@@ -1,0 +1,543 @@
+"""Constraint solving without Z3: directed probing + (later) native CDCL.
+
+The reference delegates every satisfiability question to Z3
+(mythril/laser/smt/solver/solver.py:51-121, mythril/support/model.py:15-63).
+No Z3 exists in this environment, so this framework carries its own stack:
+
+  tier 0  eager constant folding (terms.py) — most queries collapse here;
+  tier 1  directed probing: back-propagate ``X == const`` constraints through
+          invertible ops into leaf bits (a constraint-directed fuzzer), then
+          fill the rest with structured random candidates and evaluate the
+          whole DAG exactly (host big-int path, or batched on TPU via
+          mythril_tpu/ops/lowering.py when available).  A hit IS a model —
+          probing is sound by construction;
+  tier 2  native C++ bit-blasting CDCL (mythril_tpu/native/) for exact UNSAT
+          and hard SAT instances.
+
+SAT answers are always accompanied by a validated model.  UNSAT without the
+native tier is heuristic ("no model found in budget"), which mirrors the
+reference's behavior under ``--solver-timeout`` where unknown is treated as
+unsat (mythril/support/model.py:60-63).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import ArrayValue, Assignment, evaluate
+from mythril_tpu.smt.terms import Term, mask
+
+SAT = "sat"
+UNSAT = "unsat"
+UNKNOWN = "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Statistics (reference smt/solver/solver_statistics.py:29)
+# ---------------------------------------------------------------------------
+
+
+class SolverStatistics:
+    """Process-wide counters for solver usage (singleton)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enabled = False
+            cls._instance.query_count = 0
+            cls._instance.solver_time = 0.0
+            cls._instance.probe_hits = 0
+            cls._instance.cdcl_calls = 0
+        return cls._instance
+
+    def __repr__(self):
+        return (
+            f"Solver statistics: query count: {self.query_count}, "
+            f"solver time: {self.solver_time:.3f}, probe hits: {self.probe_hits}, "
+            f"cdcl calls: {self.cdcl_calls}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+
+class Model:
+    """A satisfying assignment; eval() reifies any expression under it.
+
+    Reference counterpart: mythril/laser/smt/model.py — but there is exactly
+    one backing assignment here (no multi-model merging needed: the
+    independence-split solver evaluates the joint assignment directly).
+    """
+
+    def __init__(self, assignment: Assignment):
+        self.assignment = assignment
+
+    def eval(self, expr, model_completion: bool = True):
+        raw = expr.raw if hasattr(expr, "raw") else expr
+        return evaluate([raw], self.assignment)[raw]
+
+    def decls(self):
+        return list(self.assignment.scalars.keys())
+
+
+# ---------------------------------------------------------------------------
+# Directed value propagation
+# ---------------------------------------------------------------------------
+
+
+class _PartialBits:
+    """Per-variable partially-known bits: (known-mask, value under mask)."""
+
+    __slots__ = ("known", "value", "width")
+
+    def __init__(self, width: int):
+        self.known = 0
+        self.value = 0
+        self.width = width
+
+    def set_bits(self, bitmask: int, bits: int) -> None:
+        # Later hints never override earlier ones (first directed hint wins).
+        new = bitmask & ~self.known
+        self.known |= new
+        self.value |= bits & new
+
+    def complete(self, fill: int) -> int:
+        return (self.value & self.known) | (fill & ~self.known & ((1 << self.width) - 1))
+
+
+class _Seeder:
+    """Collects directed hints from equality constraints and constant pools."""
+
+    def __init__(self, conjuncts: Sequence[Term]):
+        self.conjuncts = conjuncts
+        self.scalar_hints: Dict[Term, _PartialBits] = {}
+        self.bool_hints: Dict[Term, bool] = {}
+        # (array_var term, concrete index) -> byte/word hints
+        self.array_hints: Dict[Tuple[Term, int], int] = {}
+        self.const_pool: List[int] = []
+        self._harvest()
+        self._propagate_all()
+
+    # -- constant pool: every literal in the DAG is an interesting value
+    def _harvest(self):
+        pool = set()
+        for t in terms.topo_order(self.conjuncts):
+            if t.op == "const" and t.sort is not terms.BOOL:
+                v = t.aux
+                for cand in (v, v - 1, v + 1, (1 << t.sort[1]) - v if v else 0):
+                    pool.add(mask(cand, 256))
+        pool |= {0, 1, 2, (1 << 256) - 1, (1 << 255), (1 << 160) - 1}
+        self.const_pool = sorted(pool)
+
+    def _hint(self, t: Term) -> _PartialBits:
+        h = self.scalar_hints.get(t)
+        if h is None:
+            h = _PartialBits(t.width)
+            self.scalar_hints[t] = h
+        return h
+
+    def _propagate_all(self):
+        for c in self.conjuncts:
+            self._propagate_bool(c, True)
+
+    def _propagate_bool(self, t: Term, want: bool):
+        if t.op == "var" and t.sort is terms.BOOL:
+            self.bool_hints.setdefault(t, want)
+            return
+        if t.op == "and" and want:
+            for a in t.args:
+                self._propagate_bool(a, True)
+            return
+        if t.op == "or" and not want:
+            for a in t.args:
+                self._propagate_bool(a, False)
+            return
+        if t.op == "not":
+            self._propagate_bool(t.args[0], not want)
+            return
+        if t.op == "eq" and want:
+            a, b = t.args
+            if terms.is_bv_sort(a.sort):
+                if a.is_const:
+                    self._propagate_value(b, a.value)
+                elif b.is_const:
+                    self._propagate_value(a, b.value)
+            return
+        # Inequalities with a constant side: nudge toward the boundary.
+        if t.op in ("ult", "ule", "slt", "sle"):
+            a, b = t.args
+            if want and a.is_const and not b.is_const:
+                self._propagate_value(b, mask(a.value + 1, b.width), weak=True)
+            elif want and b.is_const and not a.is_const:
+                v = b.value - 1 if t.op in ("ult", "slt") else b.value
+                self._propagate_value(a, mask(v, a.width), weak=True)
+
+    def _propagate_value(self, t: Term, value: int, weak: bool = False):
+        """Push ``t == value`` down into leaves where ops are invertible."""
+        value = mask(value, t.width if terms.is_bv_sort(t.sort) else 1)
+        if t.op == "var":
+            self._hint(t).set_bits((1 << t.width) - 1, value)
+            return
+        if t.op == "select":
+            arr, idx = t.args
+            base = arr
+            while base.op == "store":
+                base = base.args[0]
+            if base.op == "array_var" and idx.is_const:
+                self.array_hints.setdefault((base, idx.value), value)
+            return
+        if t.op == "concat":
+            hi, lo = t.args
+            self._propagate_value(lo, value & ((1 << lo.width) - 1), weak)
+            self._propagate_value(hi, value >> lo.width, weak)
+            return
+        if t.op == "extract":
+            hi_bit, lo_bit = t.aux
+            inner = t.args[0]
+            if inner.op == "var":
+                m = (((1 << (hi_bit - lo_bit + 1)) - 1) << lo_bit)
+                self._hint(inner).set_bits(m, value << lo_bit)
+            else:
+                self._propagate_value_masked(inner, value, hi_bit, lo_bit, weak)
+            return
+        if t.op in ("zext", "sext"):
+            inner = t.args[0]
+            if value < (1 << inner.width):
+                self._propagate_value(inner, value, weak)
+            return
+        if t.op == "bvadd":
+            a, b = t.args
+            if a.is_const:
+                self._propagate_value(b, value - a.value, weak)
+            elif b.is_const:
+                self._propagate_value(a, value - b.value, weak)
+            return
+        if t.op == "bvsub":
+            a, b = t.args
+            if b.is_const:
+                self._propagate_value(a, value + b.value, weak)
+            elif a.is_const:
+                self._propagate_value(b, a.value - value, weak)
+            return
+        if t.op == "bvxor":
+            a, b = t.args
+            if a.is_const:
+                self._propagate_value(b, value ^ a.value, weak)
+            elif b.is_const:
+                self._propagate_value(a, value ^ b.value, weak)
+            return
+        if t.op == "bvnot":
+            self._propagate_value(t.args[0], ~value, weak)
+            return
+        if t.op == "bvmul":
+            a, b = t.args
+            for c, x in ((a, b), (b, a)):
+                if c.is_const and c.value % 2 == 1:
+                    inv = pow(c.value, -1, 1 << t.width)
+                    self._propagate_value(x, value * inv, weak)
+                    return
+            return
+        if t.op == "bvshl":
+            a, b = t.args
+            if b.is_const and value % (1 << min(b.value, t.width)) == 0:
+                self._propagate_value(a, value >> b.value, weak)
+            return
+        if t.op == "bvlshr":
+            a, b = t.args
+            if b.is_const:
+                self._propagate_value(a, value << b.value, weak)
+            return
+        if t.op == "ite":
+            # try to make the then-branch produce the value
+            c, a, b = t.args
+            self._propagate_bool(c, True)
+            self._propagate_value(a, value, weak=True)
+            return
+
+    def _propagate_value_masked(self, t: Term, value: int, hi_bit: int, lo_bit: int, weak: bool):
+        # extract(hi, lo, f(x)) == value: only handle f == concat-of-var chain
+        if t.op == "concat":
+            hi_part, lo_part = t.args
+            if hi_bit < lo_part.width:
+                self._propagate_value_masked(lo_part, value, hi_bit, lo_bit, weak)
+            elif lo_bit >= lo_part.width:
+                self._propagate_value_masked(
+                    hi_part, value, hi_bit - lo_part.width, lo_bit - lo_part.width, weak
+                )
+        elif t.op == "var":
+            m = (((1 << (hi_bit - lo_bit + 1)) - 1) << lo_bit)
+            self._hint(t).set_bits(m, value << lo_bit)
+
+
+# ---------------------------------------------------------------------------
+# The probe solver
+# ---------------------------------------------------------------------------
+
+
+class ProbeConfig:
+    def __init__(
+        self,
+        max_rounds: int = 4,
+        candidates_per_round: int = 48,
+        timeout_ms: int = 10_000,
+        rng_seed: int = 0x5EED,
+    ):
+        self.max_rounds = max_rounds
+        self.candidates_per_round = candidates_per_round
+        self.timeout_ms = timeout_ms
+        self.rng_seed = rng_seed
+
+
+def _interesting_fills(rng: random.Random, pool: Sequence[int], width: int):
+    """Yield an endless stream of fill values for unknown bits."""
+    yield 0
+    yield (1 << width) - 1
+    for v in pool:
+        yield v
+    while True:
+        choice = rng.random()
+        if choice < 0.35 and pool:
+            yield rng.choice(pool)
+        elif choice < 0.55:
+            yield rng.getrandbits(8)
+        elif choice < 0.75:
+            # sparse random: few set bytes
+            v = 0
+            for _ in range(rng.randint(1, 4)):
+                v |= rng.getrandbits(8) << (8 * rng.randint(0, max(0, width // 8 - 1)))
+            yield v
+        else:
+            yield rng.getrandbits(width)
+
+
+def solve_conjunction(
+    conjuncts: Sequence[Term],
+    config: Optional[ProbeConfig] = None,
+    extra_seeds: Optional[Sequence[Assignment]] = None,
+) -> Tuple[str, Optional[Assignment]]:
+    """Core entry: find a model of And(conjuncts) or report unsat/unknown."""
+    config = config or ProbeConfig()
+    stats = SolverStatistics()
+    stats.query_count += 1
+    t0 = time.time()
+
+    # tier 0: structural
+    folded = terms.land(*conjuncts)
+    if folded.op == "const":
+        if folded.aux:
+            return SAT, Assignment()
+        return UNSAT, None
+    conjuncts = list(folded.args) if folded.op == "and" else [folded]
+
+    free = terms.free_vars(conjuncts)
+    scalar_vars = [v for v in free if v.op == "var"]
+    array_vars = [v for v in free if v.op == "array_var"]
+
+    seeder = _Seeder(conjuncts)
+    rng = random.Random(config.rng_seed)
+    deadline = t0 + config.timeout_ms / 1000.0
+
+    def build_assignment(fill_iter) -> Assignment:
+        asg = Assignment()
+        for v in scalar_vars:
+            if v.sort is terms.BOOL:
+                asg.scalars[v] = seeder.bool_hints.get(v, rng.random() < 0.5)
+            else:
+                hint = seeder.scalar_hints.get(v)
+                fill = next(fill_iter)
+                if hint is not None:
+                    asg.scalars[v] = hint.complete(mask(fill, v.width))
+                else:
+                    asg.scalars[v] = mask(fill, v.width)
+        for av in array_vars:
+            backing = {
+                idx: val for (a, idx), val in seeder.array_hints.items() if a is av
+            }
+            asg.arrays[av] = ArrayValue(backing, default=0)
+        return asg
+
+    def check_asg(asg: Assignment) -> bool:
+        vals = evaluate(conjuncts, asg)
+        return all(vals[c] for c in conjuncts)
+
+    candidates: List[Assignment] = []
+    if extra_seeds:
+        candidates.extend(extra_seeds)
+    fill_iter = _interesting_fills(rng, seeder.const_pool, 256)
+    total = config.max_rounds * config.candidates_per_round
+    for i in range(total):
+        if i > 0 and time.time() > deadline:
+            break
+        candidates.append(build_assignment(fill_iter))
+
+    best_asg, best_score = None, -1
+    for asg in candidates:
+        try:
+            vals = evaluate(conjuncts, asg)
+        except NotImplementedError:
+            continue
+        score = sum(1 for c in conjuncts if vals[c])
+        if score == len(conjuncts):
+            stats.probe_hits += 1
+            stats.solver_time += time.time() - t0
+            return SAT, asg
+        if score > best_score:
+            best_score, best_asg = score, asg
+        if time.time() > deadline:
+            break
+
+    # local repair: mutate the best candidate on vars feeding failed conjuncts
+    if best_asg is not None and scalar_vars:
+        for _ in range(64):
+            if time.time() > deadline:
+                break
+            asg = Assignment(
+                dict(best_asg.scalars),
+                {k: ArrayValue(v.backing, v.default) for k, v in best_asg.arrays.items()},
+            )
+            v = rng.choice(scalar_vars)
+            if v.sort is terms.BOOL:
+                asg.scalars[v] = not asg.scalars.get(v, False)
+            else:
+                mode = rng.random()
+                cur = asg.scalars.get(v, 0)
+                if mode < 0.3:
+                    asg.scalars[v] = mask(cur + rng.choice([1, -1, 2, -2, 32, -32]), v.width)
+                elif mode < 0.6:
+                    asg.scalars[v] = cur ^ (1 << rng.randint(0, v.width - 1))
+                elif mode < 0.8 and seeder.const_pool:
+                    asg.scalars[v] = mask(rng.choice(seeder.const_pool), v.width)
+                else:
+                    asg.scalars[v] = rng.getrandbits(v.width)
+            vals = evaluate(conjuncts, asg)
+            score = sum(1 for c in conjuncts if vals[c])
+            if score == len(conjuncts):
+                stats.probe_hits += 1
+                stats.solver_time += time.time() - t0
+                return SAT, asg
+            if score >= best_score:
+                best_score, best_asg = score, asg
+
+    # tier 2: exact bit-blasting CDCL if the native library is available
+    try:
+        from mythril_tpu.native import bitblast
+
+        if bitblast.available():
+            stats.cdcl_calls += 1
+            status, asg = bitblast.solve(conjuncts, deadline - time.time())
+            stats.solver_time += time.time() - t0
+            if status == SAT and asg is not None and check_asg(asg):
+                return SAT, asg
+            if status == UNSAT:
+                return UNSAT, None
+    except ImportError:
+        pass
+
+    stats.solver_time += time.time() - t0
+    return UNKNOWN, None
+
+
+# ---------------------------------------------------------------------------
+# Solver / Optimize facades (reference smt/solver/solver.py:83-121)
+# ---------------------------------------------------------------------------
+
+
+class Solver:
+    def __init__(self, config: Optional[ProbeConfig] = None):
+        self.config = config or ProbeConfig()
+        self.constraints: List = []
+        self._model: Optional[Model] = None
+
+    def set_timeout(self, timeout_ms: int) -> None:
+        self.config.timeout_ms = timeout_ms
+
+    def add(self, *constraints) -> None:
+        for c in constraints:
+            if isinstance(c, (list, tuple)):
+                self.constraints.extend(c)
+            else:
+                self.constraints.append(c)
+
+    append = add
+
+    def _raw_conjuncts(self) -> List[Term]:
+        return [c.raw if hasattr(c, "raw") else c for c in self.constraints]
+
+    def check(self, *extra) -> str:
+        conj = self._raw_conjuncts() + [
+            c.raw if hasattr(c, "raw") else c for c in extra
+        ]
+        status, asg = solve_conjunction(conj, self.config)
+        self._model = Model(asg) if asg is not None else None
+        return status
+
+    def model(self) -> Model:
+        if self._model is None:
+            raise UnsatError("no model available (last check was not sat)")
+        return self._model
+
+    def reset(self) -> None:
+        self.constraints = []
+        self._model = None
+
+
+class Optimize(Solver):
+    """Best-effort objective optimization over probe-discovered models.
+
+    The reference uses z3.Optimize to minimize calldata size / callvalue for
+    pretty exploit reports (mythril/analysis/solver.py:216-256).  Here we take
+    the best model among the probe's satisfying candidates; exactness of the
+    optimum is not required for soundness anywhere in the pipeline.
+    """
+
+    def __init__(self, config: Optional[ProbeConfig] = None):
+        super().__init__(config)
+        self._minimize: List = []
+        self._maximize: List = []
+
+    def minimize(self, expr) -> None:
+        self._minimize.append(expr.raw if hasattr(expr, "raw") else expr)
+
+    def maximize(self, expr) -> None:
+        self._maximize.append(expr.raw if hasattr(expr, "raw") else expr)
+
+    def check(self, *extra) -> str:
+        conj = self._raw_conjuncts() + [
+            c.raw if hasattr(c, "raw") else c for c in extra
+        ]
+        best: Optional[Assignment] = None
+        best_key = None
+        status_any = UNKNOWN
+        # Ask for several models with different seeds, keep the best.
+        for seed in range(3):
+            cfg = ProbeConfig(
+                max_rounds=self.config.max_rounds,
+                candidates_per_round=self.config.candidates_per_round,
+                timeout_ms=max(1, self.config.timeout_ms // 3),
+                rng_seed=self.config.rng_seed + seed,
+            )
+            status, asg = solve_conjunction(conj, cfg)
+            if status == UNSAT:
+                self._model = None
+                return UNSAT
+            if status == SAT and asg is not None:
+                status_any = SAT
+                vals = evaluate(self._minimize + self._maximize, asg) if (
+                    self._minimize or self._maximize
+                ) else {}
+                key = tuple(
+                    [vals[m] for m in self._minimize]
+                    + [-vals[m] for m in self._maximize]
+                )
+                if best is None or key < best_key:
+                    best, best_key = asg, key
+        self._model = Model(best) if best is not None else None
+        return status_any

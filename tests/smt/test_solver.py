@@ -1,0 +1,138 @@
+"""Probe solver tests: directed seeding, models, array consistency.
+
+Mirrors the role of the reference's tests/laser/smt/ suite (solver behavior is
+validated through a sat/unsat oracle table, cf. tests/laser/keccak_tests.py:7-39).
+"""
+
+import pytest
+
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.smt import (
+    And, Concat, Extract, If, Not, Solver, Optimize, ULT, UGT, symbol_factory,
+    SAT, UNSAT, UNKNOWN,
+)
+from mythril_tpu.smt import terms
+
+
+def bv(name):
+    return symbol_factory.BitVecSym(name, 256)
+
+
+def val(v):
+    return symbol_factory.BitVecVal(v, 256)
+
+
+def test_trivial_sat_unsat():
+    s = Solver()
+    x = bv("x")
+    s.add(x == val(5))
+    assert s.check() == SAT
+    assert s.model().eval(x) == 5
+
+    s2 = Solver()
+    s2.add(val(1) == val(2))
+    assert s2.check() == UNSAT
+
+
+def test_structural_contradiction():
+    s = Solver()
+    x = bv("x")
+    s.add(x == val(5))
+    s.add(Not(x == val(5)))
+    status = s.check()
+    # Probe cannot hit this; without CDCL it may only say unknown — both
+    # answers are acceptable, SAT would be a bug.
+    assert status in (UNSAT, UNKNOWN)
+
+
+def test_directed_equality_through_add():
+    s = Solver()
+    x = bv("x")
+    s.add(x + val(100) == val(142))
+    assert s.check() == SAT
+    assert s.model().eval(x) == 42
+
+
+def test_directed_through_concat_selector():
+    """The calldata-selector pattern: Concat of byte reads == constant."""
+    cd = []
+    for i in range(4):
+        cd.append(symbol_factory.BitVecSym(f"cd_{i}", 8))
+    sel = Concat(*cd)
+    s = Solver()
+    s.add(sel == symbol_factory.BitVecVal(0xCBF0B0C0, 32))
+    assert s.check() == SAT
+    m = s.model()
+    assert m.eval(cd[0]) == 0xCB
+    assert m.eval(cd[3]) == 0xC0
+
+
+def test_inequality_boundary():
+    s = Solver()
+    x = bv("x")
+    s.add(ULT(x, val(10)))
+    s.add(UGT(x, val(7)))
+    assert s.check() == SAT
+    assert s.model().eval(x) in (8, 9)
+
+
+def test_array_select_consistency():
+    from mythril_tpu.smt import Array
+
+    a = Array("calldata", 256, 8)
+    r0 = a[val(0)]
+    r1 = a[val(1)]
+    s = Solver()
+    s.add(r0 == symbol_factory.BitVecVal(0xAA, 8))
+    s.add(r1 == symbol_factory.BitVecVal(0xBB, 8))
+    assert s.check() == SAT
+    m = s.model()
+    assert m.eval(r0) == 0xAA
+    assert m.eval(r1) == 0xBB
+    # identical indices must see identical values
+    r0b = a[val(0)]
+    assert m.eval(r0b) == 0xAA
+
+
+def test_keccak_concrete_in_model():
+    """Constraints over keccak of a probe-assigned value evaluate exactly."""
+    from mythril_tpu.smt import Keccak
+
+    x = bv("x")
+    h = Keccak(x)
+    s = Solver()
+    s.add(x == val(0))
+    s.add(h == val(0x290DECD9548B62A8D60345A988386FC84BA6BC95484008F6362F93160EF3E563))
+    assert s.check() == SAT
+
+
+def test_optimize_minimize():
+    x = bv("x")
+    o = Optimize()
+    o.add(ULT(val(5), x))
+    o.minimize(x)
+    assert o.check() == SAT
+    # best-effort minimization: should find a small-ish witness, exact min is 6
+    assert o._model.eval(x) >= 6
+
+
+def test_overflow_predicates():
+    from mythril_tpu.smt import BVAddNoOverflow, BVMulNoOverflow, BVSubNoUnderflow
+
+    a = val((1 << 256) - 1)
+    b = val(2)
+    assert BVAddNoOverflow(a, b, False).is_false
+    assert BVAddNoOverflow(val(1), val(2), False).is_true
+    assert BVMulNoOverflow(val(1 << 200), val(1 << 100), False).is_false
+    assert BVMulNoOverflow(val(10), val(10), False).is_true
+    assert BVSubNoUnderflow(val(1), val(2), False).is_false
+    assert BVSubNoUnderflow(val(2), val(1), False).is_true
+
+
+def test_taint_annotations_propagate():
+    x = bv("x")
+    x.annotate("tainted")
+    y = x + val(1)
+    assert "tainted" in y.annotations
+    z = If(y == val(3), y, val(0))
+    assert "tainted" in z.annotations

@@ -1,0 +1,138 @@
+"""Term IR unit tests: folding, interning, simplification, DAG utilities."""
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.terms import (
+    add, band, bnot, bor, bvexp, bxor, concat, const, eq, extract, ite, keccak,
+    land, lnot, lor, lshr, mul, sdiv, select, sext, shl, slt, srem, store, sub,
+    sext, to_signed, true, false, udiv, ule, ult, urem, var, zext, array_var,
+    const_array,
+)
+
+W = 256
+M = (1 << 256) - 1
+
+
+def test_interning_structural_identity():
+    a = add(var("x", W), const(1, W))
+    b = add(var("x", W), const(1, W))
+    assert a is b
+
+
+def test_constant_folding_arith():
+    assert add(const(2, W), const(3, W)).value == 5
+    assert sub(const(2, W), const(3, W)).value == M  # wraps
+    assert mul(const(1 << 255, W), const(2, W)).value == 0
+    assert udiv(const(7, W), const(0, W)).value == 0  # EVM div-by-zero = 0
+    assert sdiv(const(M, W), const(1, W)).value == M  # -1 / 1 == -1
+    assert sdiv(const((-7) & M, W), const(2, W)).value == (-3) & M  # trunc toward 0
+    assert urem(const(7, W), const(3, W)).value == 1
+    assert srem(const((-7) & M, W), const(3, W)).value == (-1) & M
+    assert bvexp(const(2, W), const(10, W)).value == 1024
+
+
+def test_identity_rewrites():
+    x = var("x", W)
+    assert add(x, const(0, W)) is x
+    assert mul(x, const(1, W)) is x
+    assert band(x, const(M, W)) is x
+    assert bor(x, const(0, W)) is x
+    assert bxor(x, x).value == 0
+    assert sub(x, x).value == 0
+    assert bnot(bnot(x)) is x
+
+
+def test_shifts():
+    assert shl(const(1, W), const(8, W)).value == 256
+    assert shl(const(1, W), const(256, W)).value == 0
+    assert lshr(const(256, W), const(8, W)).value == 1
+    assert terms.ashr(const(M, W), const(8, W)).value == M  # -1 >> 8 == -1
+
+
+def test_extract_concat():
+    x = var("x", 8)
+    y = var("y", 8)
+    c = concat(x, y)
+    assert c.width == 16
+    assert extract(7, 0, c) is y
+    assert extract(15, 8, c) is x
+    assert extract(7, 0, concat(const(0xAB, 8), const(0xCD, 8))).value == 0xCD
+    # extract-of-extract composes
+    z = var("z", 32)
+    assert extract(3, 0, extract(15, 8, z)) is extract(11, 8, z)
+    # adjacent extracts re-fuse
+    assert concat(extract(15, 8, z), extract(7, 0, z)) is extract(15, 0, z)
+
+
+def test_zext_sext():
+    assert zext(const(0xFF, 8), 8).value == 0xFF
+    assert sext(const(0xFF, 8), 8).value == 0xFFFF
+    assert sext(const(0x7F, 8), 8).value == 0x7F
+
+
+def test_bool_ops():
+    x = var("b", 8)
+    p = ult(x, const(5, 8))
+    assert land(p, true()) is p
+    assert land(p, false()) is false()
+    assert lor(p, true()) is true()
+    assert lnot(lnot(p)) is p
+    # Not pushes through comparisons
+    assert lnot(p) is ule(const(5, 8), x)
+    assert land(p, p) is p
+
+
+def test_eq_fold():
+    assert eq(const(5, W), const(5, W)) is true()
+    assert eq(const(5, W), const(6, W)) is false()
+    x = var("x", W)
+    assert eq(x, x) is true()
+
+
+def test_ite():
+    x, y = var("x", W), var("y", W)
+    assert ite(true(), x, y) is x
+    assert ite(false(), x, y) is y
+    assert ite(ult(x, y), x, x) is x
+
+
+def test_array_read_over_write():
+    a = array_var("mem", 256, 8)
+    i, j = const(0, 256), const(1, 256)
+    v = const(0xAA, 8)
+    a2 = store(a, i, v)
+    assert select(a2, i) is v
+    # distinct concrete index skips the store
+    s = select(a2, j)
+    assert s.op == "select" and s.args[0] is a
+    # symbolic index cannot skip
+    k = var("k", 256)
+    a3 = store(a, k, v)
+    assert select(a3, j).op == "select"
+    assert select(a3, k) is v
+    # const array
+    ka = const_array(256, 8, const(7, 8))
+    assert select(ka, j).value == 7
+
+
+def test_keccak_concrete_folds():
+    h = keccak(const(0, 256))
+    assert h.is_const
+    # keccak256 of 32 zero bytes
+    assert h.value == 0x290DECD9548B62A8D60345A988386FC84BA6BC95484008F6362F93160EF3E563
+
+
+def test_substitute():
+    x, y = var("x", W), var("y", W)
+    e = add(mul(x, const(3, W)), y)
+    e2 = terms.substitute(e, {x: const(2, W)})
+    e3 = terms.substitute(e2, {y: const(4, W)})
+    assert e3.value == 10
+
+
+def test_free_vars_topo():
+    x, y = var("x", W), var("y", W)
+    e = add(x, mul(y, x))
+    fv = terms.free_vars([e])
+    assert set(fv) == {x, y}
+    order = terms.topo_order([e])
+    assert order[-1] is e
