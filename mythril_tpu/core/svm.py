@@ -1,0 +1,440 @@
+"""LaserEVM: the work-list symbolic execution engine.
+
+Reference parity: mythril/laser/ethereum/svm.py:42-739 — strategy-driven main
+loop (:261-304), per-instruction execution with plugin/module hooks (:336-449),
+nested-call frame management via transaction signals (:451-504), CFG
+bookkeeping (:506-532), the 9 laser hook types + per-opcode pre/post hooks
+(:100-133, 596-739), and the multi-transaction loop with open-world-state
+reseeding (:208-245).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import logging
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from mythril_tpu.core.cfg import Edge, JumpType, Node, NodeFlags
+from mythril_tpu.core.evm_exceptions import StackUnderflowException, VmException
+from mythril_tpu.core.instructions import Instruction
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.core.state.world_state import WorldState
+from mythril_tpu.core.strategy.basic import BasicSearchStrategy, DepthFirstSearchStrategy
+from mythril_tpu.core.transaction.transaction_models import (
+    ContractCreationTransaction,
+    TransactionEndSignal,
+    TransactionStartSignal,
+)
+from mythril_tpu.plugins.signals import PluginSkipState, PluginSkipWorldState
+from mythril_tpu.support.opcodes import OPCODES, stack_inputs
+from mythril_tpu.support.support_args import args
+from mythril_tpu.support.time_handler import time_handler
+
+log = logging.getLogger(__name__)
+
+LASER_HOOK_TYPES = (
+    "start_sym_exec",
+    "stop_sym_exec",
+    "start_sym_trans",
+    "stop_sym_trans",
+    "start_exec",
+    "stop_exec",
+    "execute_state",
+    "add_world_state",
+    "transaction_start",
+    "transaction_end",
+)
+
+
+class LaserEVM:
+    def __init__(
+        self,
+        dynamic_loader=None,
+        max_depth: int = 128,
+        execution_timeout: Optional[int] = None,
+        create_timeout: Optional[int] = None,
+        strategy=DepthFirstSearchStrategy,
+        transaction_count: int = 2,
+        requires_statespace: bool = True,
+        iprof=None,
+    ):
+        self.dynamic_loader = dynamic_loader
+        self.open_states: List[WorldState] = []
+        self.total_states = 0
+
+        self.work_list: List[GlobalState] = []
+        self.strategy: BasicSearchStrategy = strategy(self.work_list, max_depth)
+        self.max_depth = max_depth
+        self.transaction_count = transaction_count
+        self.execution_timeout = execution_timeout or args.execution_timeout
+        self.create_timeout = create_timeout if create_timeout is not None else args.create_timeout
+
+        self.requires_statespace = requires_statespace
+        self.nodes: Dict[int, Node] = {}
+        self.edges: List[Edge] = []
+
+        self.time: Optional[float] = None
+        self.executed_transactions = False
+
+        # hook registries
+        self._hooks: Dict[str, List[Callable]] = {t: [] for t in LASER_HOOK_TYPES}
+        self._pre_hooks: Dict[str, List[Callable]] = defaultdict(list)
+        self._post_hooks: Dict[str, List[Callable]] = defaultdict(list)
+        self.instr_pre_hook: Dict[str, List[Callable]] = defaultdict(list)
+        self.instr_post_hook: Dict[str, List[Callable]] = defaultdict(list)
+
+        self.iprof = iprof
+        self.executed_instruction_count = 0
+
+    # ------------------------------------------------------------------
+    # hook registration (reference svm.py:596-739)
+    # ------------------------------------------------------------------
+
+    def register_laser_hooks(self, hook_type: str, hook: Callable) -> None:
+        if hook_type not in LASER_HOOK_TYPES:
+            raise ValueError(f"unknown laser hook type {hook_type}")
+        self._hooks[hook_type].append(hook)
+
+    def register_hooks(self, hook_type: str, hook_dict: Dict[str, List[Callable]]) -> None:
+        """Register detection-module hooks keyed by opcode name."""
+        target = self._pre_hooks if hook_type == "pre" else self._post_hooks
+        for op, funcs in hook_dict.items():
+            target[op].extend(funcs)
+
+    def register_instr_hooks(self, hook_type: str, opcode: Optional[str], hook: Callable) -> None:
+        """Instruction-level hooks; opcode None means every opcode."""
+        registry = self.instr_pre_hook if hook_type == "pre" else self.instr_post_hook
+        registry["*" if opcode is None else opcode].append(hook)
+
+    def _fire(self, hook_type: str, *hook_args) -> None:
+        for hook in self._hooks[hook_type]:
+            hook(*hook_args)
+
+    def extend_strategy(self, extension, **kwargs) -> None:
+        self.strategy = extension(self.strategy, **kwargs)
+
+    # ------------------------------------------------------------------
+    # top-level entry points (reference svm.py:139-245)
+    # ------------------------------------------------------------------
+
+    def sym_exec(
+        self,
+        world_state: Optional[WorldState] = None,
+        target_address: Optional[int] = None,
+        creation_code: Optional[bytes] = None,
+        contract_name: Optional[str] = None,
+    ) -> None:
+        from mythril_tpu.core.transaction import symbolic as sym_tx
+
+        pre_configured = world_state is not None and target_address is not None
+        self._fire("start_sym_exec")
+        time_handler.start_execution(self.execution_timeout)
+        self.time = time.time()
+
+        if pre_configured:
+            self.open_states = [world_state]
+            self._execute_transactions(target_address)
+        else:
+            assert creation_code is not None
+            created = sym_tx.execute_contract_creation(
+                self, creation_code, contract_name or "MAIN"
+            )
+            log.info(
+                "finished creation; %d open states, created address %s",
+                len(self.open_states),
+                created.address,
+            )
+            if created.address.value is not None:
+                self._execute_transactions(created.address.value)
+
+        self._fire("stop_sym_exec")
+
+    def _execute_transactions(self, address: int) -> None:
+        """Symbolic-tx loop: each round reseeds from surviving open states."""
+        from mythril_tpu.core.transaction import symbolic as sym_tx
+
+        self.executed_transactions = True
+        for i in range(self.transaction_count):
+            if not self.open_states:
+                break
+            # prune unreachable open states before the next round
+            if not args.sparse_pruning:
+                self.open_states = [
+                    s for s in self.open_states if s.constraints.is_possible
+                ]
+            if not self.open_states:
+                break
+            log.info(
+                "starting message call transaction %d; %d open states",
+                i,
+                len(self.open_states),
+            )
+            self._fire("start_sym_trans")
+            sym_tx.execute_message_call(self, address)
+            self._fire("stop_sym_trans")
+
+    # ------------------------------------------------------------------
+    # main loop (reference svm.py:261-304)
+    # ------------------------------------------------------------------
+
+    def exec(self, create: bool = False, track_gas: bool = False) -> Optional[List[GlobalState]]:
+        final_states: List[GlobalState] = []
+        self._fire("start_exec")
+        start = time.time()
+        deadline = (
+            start + self.create_timeout
+            if create and self.create_timeout
+            else start + self.execution_timeout
+        )
+        for global_state in self.strategy:
+            if time.time() > deadline or time_handler.time_remaining() <= 0:
+                log.info("%s timeout reached; halting exec loop", "create" if create else "execution")
+                break
+            new_states, op_code = self.execute_state(global_state)
+            if self.requires_statespace:
+                self.manage_cfg(op_code, new_states)
+            if not args.sparse_pruning:
+                new_states = [
+                    s for s in new_states if s.world_state.constraints.is_possible
+                ]
+            self.work_list.extend(new_states)
+            self.total_states += len(new_states)
+            if track_gas and not new_states and op_code is not None:
+                final_states.append(global_state)
+        self._fire("stop_exec")
+        return final_states if track_gas else None
+
+    # ------------------------------------------------------------------
+    # single-instruction execution (reference svm.py:336-449)
+    # ------------------------------------------------------------------
+
+    def execute_state(
+        self, global_state: GlobalState
+    ) -> Tuple[List[GlobalState], Optional[str]]:
+        instructions = global_state.environment.code.instruction_list
+        try:
+            instruction = instructions[global_state.mstate.pc]
+            op_code = instruction.opcode
+        except IndexError:
+            # implicit STOP off the end of code
+            self._add_world_state(global_state)
+            return [], None
+        global_state.op_code = op_code
+
+        # required stack elements check (reference svm.py:351-357); an arity
+        # miss is an exceptional halt, not an engine error
+        if op_code in OPCODES and len(global_state.mstate.stack) < stack_inputs(op_code):
+            return (
+                self._handle_vm_exception(
+                    global_state, op_code, f"not enough stack elements for {op_code}"
+                ),
+                op_code,
+            )
+
+        try:
+            self._fire("execute_state", global_state)
+        except PluginSkipState:
+            return [], None
+
+        # detection-module pre hooks
+        for hook in self._pre_hooks[op_code]:
+            try:
+                hook(global_state)
+            except PluginSkipState:
+                return [], None
+
+        self.executed_instruction_count += 1
+        try:
+            inst = Instruction(
+                op_code,
+                self.dynamic_loader,
+                pre_hooks=self.instr_pre_hook[op_code] + self.instr_pre_hook["*"],
+                post_hooks=self.instr_post_hook[op_code] + self.instr_post_hook["*"],
+            )
+            new_global_states = inst.evaluate(global_state)
+
+        except VmException as error:
+            log.debug("VM exception at pc %d: %s", global_state.mstate.pc, error)
+            new_global_states = self._handle_vm_exception(global_state, op_code, str(error))
+
+        except TransactionStartSignal as start_signal:
+            self._fire("transaction_start", start_signal.global_state, start_signal.transaction)
+            new_global_state = start_signal.transaction.initial_global_state()
+            new_global_state.transaction_stack = list(
+                start_signal.global_state.transaction_stack
+            ) + [(start_signal.transaction, start_signal.global_state)]
+            new_global_state.node = global_state.node
+            new_global_state.mstate.depth = global_state.mstate.depth
+            return [new_global_state], op_code
+
+        except TransactionEndSignal as end_signal:
+            transaction, return_global_state = end_signal.global_state.transaction_stack[-1]
+            self._fire("transaction_end", end_signal.global_state, transaction, return_global_state, end_signal.revert)
+            if return_global_state is None:
+                # outermost frame
+                if (
+                    not isinstance(transaction, ContractCreationTransaction)
+                    or transaction.return_data is not None
+                ) and not end_signal.revert:
+                    end_signal.global_state.world_state.node = global_state.node
+                    self._check_potential_issues(end_signal.global_state)
+                    self._add_world_state(end_signal.global_state)
+                new_global_states = []
+            else:
+                new_global_states = self._end_message_call(
+                    _copy.copy(return_global_state),
+                    end_signal.global_state,
+                    revert_changes=end_signal.revert,
+                    return_data=transaction.return_data,
+                    ended_transaction=transaction,
+                )
+
+        # detection-module post hooks
+        if self._post_hooks[op_code]:
+            kept = []
+            for new_state in new_global_states:
+                skip = False
+                for hook in self._post_hooks[op_code]:
+                    try:
+                        hook(new_state)
+                    except PluginSkipState:
+                        skip = True
+                        break
+                if not skip:
+                    kept.append(new_state)
+            new_global_states = kept
+
+        for new_state in new_global_states:
+            new_state.mstate.depth = global_state.mstate.depth + 1
+        return new_global_states, op_code
+
+    def _handle_vm_exception(
+        self, global_state: GlobalState, op_code: str, error: str
+    ) -> List[GlobalState]:
+        """Unwind the tx stack on exceptional halt (reference svm.py:317-334)."""
+        transaction, return_global_state = global_state.transaction_stack[-1]
+        if return_global_state is None:
+            return []
+        return self._end_message_call(
+            _copy.copy(return_global_state),
+            global_state,
+            revert_changes=True,
+            return_data=None,
+            ended_transaction=transaction,
+        )
+
+    def _end_message_call(
+        self,
+        return_global_state: GlobalState,
+        global_state: GlobalState,
+        revert_changes: bool = False,
+        return_data=None,
+        ended_transaction=None,
+    ) -> List[GlobalState]:
+        """Resume the caller frame after a child tx (reference svm.py:451-504)."""
+        if not revert_changes:
+            # adopt the child's world (storage/balances) and its constraints
+            return_global_state.world_state = global_state.world_state
+            addr = return_global_state.environment.active_account.address.value
+            if addr is not None and addr in global_state.world_state.accounts:
+                return_global_state.environment.active_account = (
+                    global_state.world_state.accounts[addr]
+                )
+        else:
+            # reverted: state rolls back, path constraints remain
+            for constraint in global_state.world_state.constraints[
+                len(return_global_state.world_state.constraints) :
+            ]:
+                return_global_state.world_state.constraints.append(constraint)
+
+        # child's gas is spent either way
+        return_global_state.mstate.min_gas_used += global_state.mstate.min_gas_used
+        return_global_state.mstate.max_gas_used += global_state.mstate.max_gas_used
+
+        return_global_state.last_return_data = return_data
+        if ended_transaction is not None:
+            return_global_state.call_output_location = (
+                getattr(ended_transaction, "memory_out_offset", None),
+                getattr(ended_transaction, "memory_out_size", None),
+            )
+
+        # resume via the <op>_post handler of the call instruction
+        op_code = return_global_state.environment.code.instruction_list[
+            return_global_state.mstate.pc
+        ].opcode
+        try:
+            new_states = Instruction(op_code, self.dynamic_loader).evaluate(
+                return_global_state, post=True
+            )
+        except VmException:
+            new_states = []
+        return new_states
+
+    def _check_potential_issues(self, global_state: GlobalState) -> None:
+        """Solve deferred issues at tx end (reference svm.py:423)."""
+        try:
+            from mythril_tpu.analysis.potential_issues import check_potential_issues
+
+            check_potential_issues(global_state)
+        except ImportError:
+            pass
+
+    def _add_world_state(self, global_state: GlobalState) -> None:
+        """Archive a surviving world state as a seed for the next tx."""
+        try:
+            self._fire("add_world_state", global_state)
+        except (PluginSkipState, PluginSkipWorldState):
+            return
+        self.open_states.append(global_state.world_state)
+
+    # ------------------------------------------------------------------
+    # CFG bookkeeping (reference svm.py:506-532)
+    # ------------------------------------------------------------------
+
+    def manage_cfg(self, opcode: Optional[str], new_states: List[GlobalState]) -> None:
+        if opcode is None:
+            return
+        if opcode == "JUMP":
+            for state in new_states:
+                self._new_node_state(state)
+        elif opcode == "JUMPI":
+            for state in new_states:
+                condition = (
+                    state.world_state.constraints[-1]
+                    if state.world_state.constraints
+                    else None
+                )
+                self._new_node_state(state, JumpType.CONDITIONAL, condition)
+        elif opcode in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL", "CREATE", "CREATE2"):
+            for state in new_states:
+                self._new_node_state(state, JumpType.CALL)
+        elif opcode in ("RETURN", "STOP"):
+            for state in new_states:
+                self._new_node_state(state, JumpType.RETURN)
+        for state in new_states:
+            if state.node is not None:
+                state.node.states.append(state)
+
+    def _new_node_state(self, state: GlobalState, edge_type=JumpType.UNCONDITIONAL, condition=None) -> None:
+        if not self.requires_statespace:
+            return
+        old_node = state.node
+        new_node = Node(state.environment.active_account.contract_name)
+        new_node.start_addr = state.get_current_instruction()["address"]
+        self.nodes[new_node.uid] = new_node
+        if old_node is not None:
+            self.edges.append(
+                Edge(old_node.uid, new_node.uid, edge_type=edge_type, condition=condition)
+            )
+        state.node = new_node
+        new_node.constraints = state.world_state.constraints.copy()
+        # function-entry naming
+        address = new_node.start_addr
+        env = state.environment
+        if env.code is not None and address in env.code.address_to_function_name:
+            new_node.flags |= NodeFlags.FUNC_ENTRY
+            new_node.function_name = env.code.address_to_function_name[address]
+        elif old_node is not None:
+            new_node.function_name = old_node.function_name

@@ -1,0 +1,202 @@
+"""Transaction models and the engine's control-transfer signals.
+
+Reference parity: mythril/laser/ethereum/transaction/transaction_models.py:
+signals (:35-54), BaseTransaction (:57), MessageCallTransaction (:159),
+ContractCreationTransaction (:194), TxIdManager (:20-32).  Control transfer
+between call frames is exception-driven in the worklist engine — a deliberate
+parity choice: the host orchestrates frames; device kernels only ever see
+single-frame segments.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+from typing import Optional
+
+from mythril_tpu.core.state.account import Account
+from mythril_tpu.core.state.calldata import BaseCalldata, ConcreteCalldata
+from mythril_tpu.core.state.constraints import Constraints
+from mythril_tpu.core.state.environment import Environment
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.core.state.machine_state import MachineState
+from mythril_tpu.core.state.world_state import WorldState
+from mythril_tpu.smt import BitVec, UGE, symbol_factory
+
+
+class TxIdManager:
+    """Monotone transaction-id source (reference :20-32)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._next = 0
+        return cls._instance
+
+    def get_next_tx_id(self) -> str:
+        self._next += 1
+        return str(self._next)
+
+    def restart_counter(self) -> None:
+        self._next = 0
+
+
+tx_id_manager = TxIdManager()
+
+
+class TransactionStartSignal(Exception):
+    """Raised by CALL-family handlers to push a new frame."""
+
+    def __init__(self, transaction, op_code: str, global_state: GlobalState):
+        self.transaction = transaction
+        self.op_code = op_code
+        self.global_state = global_state
+
+
+class TransactionEndSignal(Exception):
+    """Raised by terminal handlers (STOP/RETURN/REVERT/SELFDESTRUCT)."""
+
+    def __init__(self, global_state: GlobalState, revert: bool = False):
+        self.global_state = global_state
+        self.revert = revert
+
+
+class BaseTransaction:
+    def __init__(
+        self,
+        world_state: WorldState,
+        callee_account: Optional[Account] = None,
+        caller: Optional[BitVec] = None,
+        call_data: Optional[BaseCalldata] = None,
+        identifier: Optional[str] = None,
+        gas_price=None,
+        gas_limit: int = 8_000_000,
+        origin=None,
+        code=None,
+        call_value=None,
+        init_call_data: bool = True,
+        static: bool = False,
+        base_fee=None,
+    ):
+        self.world_state = world_state
+        self.id = identifier or tx_id_manager.get_next_tx_id()
+        self.gas_limit = gas_limit
+        self.gas_price = (
+            gas_price
+            if gas_price is not None
+            else symbol_factory.BitVecSym(f"{self.id}_gasprice", 256)
+        )
+        self.base_fee = (
+            base_fee
+            if base_fee is not None
+            else symbol_factory.BitVecSym(f"{self.id}_basefee", 256)
+        )
+        self.origin = (
+            origin if origin is not None else symbol_factory.BitVecSym(f"{self.id}_origin", 256)
+        )
+        self.code = code
+        self.caller = caller
+        self.callee_account = callee_account
+        if call_data is None and init_call_data:
+            call_data = ConcreteCalldata(self.id, [])
+        self.call_data = call_data
+        self.call_value = (
+            call_value
+            if call_value is not None
+            else symbol_factory.BitVecSym(f"{self.id}_callvalue", 256)
+        )
+        self.static = static
+        self.return_data = None
+
+    def initial_global_state_from_environment(self, environment, active_function):
+        """Seed a GlobalState for this tx + the sender-balance constraint."""
+        global_state = GlobalState(self.world_state, environment)
+        global_state.environment.active_function_name = active_function
+        sender = environment.sender
+        value = environment.callvalue
+        # sender must afford the transfer (reference :120-145)
+        global_state.world_state.constraints.append(
+            UGE(global_state.world_state.balances[sender], value)
+        )
+        global_state.world_state.balances[sender] -= value
+        global_state.world_state.balances[environment.active_account.address] += value
+        return global_state
+
+    def __str__(self):
+        addr = (
+            self.callee_account.address
+            if self.callee_account is not None
+            else "<creating>"
+        )
+        return f"{type(self).__name__} {self.id} to {addr}"
+
+
+class MessageCallTransaction(BaseTransaction):
+    """A symbolic or concrete message call (reference :159)."""
+
+    def initial_global_state(self) -> GlobalState:
+        environment = Environment(
+            self.callee_account,
+            self.caller,
+            self.call_data,
+            self.gas_price,
+            self.call_value,
+            self.origin,
+            code=self.code or self.callee_account.code,
+            basefee=self.base_fee,
+            static=self.static,
+        )
+        return super().initial_global_state_from_environment(
+            environment, active_function="fallback"
+        )
+
+    def end(self, global_state: GlobalState, return_data=None, revert: bool = False):
+        self.return_data = return_data
+        raise TransactionEndSignal(global_state, revert)
+
+
+class ContractCreationTransaction(BaseTransaction):
+    """Creation tx: executes init code, assigns runtime code on RETURN.
+
+    Reference :194-271 — snapshots ``prev_world_state`` for exploit-report
+    initial-state reconstruction, and ``end()`` installs the returned runtime
+    bytecode into the created account.
+    """
+
+    def __init__(self, *args, contract_name=None, **kwargs):
+        # snapshot the pre-state before the account is created
+        world_state = kwargs.get("world_state") if "world_state" in kwargs else args[0]
+        self.prev_world_state = _copy.copy(world_state)
+        super().__init__(*args, **kwargs)
+        self.contract_name = contract_name or "unknown_contract"
+        if self.callee_account is None:
+            self.callee_account = self.world_state.create_account(
+                0, concrete_storage=True
+            )
+        self.callee_account.contract_name = self.contract_name
+
+    def initial_global_state(self) -> GlobalState:
+        environment = Environment(
+            self.callee_account,
+            self.caller,
+            self.call_data,
+            self.gas_price,
+            self.call_value,
+            self.origin,
+            code=self.code,
+            basefee=self.base_fee,
+        )
+        return super().initial_global_state_from_environment(
+            environment, active_function="constructor"
+        )
+
+    def end(self, global_state: GlobalState, return_data=None, revert: bool = False):
+        from mythril_tpu.frontend.disassembler import Disassembly
+
+        if not revert and return_data is not None and isinstance(return_data, (bytes, bytearray)):
+            global_state.environment.active_account.code = Disassembly(bytes(return_data))
+            self.return_data = global_state.environment.active_account.address
+        elif not revert:
+            self.return_data = None
+        raise TransactionEndSignal(global_state, revert)

@@ -94,7 +94,8 @@ class Model:
 
 
 class _PartialBits:
-    """Per-variable partially-known bits: (known-mask, value under mask)."""
+    """Per-variable partially-known bits (strong hints from equalities;
+    the first directed hint for a bit wins)."""
 
     __slots__ = ("known", "value", "width")
 
@@ -104,7 +105,6 @@ class _PartialBits:
         self.width = width
 
     def set_bits(self, bitmask: int, bits: int) -> None:
-        # Later hints never override earlier ones (first directed hint wins).
         new = bitmask & ~self.known
         self.known |= new
         self.value |= bits & new
@@ -113,18 +113,75 @@ class _PartialBits:
         return (self.value & self.known) | (fill & ~self.known & ((1 << self.width) - 1))
 
 
-class _Seeder:
-    """Collects directed hints from equality constraints and constant pools."""
+def _clone_bits(h: "_PartialBits") -> "_PartialBits":
+    out = _PartialBits(h.width)
+    out.known, out.value = h.known, h.value
+    return out
 
-    def __init__(self, conjuncts: Sequence[Term]):
+
+class _Seeder:
+    """Collects directed hints from equality constraints and constant pools.
+
+    Disjunctions wanted true are collected as *choice groups*: each probe
+    candidate commits to one disjunct per group (rotating with the candidate
+    index), so constraints like ``caller == A ∨ caller == B ∨ caller == C``
+    or selector alternations are solved by construction, not by luck.
+    """
+
+    def __init__(self, conjuncts: Sequence[Term], collect_groups: bool = True):
         self.conjuncts = conjuncts
         self.scalar_hints: Dict[Term, _PartialBits] = {}
         self.bool_hints: Dict[Term, bool] = {}
         # (array_var term, concrete index) -> byte/word hints
         self.array_hints: Dict[Tuple[Term, int], int] = {}
         self.const_pool: List[int] = []
+        # weak full-variable hints (inequality boundaries): max-combined so
+        # e.g. repeated ``i < calldatasize`` reads push the size upward
+        self.weak_vals: Dict[Term, int] = {}
+        # symbolic-symbolic equalities (e.g. caller == sload(owner_slot)):
+        # resolved at assignment-build time by copying the evaluated side
+        self.link_pairs: List[Tuple[Term, Term]] = []
+        self.or_groups: List[List[Term]] = []
+        self._overlay_cache: Dict[tuple, "_Seeder"] = {}
+        self._collect_groups = collect_groups
         self._harvest()
         self._propagate_all()
+
+    def overlay_for(self, candidate_index: int) -> "_Seeder":
+        """Base hints + one committed disjunct per or-group.
+
+        Disjunct combinations are enumerated mixed-radix over the candidate
+        index so every combination is eventually committed, and overlays are
+        memoized per combination (only prod(len(g)) distinct ones exist).
+        """
+        if not self.or_groups:
+            return self
+        choices = []
+        div = 1
+        for group in self.or_groups:
+            choices.append((candidate_index // div) % len(group))
+            div *= len(group)
+        key = tuple(choices)
+        cached = self._overlay_cache.get(key)
+        if cached is not None:
+            return cached
+        clone = _Seeder.__new__(_Seeder)
+        clone.conjuncts = self.conjuncts
+        clone.scalar_hints = {
+            t: _clone_bits(h) for t, h in self.scalar_hints.items()
+        }
+        clone.bool_hints = dict(self.bool_hints)
+        clone.array_hints = dict(self.array_hints)
+        clone.weak_vals = dict(self.weak_vals)
+        clone.link_pairs = list(self.link_pairs)
+        clone.const_pool = self.const_pool
+        clone.or_groups = []
+        clone._collect_groups = False
+        clone._overlay_cache = {}
+        for gi, group in enumerate(self.or_groups):
+            clone._propagate_bool(group[choices[gi]], True)
+        self._overlay_cache[key] = clone
+        return clone
 
     # -- constant pool: every literal in the DAG is an interesting value
     def _harvest(self):
@@ -160,8 +217,24 @@ class _Seeder:
             for a in t.args:
                 self._propagate_bool(a, False)
             return
+        if t.op == "or" and want:
+            if self._collect_groups:
+                self.or_groups.append(list(t.args))
+            else:
+                self._propagate_bool(t.args[0], True)
+            return
         if t.op == "not":
             self._propagate_bool(t.args[0], not want)
+            return
+        if t.op == "ite":
+            # make the condition pick the branch that can satisfy `want`
+            c, a, b = t.args
+            if a.op == "const" and bool(a.aux) == want:
+                self._propagate_bool(c, True)
+                return
+            if b.op == "const" and bool(b.aux) == want:
+                self._propagate_bool(c, False)
+                return
             return
         if t.op == "eq" and want:
             a, b = t.args
@@ -170,8 +243,10 @@ class _Seeder:
                     self._propagate_value(b, a.value)
                 elif b.is_const:
                     self._propagate_value(a, b.value)
+                else:
+                    self.link_pairs.append((a, b))
             return
-        # Inequalities with a constant side: nudge toward the boundary.
+        # Inequalities: nudge toward the boundary, or zero the small side.
         if t.op in ("ult", "ule", "slt", "sle"):
             a, b = t.args
             if want and a.is_const and not b.is_const:
@@ -179,12 +254,19 @@ class _Seeder:
             elif want and b.is_const and not a.is_const:
                 v = b.value - 1 if t.op in ("ult", "slt") else b.value
                 self._propagate_value(a, mask(v, a.width), weak=True)
+            elif want and t.op in ("ult", "ule"):
+                # a <= b with both symbolic: a = 0 always works for ule and
+                # usually for ult; hint weakly so stronger hints win
+                self._propagate_value(a, 0, weak=True)
 
     def _propagate_value(self, t: Term, value: int, weak: bool = False):
         """Push ``t == value`` down into leaves where ops are invertible."""
         value = mask(value, t.width if terms.is_bv_sort(t.sort) else 1)
         if t.op == "var":
-            self._hint(t).set_bits((1 << t.width) - 1, value)
+            if weak:
+                self.weak_vals[t] = max(self.weak_vals.get(t, 0), value)
+            else:
+                self._hint(t).set_bits((1 << t.width) - 1, value)
             return
         if t.op == "select":
             arr, idx = t.args
@@ -204,7 +286,8 @@ class _Seeder:
             inner = t.args[0]
             if inner.op == "var":
                 m = (((1 << (hi_bit - lo_bit + 1)) - 1) << lo_bit)
-                self._hint(inner).set_bits(m, value << lo_bit)
+                if not weak:
+                    self._hint(inner).set_bits(m, value << lo_bit)
             else:
                 self._propagate_value_masked(inner, value, hi_bit, lo_bit, weak)
             return
@@ -274,7 +357,8 @@ class _Seeder:
                 )
         elif t.op == "var":
             m = (((1 << (hi_bit - lo_bit + 1)) - 1) << lo_bit)
-            self._hint(t).set_bits(m, value << lo_bit)
+            if not weak:
+                self._hint(t).set_bits(m, value << lo_bit)
 
 
 # ---------------------------------------------------------------------------
@@ -345,24 +429,91 @@ def solve_conjunction(
     rng = random.Random(config.rng_seed)
     deadline = t0 + config.timeout_ms / 1000.0
 
-    def build_assignment(fill_iter) -> Assignment:
+    def build_assignment(fill_iter, candidate_index: int) -> Assignment:
+        s = seeder.overlay_for(candidate_index)
+        use_weak = candidate_index % 3 != 2  # periodically explore past weak hints
         asg = Assignment()
         for v in scalar_vars:
             if v.sort is terms.BOOL:
-                asg.scalars[v] = seeder.bool_hints.get(v, rng.random() < 0.5)
+                asg.scalars[v] = s.bool_hints.get(v, rng.random() < 0.5)
+                continue
+            hint = s.scalar_hints.get(v)
+            if use_weak and v in s.weak_vals and (hint is None or hint.known == 0):
+                fill = s.weak_vals[v]
             else:
-                hint = seeder.scalar_hints.get(v)
                 fill = next(fill_iter)
-                if hint is not None:
-                    asg.scalars[v] = hint.complete(mask(fill, v.width))
-                else:
-                    asg.scalars[v] = mask(fill, v.width)
+            if hint is not None:
+                asg.scalars[v] = hint.complete(mask(fill, v.width))
+            else:
+                asg.scalars[v] = mask(fill, v.width)
         for av in array_vars:
             backing = {
-                idx: val for (a, idx), val in seeder.array_hints.items() if a is av
+                idx: val for (a, idx), val in s.array_hints.items() if a is av
             }
             asg.arrays[av] = ArrayValue(backing, default=0)
+        apply_links(s, asg)
         return asg
+
+    def _link_target(t):
+        """(kind, ...) if ``t`` is directly assignable in a candidate."""
+        if t.op == "var" and t.sort is not terms.BOOL:
+            return ("var", t)
+        if t.op == "select" and t.args[0].op == "array_var" and t.args[1].is_const:
+            return ("sel", t.args[0], t.args[1].value)
+        return None
+
+    def apply_links(s, asg: Assignment) -> None:
+        """Copy evaluated values across symbolic equalities (two passes).
+
+        Direction-aware: the determined side (strong hint, array hint, or a
+        value written by an earlier link) is the source; the undetermined side
+        is the target.  Both-determined pairs are left alone so constant-
+        derived hints are never clobbered.
+        """
+        if not s.link_pairs:
+            return
+        written: set = set()
+
+        def determined(t) -> Optional[tuple]:
+            """None if assignable-and-unset, else a truthy marker."""
+            info = _link_target(t)
+            if info is None:
+                return ("expr",)  # complex expression: can only be a source
+            if info[0] == "var":
+                hint = s.scalar_hints.get(info[1])
+                if (hint is not None and hint.known) or info[1] in written:
+                    return ("set",)
+                return None
+            key = (info[1], info[2])
+            if key in s.array_hints or key in written:
+                return ("set",)
+            return None
+
+        def write(target, value) -> None:
+            info = _link_target(target)
+            if info[0] == "var":
+                asg.scalars[info[1]] = value
+                written.add(info[1])
+            else:
+                asg.arrays.setdefault(info[1], ArrayValue()).backing[info[2]] = value
+                written.add((info[1], info[2]))
+
+        for _ in range(2):
+            for a, b in s.link_pairs:
+                da, db = determined(a), determined(b)
+                if da is not None and db is None:
+                    target, source = b, a
+                elif db is not None and da is None:
+                    target, source = a, b
+                elif da is None and db is None:
+                    target, source = a, b  # arbitrary: propagate left from right
+                else:
+                    continue  # both determined (or both unassignable)
+                try:
+                    value = evaluate([source], asg)[source]
+                except NotImplementedError:
+                    continue
+                write(target, value)
 
     def check_asg(asg: Assignment) -> bool:
         vals = evaluate(conjuncts, asg)
@@ -376,7 +527,7 @@ def solve_conjunction(
     for i in range(total):
         if i > 0 and time.time() > deadline:
             break
-        candidates.append(build_assignment(fill_iter))
+        candidates.append(build_assignment(fill_iter, i))
 
     best_asg, best_score = None, -1
     for asg in candidates:

@@ -472,6 +472,19 @@ def eq(a: Term, b: Term) -> Term:
         return boolval(a.value == b.value)
     if b.is_const and not a.is_const:
         a, b = b, a
+    # eq(const, ite(c, x, y)) with constant branches folds to c / ¬c — this is
+    # the `If(cond, 1, 0) == 0` pattern every comparison+JUMPI produces
+    if a.is_const and b.op == "ite":
+        c, x, y = b.args
+        if x.is_const and y.is_const:
+            ex, ey = a.value == x.value, a.value == y.value
+            if ex and ey:
+                return true()
+            if ex:
+                return c
+            if ey:
+                return lnot(c)
+            return false()
     return _mk("eq", BOOL, (a, b))
 
 

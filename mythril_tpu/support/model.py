@@ -1,0 +1,75 @@
+"""get_model: the one model-query entry point used across the framework.
+
+Reference parity: mythril/support/model.py:15-63 — memoized over the constraint
+tuple, applies the solver timeout clamped by remaining execution time, raises
+UnsatError on unsat/unknown.  Here the query routes to the probe/CDCL stack
+(mythril_tpu/smt/solver.py) instead of a z3 Optimize instance.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from typing import Optional, Sequence, Tuple
+
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.smt.solver import Model, Optimize, ProbeConfig, SAT, UNSAT
+from mythril_tpu.support.support_args import args
+from mythril_tpu.support.time_handler import time_handler
+
+
+def get_model(
+    constraints,
+    minimize=(),
+    maximize=(),
+    enforce_execution_time: bool = True,
+    solver_timeout: Optional[int] = None,
+) -> Model:
+    """Solve ``constraints``; return a Model or raise UnsatError."""
+    timeout = solver_timeout if solver_timeout is not None else args.solver_timeout
+    if enforce_execution_time:
+        timeout = min(timeout, int(max(time_handler.time_remaining(), 0) * 1000) // 2 + 1)
+    if timeout <= 0:
+        raise UnsatError("solver budget exhausted")
+
+    raws = tuple(c.raw if hasattr(c, "raw") else c for c in constraints)
+    min_raws = tuple(m.raw if hasattr(m, "raw") else m for m in minimize)
+    max_raws = tuple(m.raw if hasattr(m, "raw") else m for m in maximize)
+    return _get_model_cached(raws, min_raws, max_raws, timeout)
+
+
+@lru_cache(maxsize=2**18)
+def _get_model_cached(raws: tuple, min_raws: tuple, max_raws: tuple, timeout: int) -> Model:
+    # lru_cache keyed by interned term tuples — the counterpart of the
+    # reference's 2**23-entry cache over z3 constraint tuples.
+    opt = Optimize(
+        ProbeConfig(
+            max_rounds=args.probe_rounds,
+            candidates_per_round=args.probe_candidates,
+            timeout_ms=timeout,
+        )
+    )
+    opt.add(*raws)
+    for m in min_raws:
+        opt.minimize(m)
+    for m in max_raws:
+        opt.maximize(m)
+    if args.solver_log:
+        _dump_query(raws, args.solver_log)
+    status = opt.check()
+    if status != SAT:
+        raise UnsatError(f"no model found ({status})")
+    return opt.model()
+
+
+_dump_counter = [0]
+
+
+def _dump_query(raws, directory: str) -> None:
+    """Dump the query term dump (the .ir analogue of --solver-log .smt2 files)."""
+    os.makedirs(directory, exist_ok=True)
+    _dump_counter[0] += 1
+    path = os.path.join(directory, f"query_{_dump_counter[0]:06d}.ir")
+    with open(path, "w") as f:
+        for r in raws:
+            f.write(repr(r) + "\n")

@@ -1,0 +1,39 @@
+"""Global analysis flags.
+
+The reference threads ~15 flags through a mutable ``Args`` singleton
+(mythril/support/support_args.py:5-24).  This build keeps the same access
+pattern for engine code but the object is a plain dataclass that the facade
+constructs and *also* installs as the module-level default — device-side code
+never reads it (flags are baked into traced programs as static arguments), so
+the pjit-tracing hazard the survey warns about (SURVEY.md §5.6) does not arise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Args:
+    solver_timeout: int = 10000  # ms, per query
+    execution_timeout: int = 86400  # s, whole run
+    create_timeout: int = 10  # s, creation tx
+    max_depth: int = 128
+    call_depth_limit: int = 3
+    loop_bound: int = 3
+    transaction_count: int = 2
+    pruning_factor: Optional[float] = None
+    unconstrained_storage: bool = False
+    sparse_pruning: bool = False
+    parallel_solving: bool = False  # TPU probe batches instead of z3 threads
+    solver_log: Optional[str] = None
+    use_integer_module: bool = True
+    use_attack_as_target: bool = False
+    # probe solver tuning
+    probe_candidates: int = 48
+    probe_rounds: int = 4
+    probe_backend: str = "auto"  # auto | host | jax
+
+
+args = Args()

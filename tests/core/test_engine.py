@@ -1,0 +1,84 @@
+"""End-to-end engine tests: symbolic execution over real (hand-assembled) bytecode."""
+
+import pytest
+
+from mythril_tpu.core.svm import LaserEVM
+from mythril_tpu.core.state.world_state import WorldState
+from mythril_tpu.core.transaction.symbolic import ACTORS
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.support.model import get_model
+from mythril_tpu.exceptions import UnsatError
+
+# kill() dispatcher: selector 0x41c0e1b5 -> SELFDESTRUCT(caller); else REVERT
+KILL_CODE = "60003560e01c6341c0e1b51460145760006000fd5b33ff"
+
+# storage counter: any call does SSTORE(0, SLOAD(0)+1) then STOP
+COUNTER_CODE = "60005460010160005500"
+
+
+def run_contract(code_hex, tx_count=1, hooks=None):
+    ws = WorldState()
+    acct = ws.create_account(
+        balance=0, address=0x0901D12E, code=Disassembly(bytes.fromhex(code_hex))
+    )
+    acct.contract_name = "Test"
+    laser = LaserEVM(transaction_count=tx_count, execution_timeout=60)
+    if hooks:
+        for kind, hook_dict in hooks.items():
+            laser.register_hooks(kind, hook_dict)
+    laser.sym_exec(world_state=ws, target_address=acct.address.value)
+    return laser
+
+
+def test_selfdestruct_path_reached_with_model():
+    captured = []
+    run_contract(
+        KILL_CODE, hooks={"pre": {"SELFDESTRUCT": [lambda gs: captured.append(gs)]}}
+    )
+    assert len(captured) == 1
+    gs = captured[0]
+    model = get_model(
+        gs.world_state.constraints + [gs.environment.sender == ACTORS.attacker]
+    )
+    calldata = gs.current_transaction.call_data.concrete(model)
+    assert bytes(calldata[:4]).hex() == "41c0e1b5"
+    assert model.eval(gs.environment.sender) == ACTORS.attacker.value
+
+
+def test_revert_path_produces_no_open_state():
+    laser = run_contract(KILL_CODE)
+    # one open state from the selfdestruct (non-revert) terminal only
+    assert len(laser.open_states) == 1
+
+
+def test_counter_increments_across_transactions():
+    laser = run_contract(COUNTER_CODE, tx_count=2)
+    # every tx STOPs -> one open state per tx round
+    assert len(laser.open_states) == 1
+    ws = laser.open_states[0]
+    storage = ws.accounts[0x0901D12E].storage
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.smt.solver import Solver, SAT
+
+    s = Solver()
+    # after 2 txs on fresh storage the slot should be able to equal start+2;
+    # storage starts symbolic, so check write structure: last write = read+1
+    value = storage[symbol_factory.BitVecVal(0, 256)]
+    assert value.symbolic
+
+    sat_check = Solver()
+    sat_check.add(ws.constraints)
+    assert sat_check.check() == SAT
+
+
+def test_unreachable_branch_prunes():
+    # PUSH1 0 PUSH1 7 JUMPI -> taken branch is statically impossible
+    code = "600060075700005b00"
+    laser = run_contract(code)
+    # execution must finish without error and produce the fallthrough STOP state
+    assert len(laser.open_states) >= 1
+
+
+def test_total_states_counted():
+    laser = run_contract(KILL_CODE)
+    assert laser.total_states > 5

@@ -1,0 +1,132 @@
+"""State-model unit tests (reference parity: tests/laser/state/)."""
+
+import pytest
+
+from mythril_tpu.core.evm_exceptions import StackOverflowException, StackUnderflowException
+from mythril_tpu.core.state.calldata import (
+    BasicConcreteCalldata,
+    BasicSymbolicCalldata,
+    ConcreteCalldata,
+    SymbolicCalldata,
+)
+from mythril_tpu.core.state.machine_state import MachineStack, MachineState
+from mythril_tpu.core.state.memory import Memory
+from mythril_tpu.core.state.world_state import WorldState
+from mythril_tpu.smt import symbol_factory
+from mythril_tpu.smt.solver import Solver, SAT
+
+
+def val(v, w=256):
+    return symbol_factory.BitVecVal(v, w)
+
+
+class TestMachineStack:
+    def test_overflow(self):
+        stack = MachineStack()
+        for i in range(1024):
+            stack.append(i)
+        with pytest.raises(StackOverflowException):
+            stack.append(1)
+
+    def test_underflow(self):
+        with pytest.raises(StackUnderflowException):
+            MachineStack().pop()
+
+
+class TestMemory:
+    def test_word_roundtrip(self):
+        mem = Memory()
+        mem.write_word_at(val(0), val(0xDEADBEEF))
+        assert mem.get_word_at(val(0)).value == 0xDEADBEEF
+
+    def test_byte_level(self):
+        mem = Memory()
+        mem.write_word_at(val(0), val(0x0102030405060708 << (8 * 24)))
+        assert mem.get_byte(val(0)).value == 0x01
+        assert mem.get_byte(val(7)).value == 0x08
+        assert mem.get_byte(val(31)).value == 0
+
+    def test_symbolic_index(self):
+        mem = Memory()
+        idx = symbol_factory.BitVecSym("idx", 256)
+        mem.set_byte(idx, val(0xAB, 8))
+        assert mem.get_byte(idx).value == 0xAB  # same term -> same cell
+
+    def test_copy_isolation(self):
+        mem = Memory()
+        mem.set_byte(val(0), val(1, 8))
+        mem2 = mem.copy()
+        mem2.set_byte(val(0), val(2, 8))
+        assert mem.get_byte(val(0)).value == 1
+        assert mem2.get_byte(val(0)).value == 2
+
+
+class TestMachineState:
+    def test_memory_gas(self):
+        ms = MachineState(gas_limit=100000)
+        ms.mem_extend(0, 32)
+        assert ms.min_gas_used == 3
+        ms.mem_extend(0, 32)  # no growth, no charge
+        assert ms.min_gas_used == 3
+        ms.mem_extend(32, 32)
+        assert ms.min_gas_used == 6
+
+
+class TestCalldata:
+    def test_concrete_models_agree(self):
+        data = [0xAB, 0x12, 0x58, 0x50]
+        for cls in (ConcreteCalldata, BasicConcreteCalldata):
+            cd = cls("1", data)
+            assert cd[0].value == 0xAB
+            assert cd.calldatasize.value == 4
+            assert cd.concrete(None) == data
+            word = cd.get_word_at(0)
+            assert word.value == int.from_bytes(bytes(data) + bytes(28), "big")
+
+    def test_symbolic_calldata_constrainable(self):
+        cd = SymbolicCalldata("2")
+        s = Solver()
+        s.add(cd[0] == symbol_factory.BitVecVal(0xFE, 8))
+        s.add(cd.calldatasize == val(4))
+        assert s.check() == SAT
+        concrete = cd.concrete(s.model())
+        assert concrete[0] == 0xFE
+        assert len(concrete) == 4
+
+    def test_basic_symbolic_read_tracking(self):
+        cd = BasicSymbolicCalldata("3")
+        b0 = cd[0]
+        s = Solver()
+        s.add(b0 == symbol_factory.BitVecVal(0x7F, 8))
+        s.add(cd.calldatasize == val(1))
+        assert s.check() == SAT
+        assert cd.concrete(s.model()) == [0x7F]
+
+
+class TestWorldState:
+    def test_account_auto_create(self):
+        ws = WorldState()
+        acct = ws[val(0x1234)]
+        assert acct.address.value == 0x1234
+
+    def test_balance_transfer_symbolic(self):
+        ws = WorldState()
+        a = ws.create_account(balance=100, address=0xA)
+        b = ws.create_account(balance=0, address=0xB)
+        ws.balances[val(0xB)] = ws.balances[val(0xB)] + val(40)
+        ws.balances[val(0xA)] = ws.balances[val(0xA)] - val(40)
+        s = Solver()
+        s.add(ws.balances[val(0xB)] == val(40))
+        s.add(ws.balances[val(0xA)] == val(60))
+        assert s.check() == SAT
+
+    def test_copy_forks_storage(self):
+        import copy
+
+        ws = WorldState()
+        acct = ws.create_account(balance=0, address=0xA, concrete_storage=True)
+        acct.storage[val(1)] = val(111)
+        ws2 = copy.copy(ws)
+        ws2.accounts[0xA].storage[val(1)] = val(222)
+        assert ws.accounts[0xA].storage[val(1)].value == 111
+        assert ws2.accounts[0xA].storage[val(1)].value == 222
