@@ -1,0 +1,4 @@
+"""python -m mythril_tpu entry point."""
+from mythril_tpu.interfaces.cli import main
+
+main()

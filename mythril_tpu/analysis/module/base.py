@@ -1,0 +1,65 @@
+"""DetectionModule base class.
+
+Reference parity: mythril/analysis/module/base.py:20-116 — CALLBACK (per-state
+hook) vs POST (whole statespace) entry points, pre/post opcode hook lists, and
+the (address, bytecode-hash) issue cache that stops re-analysis of already
+flagged program points.
+"""
+
+from __future__ import annotations
+
+import logging
+from enum import Enum
+from typing import List, Optional, Set, Tuple
+
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.support.support_utils import get_code_hash
+
+log = logging.getLogger(__name__)
+
+
+class EntryPoint(Enum):
+    POST = 1
+    CALLBACK = 2
+
+
+class DetectionModule:
+    name = "detection module"
+    swc_id = ""
+    description = ""
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks: List[str] = []
+    post_hooks: List[str] = []
+
+    def __init__(self):
+        self.issues: List[Issue] = []
+        self.cache: Set[Tuple[int, str]] = set()
+
+    def reset_module(self) -> None:
+        self.issues = []
+
+    def update_cache(self, issues: Optional[List[Issue]] = None) -> None:
+        issues = issues if issues is not None else self.issues
+        for issue in issues:
+            self.cache.add((issue.address, issue.bytecode_hash))
+
+    def _cache_key(self, state: GlobalState) -> Tuple[int, str]:
+        address = state.get_current_instruction()["address"]
+        code_hash = get_code_hash(state.environment.code.bytecode)
+        return address, code_hash
+
+    def execute(self, target) -> Optional[List[Issue]]:
+        """Entry point called by the engine hook or fire_lasers."""
+        log.debug("entering module %s", type(self).__name__)
+        result = self._execute(target)
+        if result:
+            self.issues.extend(result)
+            self.update_cache(result)
+        return result
+
+    def _execute(self, target) -> Optional[List[Issue]]:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<{type(self).__name__} swc={self.swc_id}>"

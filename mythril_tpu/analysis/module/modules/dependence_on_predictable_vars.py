@@ -1,0 +1,111 @@
+"""PredictableVariables: control flow depends on predictable block values
+(SWC-116 timestamp/number, SWC-120 weak randomness from blockhash/coinbase).
+
+Reference parity: mythril/analysis/module/modules/dependence_on_predictable_vars.py:1-195.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.swc_data import TIMESTAMP_DEPENDENCE, WEAK_RANDOMNESS
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.exceptions import UnsatError
+
+DESCRIPTION = (
+    "Check whether important control flow decisions are influenced by block.coinbase, "
+    "block.gaslimit, block.timestamp or block.number."
+)
+
+PREDICTABLE_OPS = ["COINBASE", "GASLIMIT", "TIMESTAMP", "NUMBER"]
+
+
+class PredictableValueAnnotation:
+    def __init__(self, operation: str, add_constraints=None):
+        self.operation = operation
+        self.add_constraints = add_constraints or []
+
+
+class PredictablePathAnnotation:
+    def __init__(self, operation: str, location: int):
+        self.operation = operation
+        self.location = location
+
+
+class PredictableVariables(DetectionModule):
+    name = "Control flow depends on a predictable environment variable"
+    swc_id = f"{TIMESTAMP_DEPENDENCE}.{WEAK_RANDOMNESS}"
+    description = DESCRIPTION
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["JUMPI", "BLOCKHASH"]
+    post_hooks = ["BLOCKHASH"] + PREDICTABLE_OPS
+
+    def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
+        if self._cache_key(state) in self.cache:
+            return None
+        return self._analyze_state(state)
+
+    def _analyze_state(self, state: GlobalState) -> List[Issue]:
+        opcode = state.get_current_instruction()["opcode"]
+
+        if opcode != "JUMPI":
+            # post hook on a predictable-value op: taint its result
+            if state.mstate.stack:
+                op = {
+                    "COINBASE": "block.coinbase",
+                    "GASLIMIT": "block.gaslimit",
+                    "TIMESTAMP": "block.timestamp",
+                    "NUMBER": "block.number",
+                    "BLOCKHASH": "blockhash",
+                }.get(opcode, opcode.lower())
+                state.mstate.stack[-1].annotate(PredictableValueAnnotation(op))
+            return []
+
+        condition = state.mstate.stack[-2]
+        annotations = [
+            a for a in condition.annotations if isinstance(a, PredictableValueAnnotation)
+        ]
+        if not annotations:
+            return []
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints.get_all_constraints()
+            )
+        except UnsatError:
+            return []
+        operation = annotations[0].operation
+        swc_id = (
+            WEAK_RANDOMNESS
+            if operation in ("block.coinbase", "blockhash")
+            else TIMESTAMP_DEPENDENCE
+        )
+        return [
+            Issue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.node.function_name if state.node else "unknown",
+                address=state.get_current_instruction()["address"],
+                swc_id=swc_id,
+                title="Dependence on predictable environment variable",
+                severity="Low",
+                bytecode=state.environment.code.bytecode,
+                description_head=f"A control flow decision is made based on {operation}.",
+                description_tail=(
+                    f"The {operation} environment variable is used to determine a "
+                    "control flow decision. Note that the values of variables like "
+                    "coinbase, gaslimit, block number and timestamp are predictable "
+                    "and can be manipulated by a malicious miner. Also keep in mind "
+                    "that attackers know hashes of earlier blocks. Don't use any of "
+                    "those environment variables as sources of randomness and be "
+                    "aware that use of these variables introduces a certain level "
+                    "of trust into miners."
+                ),
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                transaction_sequence=transaction_sequence,
+            )
+        ]
+
+
+detector = PredictableVariables

@@ -1,0 +1,66 @@
+"""Exceptions: reachable assert-fail / INVALID opcode (SWC-110).
+
+Reference parity: mythril/analysis/module/modules/exceptions.py:1-136.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.exceptions import UnsatError
+
+DESCRIPTION = """
+Checks whether any exception states are reachable.
+"""
+
+
+class Exceptions(DetectionModule):
+    name = "Assertion violation"
+    swc_id = ASSERT_VIOLATION
+    description = DESCRIPTION
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["INVALID"]
+
+    def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
+        if self._cache_key(state) in self.cache:
+            return None
+        return self._analyze_state(state)
+
+    def _analyze_state(self, state: GlobalState) -> List[Issue]:
+        # solve immediately: the INVALID halts this path exceptionally, so a
+        # deferred (tx-end) check would never fire for it
+        instruction = state.get_current_instruction()
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints.get_all_constraints()
+            )
+        except UnsatError:
+            return []
+        return [
+            Issue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.node.function_name if state.node else "unknown",
+                address=instruction["address"],
+                swc_id=ASSERT_VIOLATION,
+                title="Exception State",
+                severity="Medium",
+                bytecode=state.environment.code.bytecode,
+                description_head="An assertion violation was triggered.",
+                description_tail=(
+                    "It is possible to trigger an assertion violation. Note that "
+                    "Solidity assert() statements should only be used to check "
+                    "invariants. Review the transaction sequence to see if this "
+                    "condition can be triggered by user input."
+                ),
+                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                transaction_sequence=transaction_sequence,
+            )
+        ]
+
+
+detector = Exceptions

@@ -1,0 +1,114 @@
+"""Deferred issues: modules park constraints, the engine solves once per tx end.
+
+Reference parity: mythril/analysis/potential_issues.py:82-126 — modules create
+PotentialIssue records (no model yet) on a state annotation;
+check_potential_issues solves each at transaction end, converting the solvable
+ones into confirmed Issues with concrete transaction sequences.  The
+annotation's search_importance (10 x #issues) steers beam search (:61-62).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.core.state.annotation import StateAnnotation
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.exceptions import UnsatError
+
+log = logging.getLogger(__name__)
+
+
+class PotentialIssue:
+    def __init__(
+        self,
+        contract: str,
+        function_name: str,
+        address: int,
+        swc_id: str,
+        title: str,
+        bytecode,
+        detector,
+        severity: str = "Medium",
+        description_head: str = "",
+        description_tail: str = "",
+        constraints=None,
+    ):
+        self.contract = contract
+        self.function_name = function_name
+        self.address = address
+        self.swc_id = swc_id
+        self.title = title
+        self.bytecode = bytecode
+        self.severity = severity
+        self.description_head = description_head
+        self.description_tail = description_tail
+        self.detector = detector
+        self.constraints = constraints or []
+
+
+class PotentialIssuesAnnotation(StateAnnotation):
+    def __init__(self):
+        self.potential_issues: List[PotentialIssue] = []
+
+    @property
+    def search_importance(self) -> int:
+        return 10 * len(self.potential_issues)
+
+    def __copy__(self):
+        # shared across forks on purpose: issues park once per program point
+        return self
+
+
+def get_potential_issues_annotation(global_state: GlobalState) -> PotentialIssuesAnnotation:
+    for annotation in global_state.get_annotations(PotentialIssuesAnnotation):
+        return annotation
+    annotation = PotentialIssuesAnnotation()
+    global_state.annotate(annotation)
+    return annotation
+
+
+def check_potential_issues(global_state: GlobalState) -> None:
+    """Called by the engine at outermost transaction end (svm counterpart of
+    reference svm.py:423)."""
+    annotation = get_potential_issues_annotation(global_state)
+    unsolved: List[PotentialIssue] = []
+    for potential_issue in annotation.potential_issues:
+        try:
+            transaction_sequence = get_transaction_sequence(
+                global_state,
+                global_state.world_state.constraints + potential_issue.constraints,
+            )
+        except UnsatError:
+            unsolved.append(potential_issue)
+            continue
+        potential_issue.detector.cache.add(
+            (potential_issue.address, get_bytecode_hash(potential_issue.bytecode))
+        )
+        potential_issue.detector.issues.append(
+            Issue(
+                contract=potential_issue.contract,
+                function_name=potential_issue.function_name,
+                address=potential_issue.address,
+                title=potential_issue.title,
+                bytecode=potential_issue.bytecode,
+                swc_id=potential_issue.swc_id,
+                gas_used=(
+                    global_state.mstate.min_gas_used,
+                    global_state.mstate.max_gas_used,
+                ),
+                description_head=potential_issue.description_head,
+                description_tail=potential_issue.description_tail,
+                severity=potential_issue.severity,
+                transaction_sequence=transaction_sequence,
+            )
+        )
+    annotation.potential_issues = unsolved
+
+
+def get_bytecode_hash(bytecode) -> str:
+    from mythril_tpu.support.support_utils import get_code_hash
+
+    return get_code_hash(bytecode) if bytecode is not None else ""

@@ -1,0 +1,65 @@
+"""Concolic driver: replay a concrete input, then flip requested branches.
+
+Reference parity: mythril/concolic/concolic_execution.py:22-85.
+"""
+
+from __future__ import annotations
+
+import binascii
+import json
+from datetime import datetime, timedelta
+from typing import Dict, List
+
+from mythril_tpu.concolic.concrete_data import ConcreteData
+from mythril_tpu.concolic.find_trace import concrete_execution, setup_concrete_initial_state
+from mythril_tpu.core.strategy.concolic import ConcolicStrategy
+from mythril_tpu.core.svm import LaserEVM
+from mythril_tpu.core.transaction import symbolic as sym_tx
+from mythril_tpu.core.transaction.transaction_models import tx_id_manager
+
+
+def flip_branches(
+    init_state, concrete_data: ConcreteData, jump_addresses: List[int], trace: List
+) -> List[Dict]:
+    """Re-execute symbolically along the trace, flipping requested JUMPIs."""
+    tx_id_manager.restart_counter()
+    output_list = []
+    laser_evm = LaserEVM(
+        execution_timeout=600,
+        transaction_count=len(concrete_data["steps"]),
+        requires_statespace=False,
+    )
+    laser_evm.open_states = [init_state]
+    laser_evm.strategy = ConcolicStrategy(
+        work_list=laser_evm.work_list,
+        max_depth=128,
+        trace=trace,
+        flip_branch_addresses=jump_addresses,
+    )
+
+    from mythril_tpu.support.time_handler import time_handler
+
+    time_handler.start_execution(laser_evm.execution_timeout)
+
+    for transaction in concrete_data["steps"]:
+        sym_tx.execute_message_call(
+            laser_evm, int(transaction["address"], 16)
+        )
+
+    if isinstance(laser_evm.strategy, ConcolicStrategy):
+        for addr, result in laser_evm.strategy.results.items():
+            if result:
+                output_list.append(result)
+    return output_list
+
+
+def concolic_execution(
+    concrete_data: ConcreteData, jump_addresses: List[int], solver_timeout: int = 100000
+) -> List[Dict]:
+    """Main entry (reference :67-85): returns new concrete inputs, one per
+    flipped branch."""
+    from mythril_tpu.support.support_args import args
+
+    args.solver_timeout = solver_timeout
+    init_state, trace = concrete_execution(concrete_data)
+    return flip_branches(init_state, concrete_data, jump_addresses, trace)

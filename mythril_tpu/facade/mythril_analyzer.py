@@ -1,0 +1,149 @@
+"""Analyzer facade: run symbolic execution + detectors per contract.
+
+Reference parity: mythril/mythril/mythril_analyzer.py:27-189 — copies CLI
+args into the global flag object, runs fire_lasers per contract with graceful
+degradation to partial results, and offers statespace/graph dumps.
+"""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from mythril_tpu.analysis.report import Issue, Report
+from mythril_tpu.analysis.security import fire_lasers, retrieve_callback_issues
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.smt.solver import SolverStatistics
+from mythril_tpu.support.support_args import args
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class AnalyzerArgs:
+    strategy: str = "dfs"
+    max_depth: int = 128
+    execution_timeout: int = 86400
+    create_timeout: int = 10
+    loop_bound: int = 3
+    call_depth_limit: int = 3
+    transaction_count: int = 2
+    modules: Optional[List[str]] = None
+    disable_dependency_pruning: bool = False
+    solver_timeout: int = 10000
+    unconstrained_storage: bool = False
+    sparse_pruning: bool = False
+    parallel_solving: bool = False
+    solver_log: Optional[str] = None
+    enable_iprof: bool = False
+    enable_coverage_strategy: bool = False
+    custom_modules_directory: str = ""
+
+
+class MythrilAnalyzer:
+    def __init__(
+        self,
+        disassembler,
+        cmd_args: AnalyzerArgs,
+        strategy: str = "dfs",
+        address: Optional[str] = None,
+    ):
+        self.eth = disassembler.eth
+        self.contracts = disassembler.contracts or []
+        self.enable_online_lookup = disassembler.enable_online_lookup
+        self.strategy = strategy or cmd_args.strategy
+        self.address = address
+        self.cmd_args = cmd_args
+
+        # anchor issue discovery timestamps before any analysis starts
+        from mythril_tpu.analysis.report import StartTime
+
+        StartTime()
+
+        # propagate flags to the global args object (reference :63-70)
+        args.solver_timeout = cmd_args.solver_timeout
+        args.execution_timeout = cmd_args.execution_timeout
+        args.create_timeout = cmd_args.create_timeout
+        args.max_depth = cmd_args.max_depth
+        args.call_depth_limit = cmd_args.call_depth_limit
+        args.loop_bound = cmd_args.loop_bound
+        args.transaction_count = cmd_args.transaction_count
+        args.unconstrained_storage = cmd_args.unconstrained_storage
+        args.sparse_pruning = cmd_args.sparse_pruning
+        args.parallel_solving = cmd_args.parallel_solving
+        args.solver_log = cmd_args.solver_log
+
+    def _sym_exec(self, contract, run_analysis_modules: bool = True) -> SymExecWrapper:
+        from mythril_tpu.support.loader import DynLoader
+
+        dynloader = DynLoader(self.eth, active=self.eth is not None)
+        return SymExecWrapper(
+            contract,
+            self.address or "0x" + "0" * 38 + "06",
+            strategy=self.strategy,
+            dynloader=dynloader,
+            max_depth=self.cmd_args.max_depth,
+            execution_timeout=self.cmd_args.execution_timeout,
+            create_timeout=self.cmd_args.create_timeout,
+            loop_bound=self.cmd_args.loop_bound,
+            transaction_count=self.cmd_args.transaction_count,
+            modules=self.cmd_args.modules,
+            disable_dependency_pruning=self.cmd_args.disable_dependency_pruning,
+            run_analysis_modules=run_analysis_modules,
+            enable_coverage_strategy=self.cmd_args.enable_coverage_strategy,
+            custom_modules_directory=self.cmd_args.custom_modules_directory,
+        )
+
+    def dump_statespace(self, contract=None) -> str:
+        import json
+
+        from mythril_tpu.analysis.traceexplore import get_serializable_statespace
+
+        sym = self._sym_exec(
+            contract or self.contracts[0], run_analysis_modules=False
+        )
+        return json.dumps(get_serializable_statespace(sym))
+
+    def graph_html(
+        self, contract=None, enable_physics: bool = False, phrackify: bool = False
+    ) -> str:
+        from mythril_tpu.analysis.callgraph import generate_graph
+
+        sym = self._sym_exec(
+            contract or self.contracts[0], run_analysis_modules=False
+        )
+        return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
+
+    def fire_lasers(self, modules: Optional[List[str]] = None) -> Report:
+        stats = SolverStatistics()
+        stats.enabled = True
+        all_issues: List[Issue] = []
+        exceptions = []
+        for contract in self.contracts:
+            try:
+                sym = self._sym_exec(contract)
+                issues = fire_lasers(sym, modules or self.cmd_args.modules)
+            except KeyboardInterrupt:
+                log.critical("keyboard interrupt: saving partial results")
+                issues = retrieve_callback_issues(modules or self.cmd_args.modules)
+            except Exception:  # noqa: BLE001 - graceful degradation to partial results
+                log.exception("exception during analysis; saving partial results")
+                issues = retrieve_callback_issues(modules or self.cmd_args.modules)
+                exceptions.append(traceback.format_exc())
+            for issue in issues:
+                issue.add_code_info(contract)
+                issue.resolve_function_name(
+                    __import__(
+                        "mythril_tpu.support.signatures", fromlist=["SignatureDB"]
+                    ).SignatureDB()
+                )
+            log.info("solver statistics: %s", stats)
+            all_issues += issues
+
+        source_data = self.contracts
+        report = Report(contracts=source_data, exceptions=exceptions)
+        for issue in all_issues:
+            report.append_issue(issue)
+        return report

@@ -1,0 +1,429 @@
+"""myth-tpu command line interface.
+
+Reference parity: mythril/interfaces/cli.py:236-935 — subcommands analyze (a),
+disassemble (d), safe-functions, concolic, list-detectors, read-storage,
+function-to-hash, hash-to-address, version, help; the ~30 analysis flags; and
+the execute_command dispatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+from mythril_tpu import __version__
+from mythril_tpu.exceptions import CriticalError
+
+log = logging.getLogger(__name__)
+
+COMMAND_ALIASES = {"a": "analyze", "d": "disassemble", "c": "concolic"}
+
+
+def exit_with_error(format_: str, message: str) -> None:
+    if format_ in ("text", "markdown"):
+        log.error(message)
+    else:
+        result = {"success": False, "error": str(message), "issues": []}
+        print(json.dumps(result))
+    sys.exit(1)
+
+
+# ---------------------------------------------------------------------------
+# parser construction
+# ---------------------------------------------------------------------------
+
+
+def _add_verbosity(parser) -> None:
+    parser.add_argument(
+        "-v", type=int, default=2, metavar="LOG_LEVEL", help="log level (0-5)"
+    )
+
+
+def _add_rpc_options(parser) -> None:
+    group = parser.add_argument_group("RPC options")
+    group.add_argument("--rpc", help="custom RPC settings (host:port, ganache, infura-<net>)")
+    group.add_argument("--rpctls", type=bool, default=False, help="RPC connection over TLS")
+    group.add_argument("--infura-id", help="infura project id")
+
+
+def _add_input_options(parser) -> None:
+    parser.add_argument("solidity_files", nargs="*", help="solidity smart contract files")
+    parser.add_argument("-c", "--code", metavar="BYTECODE", help="hex-encoded creation bytecode")
+    parser.add_argument(
+        "-f", "--codefile", metavar="BYTECODEFILE", help="file containing hex-encoded bytecode"
+    )
+    parser.add_argument("-a", "--address", metavar="ADDRESS", help="contract address on chain")
+    parser.add_argument("--bin-runtime", action="store_true", help="input is runtime (deployed) code")
+    parser.add_argument("--solc-json", help="solc standard-json settings file")
+    parser.add_argument("--solv", metavar="SOLC_VERSION", help="solc version to use")
+
+
+def _add_analysis_options(parser) -> None:
+    group = parser.add_argument_group("analysis options")
+    group.add_argument(
+        "-m", "--modules", metavar="MODULES", help="comma-separated detection modules"
+    )
+    group.add_argument("--max-depth", type=int, default=128, help="max instruction depth")
+    group.add_argument(
+        "--strategy",
+        choices=["dfs", "bfs", "naive-random", "weighted-random", "beam-search"],
+        default="bfs",
+        help="search strategy",
+    )
+    group.add_argument("--loop-bound", type=int, default=3, help="loop iteration bound")
+    group.add_argument("--call-depth-limit", type=int, default=3, help="message-call depth limit")
+    group.add_argument(
+        "-t", "--transaction-count", type=int, default=2, help="maximum number of transactions"
+    )
+    group.add_argument(
+        "--execution-timeout", type=int, default=86400, help="global timeout (seconds)"
+    )
+    group.add_argument("--create-timeout", type=int, default=10, help="creation tx timeout (seconds)")
+    group.add_argument("--solver-timeout", type=int, default=10000, help="per-query timeout (ms)")
+    group.add_argument("--solver-log", help="directory for solver query dumps")
+    group.add_argument("--parallel-solving", action="store_true", help="batched parallel solving")
+    group.add_argument(
+        "--unconstrained-storage",
+        action="store_true",
+        help="treat all storage as unconstrained symbols",
+    )
+    group.add_argument("--sparse-pruning", action="store_true", help="skip reachability pruning")
+    group.add_argument(
+        "--disable-dependency-pruning", action="store_true", help="disable dependency pruner"
+    )
+    group.add_argument("--enable-iprof", action="store_true", help="instruction profiler")
+    group.add_argument(
+        "--no-onchain-data", action="store_true", help="do not fetch on-chain data via RPC"
+    )
+    group.add_argument(
+        "--enable-coverage-strategy", action="store_true", help="coverage-driven search"
+    )
+    group.add_argument(
+        "--custom-modules-directory", default="", help="directory with additional detection modules"
+    )
+
+
+def _add_output_options(parser) -> None:
+    parser.add_argument(
+        "-o",
+        "--outform",
+        choices=["text", "markdown", "json", "jsonv2"],
+        default="text",
+        help="output format",
+    )
+    parser.add_argument("--graph", metavar="HTML_FILE", help="export call graph HTML")
+    parser.add_argument(
+        "--statespace-json", metavar="JSON_FILE", help="export statespace json"
+    )
+    parser.add_argument("--enable-physics", action="store_true", help="graph physics")
+
+
+def create_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="myth-tpu",
+        description="Security analysis of Ethereum smart contracts (TPU-native build)",
+    )
+    parser.add_argument("--version", action="store_true", help="print version and exit")
+    subparsers = parser.add_subparsers(dest="command")
+
+    analyze = subparsers.add_parser("analyze", aliases=["a"], help="analyze a contract")
+    _add_input_options(analyze)
+    _add_analysis_options(analyze)
+    _add_output_options(analyze)
+    _add_rpc_options(analyze)
+    _add_verbosity(analyze)
+
+    disassemble = subparsers.add_parser(
+        "disassemble", aliases=["d"], help="disassemble a contract"
+    )
+    _add_input_options(disassemble)
+    _add_rpc_options(disassemble)
+    _add_verbosity(disassemble)
+
+    safe = subparsers.add_parser(
+        "safe-functions", help="check functions which are completely safe using symbolic execution"
+    )
+    _add_input_options(safe)
+    _add_analysis_options(safe)
+    _add_rpc_options(safe)
+    _add_verbosity(safe)
+
+    concolic = subparsers.add_parser("concolic", help="concolic execution / branch flipping")
+    concolic.add_argument("input", help="json file with concrete transaction data")
+    concolic.add_argument(
+        "--branches", help="comma-separated branch addresses to flip", required=True
+    )
+    concolic.add_argument("--solver-timeout", type=int, default=100000)
+    _add_verbosity(concolic)
+
+    listd = subparsers.add_parser("list-detectors", help="list available detection modules")
+    _add_output_options(listd)
+
+    reads = subparsers.add_parser("read-storage", help="read storage slots from a contract")
+    reads.add_argument("address", help="contract address")
+    reads.add_argument(
+        "storage_slots", nargs="+", help="position [length] | mapping pos key... | pos len array"
+    )
+    _add_rpc_options(reads)
+
+    f2h = subparsers.add_parser("function-to-hash", help="4-byte selector of a signature")
+    f2h.add_argument("func_name", help="e.g. 'transfer(address,uint256)'")
+
+    h2a = subparsers.add_parser("hash-to-address", help="look up signatures for a selector")
+    h2a.add_argument("hash", help="e.g. 0xa9059cbb")
+
+    subparsers.add_parser("version", help="print version")
+    subparsers.add_parser("help", help="print help")
+    return parser
+
+
+# ---------------------------------------------------------------------------
+# command execution
+# ---------------------------------------------------------------------------
+
+
+def _set_logging(level: int) -> None:
+    levels = {
+        0: logging.NOTSET,
+        1: logging.CRITICAL,
+        2: logging.ERROR,
+        3: logging.WARNING,
+        4: logging.INFO,
+        5: logging.DEBUG,
+    }
+    logging.basicConfig(level=levels.get(level, logging.ERROR))
+
+
+def _load_code(parsed, disassembler) -> Optional[str]:
+    """Load input contracts into the disassembler; returns target address."""
+    address = None
+    try:
+        if parsed.code:
+            address, _ = disassembler.load_from_bytecode(parsed.code, parsed.bin_runtime)
+        elif parsed.codefile:
+            with open(parsed.codefile) as f:
+                code = f.read().strip()
+            address, _ = disassembler.load_from_bytecode(code, parsed.bin_runtime)
+        elif parsed.address:
+            address, _ = disassembler.load_from_address(parsed.address)
+        elif parsed.solidity_files:
+            address, _ = disassembler.load_from_solidity(parsed.solidity_files)
+        else:
+            raise CriticalError(
+                "no input bytecode or Solidity file specified; see --help"
+            )
+    except ValueError as e:
+        raise CriticalError(f"invalid bytecode input: {e}") from e
+    except FileNotFoundError as e:
+        raise CriticalError(str(e)) from e
+    return address
+
+
+def _build_analyzer(parsed, query_signature: bool = False):
+    from mythril_tpu.facade.mythril_analyzer import AnalyzerArgs, MythrilAnalyzer
+    from mythril_tpu.facade.mythril_config import MythrilConfig
+    from mythril_tpu.facade.mythril_disassembler import MythrilDisassembler
+
+    config = MythrilConfig()
+    if getattr(parsed, "infura_id", None):
+        config.infura_id = parsed.infura_id
+    if getattr(parsed, "rpc", None) and not getattr(parsed, "no_onchain_data", False):
+        config.set_api_rpc(parsed.rpc, parsed.rpctls)
+
+    disassembler = MythrilDisassembler(
+        eth=config.eth,
+        solc_version=getattr(parsed, "solv", None),
+        solc_settings_json=getattr(parsed, "solc_json", None),
+    )
+    address = _load_code(parsed, disassembler)
+    modules = (
+        parsed.modules.split(",") if getattr(parsed, "modules", None) else None
+    )
+    cmd_args = AnalyzerArgs(
+        strategy=parsed.strategy,
+        max_depth=parsed.max_depth,
+        execution_timeout=parsed.execution_timeout,
+        create_timeout=parsed.create_timeout,
+        loop_bound=parsed.loop_bound,
+        call_depth_limit=parsed.call_depth_limit,
+        transaction_count=parsed.transaction_count,
+        modules=modules,
+        disable_dependency_pruning=parsed.disable_dependency_pruning,
+        solver_timeout=parsed.solver_timeout,
+        unconstrained_storage=parsed.unconstrained_storage,
+        sparse_pruning=parsed.sparse_pruning,
+        parallel_solving=parsed.parallel_solving,
+        solver_log=parsed.solver_log,
+        enable_iprof=parsed.enable_iprof,
+        enable_coverage_strategy=parsed.enable_coverage_strategy,
+        custom_modules_directory=parsed.custom_modules_directory,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler, cmd_args, strategy=parsed.strategy, address=address
+    )
+    return analyzer
+
+
+def execute_command(parsed) -> None:
+    command = COMMAND_ALIASES.get(parsed.command, parsed.command)
+
+    if command == "version":
+        print(f"myth-tpu v{__version__}")
+        return
+
+    if command == "help":
+        create_parser().print_help()
+        return
+
+    if command == "function-to-hash":
+        from mythril_tpu.support.signatures import selector_of
+
+        print(selector_of(parsed.func_name))
+        return
+
+    if command == "hash-to-address":
+        from mythril_tpu.support.signatures import SignatureDB
+
+        sigs = SignatureDB().get(parsed.hash)
+        for sig in sigs:
+            print(sig)
+        if not sigs:
+            print(f"no signature found for {parsed.hash}")
+        return
+
+    if command == "list-detectors":
+        from mythril_tpu.analysis.module.loader import ModuleLoader
+
+        modules = ModuleLoader().get_detection_modules()
+        if getattr(parsed, "outform", "text") == "json":
+            print(
+                json.dumps(
+                    [
+                        {
+                            "classname": type(m).__name__,
+                            "title": m.name,
+                            "swc_id": m.swc_id,
+                            "description": m.description.strip(),
+                        }
+                        for m in modules
+                    ]
+                )
+            )
+        else:
+            for m in modules:
+                print(f"{type(m).__name__}: {m.name} (SWC-{m.swc_id})")
+        return
+
+    if command == "read-storage":
+        from mythril_tpu.facade.mythril_config import MythrilConfig
+        from mythril_tpu.facade.mythril_disassembler import MythrilDisassembler
+
+        config = MythrilConfig()
+        config.set_api_rpc(parsed.rpc, parsed.rpctls)
+        disassembler = MythrilDisassembler(eth=config.eth)
+        print(
+            disassembler.get_state_variable_from_storage(
+                parsed.address, parsed.storage_slots
+            )
+        )
+        return
+
+    if command == "concolic":
+        with open(parsed.input) as f:
+            concrete_data = json.load(f)
+        from mythril_tpu.concolic.concolic_execution import concolic_execution
+
+        branches = [int(b, 0) for b in parsed.branches.split(",")]
+        results = concolic_execution(concrete_data, branches, parsed.solver_timeout)
+        print(json.dumps(results, indent=2))
+        return
+
+    if command == "disassemble":
+        from mythril_tpu.facade.mythril_config import MythrilConfig
+        from mythril_tpu.facade.mythril_disassembler import MythrilDisassembler
+
+        config = MythrilConfig()
+        if getattr(parsed, "rpc", None):
+            config.set_api_rpc(parsed.rpc, parsed.rpctls)
+        disassembler = MythrilDisassembler(
+            eth=config.eth, solc_version=getattr(parsed, "solv", None)
+        )
+        _load_code(parsed, disassembler)
+        for contract in disassembler.contracts:
+            if contract.disassembly is not None:
+                print(contract.disassembly.get_easm())
+            elif contract.creation_disassembly is not None:
+                print(contract.creation_disassembly.get_easm())
+        return
+
+    if command == "safe-functions":
+        analyzer = _build_analyzer(parsed)
+        parsed_tx_count_backup = parsed.transaction_count
+        analyzer.cmd_args.transaction_count = 1
+        from mythril_tpu.support.support_args import args as global_args
+
+        global_args.unconstrained_storage = True
+        report = analyzer.fire_lasers()
+        issue_functions = {i["function"] for i in report.sorted_issues()}
+        all_functions = set()
+        for contract in analyzer.contracts:
+            dis = contract.disassembly or contract.creation_disassembly
+            if dis:
+                all_functions |= set(dis.function_name_to_address.keys())
+        safe = sorted(all_functions - issue_functions)
+        print(f"{len(safe)} functions found to be safe (no issue found in 1-tx analysis "
+              "with unconstrained storage; probe-based, not a completeness proof):")
+        for fn in safe:
+            print(f"  - {fn}")
+        return
+
+    if command == "analyze":
+        analyzer = _build_analyzer(parsed)
+        if parsed.graph:
+            html = analyzer.graph_html(
+                enable_physics=parsed.enable_physics,
+            )
+            with open(parsed.graph, "w") as f:
+                f.write(html)
+            return
+        if parsed.statespace_json:
+            with open(parsed.statespace_json, "w") as f:
+                f.write(analyzer.dump_statespace())
+            return
+        report = analyzer.fire_lasers()
+        outputs = {
+            "json": report.as_json(),
+            "jsonv2": report.as_swc_standard_format(),
+            "text": report.as_text(),
+            "markdown": report.as_markdown(),
+        }
+        print(outputs[parsed.outform])
+        return
+
+    raise CriticalError(f"unknown command {command}")
+
+
+def main() -> None:
+    parser = create_parser()
+    parsed = parser.parse_args()
+    if parsed.version:
+        print(f"myth-tpu v{__version__}")
+        return
+    if not parsed.command:
+        parser.print_help()
+        return
+    _set_logging(getattr(parsed, "v", 2))
+    from mythril_tpu.exceptions import MythrilBaseException
+
+    try:
+        execute_command(parsed)
+    except MythrilBaseException as e:
+        exit_with_error(getattr(parsed, "outform", "text"), str(e))
+
+
+if __name__ == "__main__":
+    main()

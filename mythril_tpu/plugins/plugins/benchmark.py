@@ -1,0 +1,64 @@
+"""Benchmark plugin: coverage-over-time + executed-instruction counts.
+
+Reference parity: mythril/laser/plugin/plugins/benchmark.py:19-94 (matplotlib
+rendering replaced by a JSON dump — no display in this environment).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import List, Tuple
+
+from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
+
+log = logging.getLogger(__name__)
+
+
+class BenchmarkPlugin(LaserPlugin):
+    def __init__(self, name: str = "benchmark"):
+        self.nr_of_executed_insns = 0
+        self.begin: float = 0.0
+        self.end: float = 0.0
+        self.points: List[Tuple[float, int]] = []
+        self.name = name
+
+    def initialize(self, symbolic_vm) -> None:
+        self.begin = time.time()
+
+        def execute_state_hook(_):
+            self.nr_of_executed_insns += 1
+            self.points.append((time.time() - self.begin, self.nr_of_executed_insns))
+
+        def stop_hook():
+            self.end = time.time()
+            duration = self.end - self.begin
+            rate = self.nr_of_executed_insns / duration if duration > 0 else 0.0
+            log.info(
+                "Benchmark: %d instructions in %.2fs (%.0f/s)",
+                self.nr_of_executed_insns,
+                duration,
+                rate,
+            )
+
+        symbolic_vm.register_laser_hooks("execute_state", execute_state_hook)
+        symbolic_vm.register_laser_hooks("stop_sym_exec", stop_hook)
+
+    def write_to_file(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "executed_instructions": self.nr_of_executed_insns,
+                    "duration": self.end - self.begin,
+                    "series": self.points[:10000],
+                },
+                f,
+            )
+
+
+class BenchmarkPluginBuilder(PluginBuilder):
+    name = "benchmark"
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return BenchmarkPlugin()

@@ -1,0 +1,90 @@
+"""Instruction-coverage plugin + coverage-driven search strategy.
+
+Reference parity: mythril/laser/plugin/plugins/coverage/coverage_plugin.py:47-101
+and coverage_strategy.py:6-41.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Tuple
+
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.core.strategy.basic import BasicSearchStrategy
+from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
+
+log = logging.getLogger(__name__)
+
+
+class InstructionCoverage(LaserPlugin):
+    """Tracks a per-bytecode coverage bitmap via the execute_state hook."""
+
+    def __init__(self):
+        self.coverage: Dict[str, Tuple[int, List[bool]]] = {}
+        self.tx_id = 0
+
+    def initialize(self, symbolic_vm) -> None:
+        self.coverage = {}
+        self.tx_id = 0
+
+        def execute_state_hook(global_state: GlobalState):
+            code = global_state.environment.code.bytecode.hex()
+            if code not in self.coverage:
+                total = len(global_state.environment.code.instruction_list)
+                self.coverage[code] = (total, [False] * max(total, 1))
+            self.coverage[code][1][
+                min(global_state.mstate.pc, len(self.coverage[code][1]) - 1)
+            ] = True
+
+        def stop_sym_exec_hook():
+            for code, (total, seen) in self.coverage.items():
+                covered = sum(seen)
+                pct = 100.0 * covered / total if total else 0.0
+                log.info(
+                    "Achieved %.2f%% coverage for code: %s...",
+                    pct,
+                    code[:40],
+                )
+
+        def start_sym_trans_hook():
+            self.tx_id += 1
+
+        symbolic_vm.register_laser_hooks("execute_state", execute_state_hook)
+        symbolic_vm.register_laser_hooks("stop_sym_exec", stop_sym_exec_hook)
+        symbolic_vm.register_laser_hooks("start_sym_trans", start_sym_trans_hook)
+
+    def get_coverage(self) -> Dict[str, float]:
+        return {
+            code: (100.0 * sum(seen) / total if total else 0.0)
+            for code, (total, seen) in self.coverage.items()
+        }
+
+
+class CoverageStrategy(BasicSearchStrategy):
+    """Prefer states whose pc is not yet covered (reference coverage_strategy.py)."""
+
+    def __init__(self, super_strategy: BasicSearchStrategy, coverage_plugin: InstructionCoverage):
+        self.super_strategy = super_strategy
+        self.coverage_plugin = coverage_plugin
+        super().__init__(super_strategy.work_list, super_strategy.max_depth)
+
+    def get_strategic_global_state(self) -> GlobalState:
+        for i, state in enumerate(self.work_list):
+            if not self._is_covered(state):
+                return self.work_list.pop(i)
+        return self.super_strategy.get_strategic_global_state()
+
+    def _is_covered(self, global_state: GlobalState) -> bool:
+        code = global_state.environment.code.bytecode.hex()
+        if code not in self.coverage_plugin.coverage:
+            return False
+        _, seen = self.coverage_plugin.coverage[code]
+        pc = min(global_state.mstate.pc, len(seen) - 1)
+        return seen[pc]
+
+
+class CoveragePluginBuilder(PluginBuilder):
+    name = "coverage"
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return InstructionCoverage()

@@ -1,0 +1,129 @@
+"""Dependency pruner: skip blocks that can't touch storage written earlier.
+
+Reference parity: mythril/laser/plugin/plugins/dependency_pruner.py:142-318 —
+builds a cross-transaction map of storage locations read per basic block; in
+transaction N >= 2, a path is skipped when the blocks it is about to execute
+cannot read any location written by the previous transactions.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Set
+
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
+from mythril_tpu.plugins.plugin_annotations import (
+    DependencyAnnotation,
+    WSDependencyAnnotation,
+)
+from mythril_tpu.plugins.signals import PluginSkipState
+
+log = logging.getLogger(__name__)
+
+
+def get_dependency_annotation(state: GlobalState) -> DependencyAnnotation:
+    annotations = state.get_annotations(DependencyAnnotation)
+    if annotations:
+        return annotations[0]
+    # inherit from the world state's annotation stack if present
+    ws_annotations = state.world_state.get_annotations(WSDependencyAnnotation)
+    if ws_annotations and ws_annotations[0].annotations_stack:
+        annotation = ws_annotations[0].annotations_stack[-1].__copy__()
+    else:
+        annotation = DependencyAnnotation()
+    state.annotate(annotation)
+    return annotation
+
+
+def get_ws_dependency_annotation(state: GlobalState) -> WSDependencyAnnotation:
+    ws_annotations = state.world_state.get_annotations(WSDependencyAnnotation)
+    if ws_annotations:
+        return ws_annotations[0]
+    annotation = WSDependencyAnnotation()
+    state.world_state.annotate(annotation)
+    return annotation
+
+
+class DependencyPruner(LaserPlugin):
+    def __init__(self):
+        self.sloads_on_path: Dict[int, Set] = {}
+        self.sstores_on_path: Dict[int, Set] = {}
+        self.iteration = 0
+
+    def initialize(self, symbolic_vm) -> None:
+        self.iteration = 0
+
+        def start_sym_trans_hook():
+            self.iteration += 1
+
+        def sload_hook(global_state: GlobalState):
+            annotation = get_dependency_annotation(global_state)
+            index = global_state.mstate.stack[-1]
+            key = index.value if index.value is not None else repr(index.raw)
+            annotation.storage_loaded.add(key)
+            address = global_state.get_current_instruction()["address"]
+            for block in annotation.path:
+                self.sloads_on_path.setdefault(block, set()).add(key)
+
+        def sstore_hook(global_state: GlobalState):
+            annotation = get_dependency_annotation(global_state)
+            index = global_state.mstate.stack[-1]
+            key = index.value if index.value is not None else repr(index.raw)
+            annotation.extend_storage_write_cache(self.iteration, key)
+
+        def call_hook(global_state: GlobalState):
+            annotation = get_dependency_annotation(global_state)
+            annotation.has_call = True
+
+        def jump_hook(global_state: GlobalState):
+            annotation = get_dependency_annotation(global_state)
+            address = global_state.get_current_instruction()["address"]
+            annotation.path.append(address)
+            if self.iteration < 2:
+                return
+            if annotation.has_call:
+                return
+            # would this block possibly read something written before?
+            written = set()
+            for it in range(self.iteration):
+                written |= annotation.storage_written.get(it, set())
+            ws_annotation = get_ws_dependency_annotation(global_state)
+            for dep in ws_annotation.annotations_stack:
+                for it, keys in dep.storage_written.items():
+                    written |= keys
+            reads = self.sloads_on_path.get(address, None)
+            if reads is None:
+                return  # unknown block: explore it
+            symbolic_read = any(isinstance(k, str) for k in reads)
+            symbolic_write = any(isinstance(k, str) for k in written)
+            if symbolic_read or symbolic_write:
+                return
+            if not (reads & written):
+                log.debug("pruning block at %d (no storage dependency)", address)
+                raise PluginSkipState
+
+        def add_world_state_hook(global_state: GlobalState):
+            annotation = get_dependency_annotation(global_state)
+            ws_annotation = get_ws_dependency_annotation(global_state)
+            ws_annotation.annotations_stack.append(annotation)
+
+        symbolic_vm.register_laser_hooks("start_sym_trans", start_sym_trans_hook)
+        symbolic_vm.register_laser_hooks("add_world_state", add_world_state_hook)
+        symbolic_vm.register_hooks(
+            "pre",
+            {
+                "SLOAD": [sload_hook],
+                "SSTORE": [sstore_hook],
+                "CALL": [call_hook],
+                "STATICCALL": [call_hook],
+                "JUMPDEST": [jump_hook],
+            },
+        )
+
+
+class DependencyPrunerBuilder(PluginBuilder):
+    name = "dependency-pruner"
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return DependencyPruner()

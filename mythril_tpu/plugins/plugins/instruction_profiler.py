@@ -1,0 +1,69 @@
+"""Instruction profiler: wall-time per opcode via universal instruction hooks.
+
+Reference parity: mythril/laser/plugin/plugins/instruction_profiler.py:52-115.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Tuple
+
+from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
+
+log = logging.getLogger(__name__)
+
+
+class InstructionProfiler(LaserPlugin):
+    def __init__(self):
+        self.records: Dict[str, Tuple[float, float, float, int]] = {}
+        self._pending: Dict[int, Tuple[str, float]] = {}
+        self._sums = defaultdict(lambda: [0.0, float("inf"), 0.0, 0])
+
+    def initialize(self, symbolic_vm) -> None:
+        def pre_hook(global_state):
+            op = global_state.get_current_instruction()["opcode"]
+            self._pending[id(global_state)] = (op, time.time())
+
+        def post_hook(global_state):
+            key = id(global_state)
+            # post states are copies; attribute the sample to the last pre
+            if not self._pending:
+                return
+            op, t0 = self._pending.popitem()[1]
+            dt = time.time() - t0
+            rec = self._sums[op]
+            rec[0] += dt
+            rec[1] = min(rec[1], dt)
+            rec[2] = max(rec[2], dt)
+            rec[3] += 1
+
+        def stop_hook():
+            report = self.to_string()
+            if report:
+                log.info("Instruction profile:\n%s", report)
+
+        symbolic_vm.register_instr_hooks("pre", None, pre_hook)
+        symbolic_vm.register_instr_hooks("post", None, post_hook)
+        symbolic_vm.register_laser_hooks("stop_sym_exec", stop_hook)
+
+    def to_string(self) -> str:
+        lines = []
+        total = 0.0
+        for op, (s, mn, mx, n) in sorted(
+            self._sums.items(), key=lambda kv: -kv[1][0]
+        ):
+            lines.append(
+                f"[{op:14}] {s:.6f}s total, n={n}, avg={s / n:.6f}, min={mn:.6f}, max={mx:.6f}"
+            )
+            total += s
+        lines.append(f"Total: {total:.6f}s")
+        return "\n".join(lines)
+
+
+class InstructionProfilerBuilder(PluginBuilder):
+    name = "instruction-profiler"
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return InstructionProfiler()

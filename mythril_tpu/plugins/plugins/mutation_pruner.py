@@ -1,0 +1,58 @@
+"""Mutation pruner: drop world states whose transaction changed nothing.
+
+Reference parity: mythril/laser/plugin/plugins/mutation_pruner.py:36-89 —
+SSTORE/CALL/STATICCALL mark the state with MutationAnnotation; at
+add_world_state time, unannotated states with provably-zero callvalue are
+skipped (a "clean" path cannot enable anything in later transactions).
+"""
+
+from __future__ import annotations
+
+from mythril_tpu.core.state.global_state import GlobalState
+from mythril_tpu.core.transaction.transaction_models import ContractCreationTransaction
+from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
+from mythril_tpu.plugins.plugin_annotations import MutationAnnotation
+from mythril_tpu.plugins.signals import PluginSkipWorldState
+from mythril_tpu.smt import UGT, symbol_factory
+from mythril_tpu.smt.solver import ProbeConfig, SAT, solve_conjunction
+
+
+class MutationPruner(LaserPlugin):
+    def initialize(self, symbolic_vm) -> None:
+        def mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        symbolic_vm.register_hooks(
+            "pre",
+            {
+                "SSTORE": [mutator_hook],
+                "CALL": [mutator_hook],
+                "STATICCALL": [mutator_hook],
+                "CREATE": [mutator_hook],
+                "CREATE2": [mutator_hook],
+            },
+        )
+
+        def world_state_filter_hook(global_state: GlobalState):
+            if isinstance(global_state.current_transaction, ContractCreationTransaction):
+                return
+            if global_state.get_annotations(MutationAnnotation):
+                return
+            # no mutation: only keep if the tx could have moved value
+            value = global_state.current_transaction.call_value
+            status, _ = solve_conjunction(
+                global_state.world_state.constraints.get_all_raw()
+                + [UGT(value, symbol_factory.BitVecVal(0, 256)).raw],
+                ProbeConfig(max_rounds=1, candidates_per_round=16, timeout_ms=500),
+            )
+            if status != SAT:
+                raise PluginSkipWorldState
+
+        symbolic_vm.register_laser_hooks("add_world_state", world_state_filter_hook)
+
+
+class MutationPrunerBuilder(PluginBuilder):
+    name = "mutation-pruner"
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return MutationPruner()

@@ -246,119 +246,137 @@ class _Seeder:
                 else:
                     self.link_pairs.append((a, b))
             return
-        # Inequalities: nudge toward the boundary, or zero the small side.
+        # Inequalities: lower bounds push the variable just past the bound;
+        # upper bounds hint zero (weak hints max-combine, so lower bounds win
+        # over the zero default and minimization-style caps stay harmless).
         if t.op in ("ult", "ule", "slt", "sle"):
             a, b = t.args
             if want and a.is_const and not b.is_const:
                 self._propagate_value(b, mask(a.value + 1, b.width), weak=True)
-            elif want and b.is_const and not a.is_const:
-                v = b.value - 1 if t.op in ("ult", "slt") else b.value
-                self._propagate_value(a, mask(v, a.width), weak=True)
-            elif want and t.op in ("ult", "ule"):
-                # a <= b with both symbolic: a = 0 always works for ule and
-                # usually for ult; hint weakly so stronger hints win
+            elif want and not a.is_const:
                 self._propagate_value(a, 0, weak=True)
 
     def _propagate_value(self, t: Term, value: int, weak: bool = False):
         """Push ``t == value`` down into leaves where ops are invertible."""
-        value = mask(value, t.width if terms.is_bv_sort(t.sort) else 1)
+        width = t.width if terms.is_bv_sort(t.sort) else 1
+        self._propagate_bits(t, mask(value, width), (1 << width) - 1, weak)
+
+    def _propagate_bits(self, t: Term, value: int, claim: int, weak: bool):
+        """Propagate ``t & claim == value & claim`` — only bits set in
+        ``claim`` are actually constrained.  Shifts/masks narrow the claim
+        instead of fabricating zero bits (a full-width claim through
+        ``lshr(x, 224) == selector`` would wrongly pin the low 224 bits)."""
+        if claim == 0:
+            return
+        full = (1 << t.width) - 1 if terms.is_bv_sort(t.sort) else 1
+        claim &= full
+        value &= claim
         if t.op == "var":
             if weak:
-                self.weak_vals[t] = max(self.weak_vals.get(t, 0), value)
+                if claim == full:
+                    self.weak_vals[t] = max(self.weak_vals.get(t, 0), value)
             else:
-                self._hint(t).set_bits((1 << t.width) - 1, value)
+                self._hint(t).set_bits(claim, value)
             return
         if t.op == "select":
             arr, idx = t.args
             base = arr
             while base.op == "store":
                 base = base.args[0]
-            if base.op == "array_var" and idx.is_const:
+            if base.op == "array_var" and idx.is_const and claim == full:
                 self.array_hints.setdefault((base, idx.value), value)
             return
         if t.op == "concat":
             hi, lo = t.args
-            self._propagate_value(lo, value & ((1 << lo.width) - 1), weak)
-            self._propagate_value(hi, value >> lo.width, weak)
+            lw = lo.width
+            self._propagate_bits(lo, value, claim, weak)
+            self._propagate_bits(hi, value >> lw, claim >> lw, weak)
             return
         if t.op == "extract":
             hi_bit, lo_bit = t.aux
-            inner = t.args[0]
-            if inner.op == "var":
-                m = (((1 << (hi_bit - lo_bit + 1)) - 1) << lo_bit)
-                if not weak:
-                    self._hint(inner).set_bits(m, value << lo_bit)
-            else:
-                self._propagate_value_masked(inner, value, hi_bit, lo_bit, weak)
+            self._propagate_bits(t.args[0], value << lo_bit, claim << lo_bit, weak)
             return
-        if t.op in ("zext", "sext"):
+        if t.op == "zext":
             inner = t.args[0]
-            if value < (1 << inner.width):
-                self._propagate_value(inner, value, weak)
+            iw = (1 << inner.width) - 1
+            if value & ~iw:
+                return  # impossible: high bits nonzero
+            self._propagate_bits(inner, value, claim & iw, weak)
+            return
+        if t.op == "sext":
+            inner = t.args[0]
+            iw = (1 << inner.width) - 1
+            self._propagate_bits(inner, value & iw, claim & iw, weak)
+            return
+        if t.op == "bvand":
+            a, b = t.args
+            for c, x in ((a, b), (b, a)):
+                if c.is_const:
+                    # bits where the const is 1 pass through; where it is 0 the
+                    # result bit says nothing about x
+                    self._propagate_bits(x, value, claim & c.value, weak)
+                    return
+            return
+        if t.op == "bvor":
+            a, b = t.args
+            for c, x in ((a, b), (b, a)):
+                if c.is_const:
+                    self._propagate_bits(x, value, claim & ~c.value, weak)
+                    return
+            return
+        if t.op == "bvxor":
+            a, b = t.args
+            for c, x in ((a, b), (b, a)):
+                if c.is_const:
+                    self._propagate_bits(x, value ^ (c.value & claim), claim, weak)
+                    return
+            return
+        if t.op == "bvnot":
+            self._propagate_bits(t.args[0], ~value & claim, claim, weak)
+            return
+        if t.op == "bvshl":
+            a, b = t.args
+            if b.is_const:
+                k = min(b.value, t.width)
+                self._propagate_bits(a, value >> k, (claim >> k) & full, weak)
+            return
+        if t.op == "bvlshr":
+            a, b = t.args
+            if b.is_const:
+                k = min(b.value, t.width)
+                self._propagate_bits(a, (value << k) & full, (claim << k) & full, weak)
+            return
+        # arithmetic inversions are only exact on a full claim
+        if claim != full:
             return
         if t.op == "bvadd":
             a, b = t.args
             if a.is_const:
-                self._propagate_value(b, value - a.value, weak)
+                self._propagate_bits(b, mask(value - a.value, t.width), full, weak)
             elif b.is_const:
-                self._propagate_value(a, value - b.value, weak)
+                self._propagate_bits(a, mask(value - b.value, t.width), full, weak)
             return
         if t.op == "bvsub":
             a, b = t.args
             if b.is_const:
-                self._propagate_value(a, value + b.value, weak)
+                self._propagate_bits(a, mask(value + b.value, t.width), full, weak)
             elif a.is_const:
-                self._propagate_value(b, a.value - value, weak)
-            return
-        if t.op == "bvxor":
-            a, b = t.args
-            if a.is_const:
-                self._propagate_value(b, value ^ a.value, weak)
-            elif b.is_const:
-                self._propagate_value(a, value ^ b.value, weak)
-            return
-        if t.op == "bvnot":
-            self._propagate_value(t.args[0], ~value, weak)
+                self._propagate_bits(b, mask(a.value - value, t.width), full, weak)
             return
         if t.op == "bvmul":
             a, b = t.args
             for c, x in ((a, b), (b, a)):
                 if c.is_const and c.value % 2 == 1:
                     inv = pow(c.value, -1, 1 << t.width)
-                    self._propagate_value(x, value * inv, weak)
+                    self._propagate_bits(x, mask(value * inv, t.width), full, weak)
                     return
-            return
-        if t.op == "bvshl":
-            a, b = t.args
-            if b.is_const and value % (1 << min(b.value, t.width)) == 0:
-                self._propagate_value(a, value >> b.value, weak)
-            return
-        if t.op == "bvlshr":
-            a, b = t.args
-            if b.is_const:
-                self._propagate_value(a, value << b.value, weak)
             return
         if t.op == "ite":
             # try to make the then-branch produce the value
             c, a, b = t.args
             self._propagate_bool(c, True)
-            self._propagate_value(a, value, weak=True)
+            self._propagate_bits(a, value, claim, weak=True)
             return
-
-    def _propagate_value_masked(self, t: Term, value: int, hi_bit: int, lo_bit: int, weak: bool):
-        # extract(hi, lo, f(x)) == value: only handle f == concat-of-var chain
-        if t.op == "concat":
-            hi_part, lo_part = t.args
-            if hi_bit < lo_part.width:
-                self._propagate_value_masked(lo_part, value, hi_bit, lo_bit, weak)
-            elif lo_bit >= lo_part.width:
-                self._propagate_value_masked(
-                    hi_part, value, hi_bit - lo_part.width, lo_bit - lo_part.width, weak
-                )
-        elif t.op == "var":
-            m = (((1 << (hi_bit - lo_bit + 1)) - 1) << lo_bit)
-            if not weak:
-                self._hint(t).set_bits(m, value << lo_bit)
 
 
 # ---------------------------------------------------------------------------
