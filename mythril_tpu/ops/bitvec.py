@@ -1,0 +1,436 @@
+"""Batched wide-word bitvector algebra for TPU — the device-side number system.
+
+EVM words are 256-bit; TPUs have no native integer type wider than 32 bits
+(and Pallas kernels cannot use 64-bit at all).  Every bitvector of width ``w``
+is therefore represented as ``ceil(w / 16)`` little-endian 16-bit limbs held
+in a ``uint32`` array, shape ``[..., L]`` with arbitrary leading batch dims.
+16-bit limbs (not 32) are chosen so a full limb product ``a_i * b_j`` fits in
+uint32 and a column of up to 2·L partial products accumulates without
+overflow — multiplication needs no 64-bit intermediate anywhere, which keeps
+the same code valid inside Pallas TPU kernels.
+
+Semantics match the host big-int evaluator exactly
+(``mythril_tpu/smt/concrete_eval.py``): EVM-style division (x/0 == 0,
+truncated signed division), modular exponentiation, shifts that saturate to
+zero (or the sign fill) at ``s >= width``.
+
+Reference counterpart: the 256-bit words the reference keeps as Z3
+``BitVecRef``s (mythril/laser/smt/bitvec.py:25) and evaluates inside native
+Z3; here they are dense tensors so thousands of candidate assignments are
+evaluated per XLA dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def nlimbs(width: int) -> int:
+    return -(-width // LIMB_BITS)
+
+
+def _top_mask(width: int) -> int:
+    """Mask for the most-significant limb (partial when width % 16 != 0)."""
+    r = width % LIMB_BITS
+    return LIMB_MASK if r == 0 else (1 << r) - 1
+
+
+def mask_top(a: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Re-canonicalise: clear bits above ``width`` in the top limb."""
+    tm = _top_mask(width)
+    if tm == LIMB_MASK:
+        return a
+    L = nlimbs(width)
+    m = np.full((L,), LIMB_MASK, np.uint32)
+    m[-1] = tm
+    return a & jnp.asarray(m)
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion (tests, witness extraction)
+# ---------------------------------------------------------------------------
+
+
+def from_ints(values: Union[int, Sequence[int]], width: int) -> np.ndarray:
+    """Python int(s) -> uint32 limb array [L] or [B, L]."""
+    scalar = isinstance(values, int)
+    vals = [values] if scalar else list(values)
+    L = nlimbs(width)
+    out = np.zeros((len(vals), L), np.uint32)
+    for b, v in enumerate(vals):
+        v &= (1 << width) - 1
+        for i in range(L):
+            out[b, i] = (v >> (LIMB_BITS * i)) & LIMB_MASK
+    return out[0] if scalar else out
+
+
+def to_ints(arr, width: int) -> List[int]:
+    """uint32 limb array [..., L] -> list of Python ints (flattened batch)."""
+    a = np.asarray(arr).reshape(-1, nlimbs(width))
+    return [
+        sum(int(a[b, i]) << (LIMB_BITS * i) for i in range(a.shape[1]))
+        for b in range(a.shape[0])
+    ]
+
+
+def zeros(batch_shape, width: int) -> jnp.ndarray:
+    return jnp.zeros((*batch_shape, nlimbs(width)), jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Carry machinery
+# ---------------------------------------------------------------------------
+
+
+def _carry_propagate(cols: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Columns of up-to-uint32 partial sums -> canonical 16-bit limbs.
+
+    Sequential carry chain over L limbs, unrolled at trace time (L <= 32 for
+    every width the EVM needs: 512-bit keccak operands at most).
+    """
+    L = nlimbs(width)
+    out = []
+    carry = jnp.zeros_like(cols[..., 0])
+    for i in range(L):
+        s = cols[..., i] + carry
+        out.append(s & LIMB_MASK)
+        carry = s >> LIMB_BITS
+    return mask_top(jnp.stack(out, axis=-1), width)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray, width: int) -> jnp.ndarray:
+    return _carry_propagate(a + b, width)
+
+
+def not_(a: jnp.ndarray, width: int) -> jnp.ndarray:
+    return mask_top(a ^ LIMB_MASK, width)
+
+
+def neg(a: jnp.ndarray, width: int) -> jnp.ndarray:
+    return _carry_propagate((a ^ LIMB_MASK) + _one_cols(a), width)
+
+
+def _one_cols(like: jnp.ndarray) -> jnp.ndarray:
+    one = jnp.zeros(jnp.shape(like), jnp.uint32)
+    return one.at[..., 0].set(1)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray, width: int) -> jnp.ndarray:
+    return _carry_propagate(a + (b ^ LIMB_MASK) + _one_cols(a), width)
+
+
+def and_(a, b, width):
+    return a & b
+
+
+def or_(a, b, width):
+    return a | b
+
+
+def xor(a, b, width):
+    return a ^ b
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Low ``width`` bits of the product (EVM MUL).  Schoolbook columns with
+    hi/lo split so nothing exceeds uint32: each partial product < 2^32 is
+    split into two 16-bit halves accumulated into adjacent columns; a column
+    then holds < 2·L·2^16 <= 2^22."""
+    L = nlimbs(width)
+    cols = jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape), jnp.uint32)
+    for k in range(L):
+        for i in range(k + 1):
+            p = a[..., i] * b[..., k - i]
+            cols = cols.at[..., k].add(p & LIMB_MASK)
+            if k + 1 < L:
+                cols = cols.at[..., k + 1].add(p >> LIMB_BITS)
+    return _carry_propagate(cols, width)
+
+
+# ---------------------------------------------------------------------------
+# Comparisons -> bool mask over batch dims
+# ---------------------------------------------------------------------------
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+def ult(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic compare from the most-significant limb down."""
+    L = a.shape[-1]
+    lt = jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape)[:-1], bool)
+    gt = jnp.zeros_like(lt)
+    for i in range(L - 1, -1, -1):
+        ai, bi = a[..., i], b[..., i]
+        lt = lt | (~gt & (ai < bi))
+        gt = gt | (~lt & (ai > bi))
+    return lt
+
+
+def ule(a, b):
+    return ~ult(b, a)
+
+
+def _flip_sign(a: jnp.ndarray, width: int) -> jnp.ndarray:
+    """XOR the sign bit so unsigned compare gives signed order."""
+    r = (width - 1) % LIMB_BITS
+    bit = np.uint32(1 << r)
+    a = jnp.asarray(a)
+    return a.at[..., -1].set(a[..., -1] ^ bit)
+
+
+def slt(a, b, width):
+    return ult(_flip_sign(a, width), _flip_sign(b, width))
+
+
+def sle(a, b, width):
+    return ~slt(b, a, width)
+
+
+def sign_bit(a: jnp.ndarray, width: int) -> jnp.ndarray:
+    r = (width - 1) % LIMB_BITS
+    return (a[..., -1] >> r) & 1
+
+
+# ---------------------------------------------------------------------------
+# Shifts (per-batch symbolic amounts)
+# ---------------------------------------------------------------------------
+
+
+def _shift_amount(s: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Limb array -> clamped uint32 scalar shift per batch element.
+
+    Any set bit above 2^32 means s >= width for every realistic width, so the
+    amount saturates to ``width`` (which all shift kernels treat as
+    'shifted out completely')."""
+    big = jnp.zeros(s.shape[:-1], bool)
+    for i in range(2, s.shape[-1]):
+        big = big | (s[..., i] != 0)
+    lo = s[..., 0].astype(jnp.uint32)
+    if s.shape[-1] > 1:
+        lo = lo | (s[..., 1].astype(jnp.uint32) << LIMB_BITS)
+    return jnp.where(big | (lo > width), np.uint32(width), lo)
+
+
+def _take_limb(a: jnp.ndarray, idx: jnp.ndarray, fill: jnp.ndarray) -> jnp.ndarray:
+    """a[..., idx] with out-of-range limbs replaced by ``fill`` (broadcast)."""
+    L = a.shape[-1]
+    valid = (idx >= 0) & (idx < L)
+    got = jnp.take_along_axis(a, jnp.clip(idx, 0, L - 1).astype(jnp.int32), axis=-1)
+    return jnp.where(valid, got, fill)
+
+
+def shl(a: jnp.ndarray, s: jnp.ndarray, width: int) -> jnp.ndarray:
+    """a << s, saturating to 0 at s >= width.  s is a limb array."""
+    L = a.shape[-1]
+    amt = _shift_amount(s, width)[..., None]
+    q = (amt // LIMB_BITS).astype(jnp.int32)
+    r = amt % LIMB_BITS
+    idx = jnp.arange(L, dtype=jnp.int32) - q
+    zero = jnp.zeros(a.shape[:-1] + (1,), jnp.uint32)
+    lo = _take_limb(a, idx, zero)
+    lo1 = _take_limb(a, idx - 1, zero)
+    out = ((lo << r) | (lo1 >> (LIMB_BITS - r))) & LIMB_MASK
+    out = jnp.where(amt >= width, 0, out)
+    return mask_top(out.astype(jnp.uint32), width)
+
+
+def lshr(a: jnp.ndarray, s: jnp.ndarray, width: int) -> jnp.ndarray:
+    L = a.shape[-1]
+    amt = _shift_amount(s, width)[..., None]
+    q = (amt // LIMB_BITS).astype(jnp.int32)
+    r = amt % LIMB_BITS
+    idx = jnp.arange(L, dtype=jnp.int32) + q
+    zero = jnp.zeros(a.shape[:-1] + (1,), jnp.uint32)
+    lo = _take_limb(a, idx, zero)
+    hi = _take_limb(a, idx + 1, zero)
+    out = ((lo >> r) | (hi << (LIMB_BITS - r))) & LIMB_MASK
+    out = jnp.where(amt >= width, 0, out)
+    return out.astype(jnp.uint32)
+
+
+def ashr(a: jnp.ndarray, s: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Arithmetic shift right: lshr plus a sign fill of the vacated bits."""
+    sign = sign_bit(a, width).astype(bool)[..., None]
+    amt = _shift_amount(s, width)[..., None]
+    base = lshr(a, s, width)
+    # fill mask = ones << (width - s)  (s == 0 -> no fill; s >= width -> all)
+    ones = jnp.full_like(a, LIMB_MASK)
+    inv = width - jnp.minimum(amt[..., 0], np.uint32(width))
+    fill = shl(mask_top(ones, width), _u32_to_limbs(inv, width), width)
+    all_ones = mask_top(jnp.full_like(a, LIMB_MASK), width)
+    fill = jnp.where(amt >= width, all_ones, fill)
+    return jnp.where(sign, base | fill, base)
+
+
+def _u32_to_limbs(v: jnp.ndarray, width: int) -> jnp.ndarray:
+    """uint32 scalar [..,] -> limb array [.., L] (value < 2^32)."""
+    L = nlimbs(width)
+    parts = [v & LIMB_MASK, (v >> LIMB_BITS) & LIMB_MASK]
+    while len(parts) < L:
+        parts.append(jnp.zeros_like(v))
+    return jnp.stack(parts[:L], axis=-1).astype(jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Division / remainder (bit-serial restoring; EVM x/0 == 0)
+# ---------------------------------------------------------------------------
+
+
+def _udivmod(a: jnp.ndarray, b: jnp.ndarray, width: int):
+    L = nlimbs(width)
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+
+    def body(i, carry):
+        q, rem = carry
+        bit_pos = width - 1 - i
+        limb_i = bit_pos // LIMB_BITS
+        bit_i = bit_pos % LIMB_BITS
+        idx = jnp.broadcast_to(limb_i.astype(jnp.int32), a.shape[:-1])[..., None]
+        abit = (
+            jnp.take_along_axis(a, idx, axis=-1)[..., 0] >> bit_i.astype(jnp.uint32)
+        ) & 1
+        # rem = (rem << 1) | abit
+        rem2 = jnp.concatenate(
+            [
+                ((rem[..., :1] << 1) & LIMB_MASK) | abit[..., None],
+                ((rem[..., 1:] << 1) & LIMB_MASK) | (rem[..., :-1] >> (LIMB_BITS - 1)),
+            ],
+            axis=-1,
+        )
+        ge = ule(b, rem2)
+        rem3 = jnp.where(ge[..., None], sub(rem2, b, width), rem2)
+        qbit = (jnp.arange(L) == limb_i) * (ge.astype(jnp.uint32)[..., None] << bit_i)
+        return q | qbit.astype(jnp.uint32), rem3
+
+    q0 = jnp.zeros(shape, jnp.uint32)
+    q, rem = jax.lax.fori_loop(0, width, body, (q0, q0))
+    bz = is_zero(b)[..., None]
+    return jnp.where(bz, 0, q), jnp.where(bz, 0, rem)
+
+
+def udiv(a, b, width):
+    return _udivmod(a, b, width)[0]
+
+
+def urem(a, b, width):
+    return _udivmod(a, b, width)[1]
+
+
+def _abs(a, width):
+    s = sign_bit(a, width).astype(bool)[..., None]
+    return jnp.where(s, neg(a, width), a), s[..., 0]
+
+
+def sdiv(a, b, width):
+    """EVM-style truncated signed division; x / 0 == 0."""
+    aa, sa = _abs(a, width)
+    ab, sb = _abs(b, width)
+    q = udiv(aa, ab, width)
+    negq = sa ^ sb
+    return jnp.where(negq[..., None], neg(q, width), q)
+
+
+def srem(a, b, width):
+    """Truncated signed remainder (sign follows the dividend); x % 0 == 0."""
+    aa, sa = _abs(a, width)
+    ab, _ = _abs(b, width)
+    r = urem(aa, ab, width)
+    return jnp.where(sa[..., None], neg(r, width), r)
+
+
+# ---------------------------------------------------------------------------
+# Modular exponentiation (EVM EXP)
+# ---------------------------------------------------------------------------
+
+
+def bvexp(a: jnp.ndarray, e: jnp.ndarray, width: int) -> jnp.ndarray:
+    """a ** e mod 2^width via square-and-multiply over e's bits."""
+    L = nlimbs(width)
+    shape = jnp.broadcast_shapes(a.shape, e.shape)
+    a = jnp.broadcast_to(a, shape)
+    e = jnp.broadcast_to(e, shape)
+    ew = e.shape[-1] * LIMB_BITS
+
+    def body(i, carry):
+        result, base = carry
+        idx = jnp.broadcast_to((i // LIMB_BITS).astype(jnp.int32), e.shape[:-1])[
+            ..., None
+        ]
+        ebit = (
+            jnp.take_along_axis(e, idx, axis=-1)[..., 0]
+            >> (i % LIMB_BITS).astype(jnp.uint32)
+        ) & 1
+        result = jnp.where((ebit == 1)[..., None], mul(result, base, width), result)
+        return result, mul(base, base, width)
+
+    one = jnp.zeros(shape, jnp.uint32).at[..., 0].set(1)
+    result, _ = jax.lax.fori_loop(0, ew, body, (one, a))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Width changes (static offsets — from concat/extract/zext/sext terms)
+# ---------------------------------------------------------------------------
+
+
+def resize(a: jnp.ndarray, from_w: int, to_w: int) -> jnp.ndarray:
+    """Zero-extend or truncate to a new width."""
+    Lf, Lt = nlimbs(from_w), nlimbs(to_w)
+    if Lt <= Lf:
+        return mask_top(a[..., :Lt], to_w)
+    pad = jnp.zeros(a.shape[:-1] + (Lt - Lf,), jnp.uint32)
+    return jnp.concatenate([mask_top(a, from_w), pad], axis=-1)
+
+
+def sext_to(a: jnp.ndarray, from_w: int, to_w: int) -> jnp.ndarray:
+    s = sign_bit(a, from_w).astype(bool)[..., None]
+    low = resize(a, from_w, to_w)
+    ones = mask_top(jnp.full_like(low, LIMB_MASK), to_w)
+    # high mask = ones << from_w
+    shift = from_ints(from_w, 32)
+    shift = jnp.broadcast_to(jnp.asarray(shift), low.shape[:-1] + (2,))
+    high = shl(ones, shift, to_w)
+    return jnp.where(s, low | high, low)
+
+
+def extract_bits(a: jnp.ndarray, hi: int, lo: int, from_w: int) -> jnp.ndarray:
+    """Static [hi:lo] slice (inclusive), result width hi-lo+1."""
+    out_w = hi - lo + 1
+    if lo % LIMB_BITS == 0:
+        return mask_top(
+            resize(a[..., lo // LIMB_BITS :], from_w - lo, out_w), out_w
+        )
+    shift = from_ints(lo, 32)
+    shift = jnp.broadcast_to(jnp.asarray(shift), a.shape[:-1] + (2,))
+    shifted = lshr(a, shift, from_w)
+    return resize(shifted, from_w, out_w)
+
+
+def concat_bits(hi: jnp.ndarray, lo: jnp.ndarray, hi_w: int, lo_w: int) -> jnp.ndarray:
+    """hi ++ lo, result width hi_w + lo_w."""
+    out_w = hi_w + lo_w
+    lo_r = resize(lo, lo_w, out_w)
+    hi_r = resize(hi, hi_w, out_w)
+    shift = from_ints(lo_w, 32)
+    shift = jnp.broadcast_to(jnp.asarray(shift), hi_r.shape[:-1] + (2,))
+    return lo_r | shl(hi_r, shift, out_w)
+
+
+def mux(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-batch select: cond is a bool mask over batch dims."""
+    return jnp.where(cond[..., None], a, b)

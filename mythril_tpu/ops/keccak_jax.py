@@ -1,0 +1,128 @@
+"""Batched keccak-256 on device — exact concrete hashing for the probe solver.
+
+The reference cannot hash symbolically, so it axiomatizes keccak as an
+uninterpreted function with disjoint-interval range constraints
+(mythril/laser/ethereum/function_managers/keccak_function_manager.py:26-34).
+This framework instead evaluates ``keccak`` terms *concretely* for every
+candidate assignment, on device, in batch — hashing thousands of candidate
+preimages per dispatch.  Exactness beats axioms: a probe hit is a real model
+with real hash values, so no post-hoc ``_replace_with_actual_sha`` step
+(reference: mythril/analysis/solver.py:128-164) is ever needed.
+
+Representation: 64-bit keccak lanes as four 16-bit limbs in uint32
+(``[..., 25, 4]`` state), matching ``mythril_tpu/ops/bitvec.py`` — no 64-bit
+integers anywhere, so the same arithmetic is valid inside Pallas TPU kernels.
+Differentially tested against the host implementation
+(mythril_tpu/ops/keccak.py) in tests/ops/test_keccak_jax.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mythril_tpu.ops.bitvec import LIMB_BITS, LIMB_MASK, nlimbs
+from mythril_tpu.ops.keccak import _RC, _ROT
+
+RATE_BYTES = 136  # 1088-bit rate for keccak-256
+
+# Round constants as [24, 4] little-endian 16-bit limbs.
+_RC_LIMBS = np.array(
+    [[(rc >> (16 * i)) & LIMB_MASK for i in range(4)] for rc in _RC], np.uint32
+)
+
+
+def _rotl64(lane: jnp.ndarray, r: int) -> jnp.ndarray:
+    """Rotate a [..., 4]-limb 64-bit lane left by a static amount."""
+    r %= 64
+    q, s = divmod(r, LIMB_BITS)
+    rolled = jnp.roll(lane, q, axis=-1)
+    if s == 0:
+        return rolled
+    prev = jnp.roll(rolled, 1, axis=-1)
+    return ((rolled << s) | (prev >> (LIMB_BITS - s))) & LIMB_MASK
+
+
+def keccak_f1600(state: jnp.ndarray) -> jnp.ndarray:
+    """One permutation of the [..., 25, 4] state (lane index = x + 5*y)."""
+    a = [state[..., i, :] for i in range(25)]
+    for rnd in range(24):
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x + 4) % 5] ^ _rotl64(c[(x + 1) % 5], 1) for x in range(5)]
+        a = [a[i] ^ d[i % 5] for i in range(25)]
+        # rho + pi
+        b = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64(
+                    a[x + 5 * y], _ROT[x][y]
+                )
+        # chi
+        a = [
+            b[x + 5 * y] ^ ((b[(x + 1) % 5 + 5 * y] ^ LIMB_MASK) & b[(x + 2) % 5 + 5 * y])
+            for y in range(5)
+            for x in range(5)
+        ]
+        # iota
+        a[0] = a[0] ^ jnp.asarray(_RC_LIMBS[rnd])
+    return jnp.stack(a, axis=-2)
+
+
+def _gather_bytes(data: jnp.ndarray, width: int) -> list:
+    """Big-endian byte string of a [..., L]-limb bitvector, as a list of
+    [...]-shaped uint32 byte tensors (static index shuffle)."""
+    n = width // 8
+    out = []
+    for j in range(n):  # j = 0 is the most significant byte
+        k = n - 1 - j  # numeric little-endian byte index
+        limb = data[..., k // 2]
+        out.append((limb >> (8 * (k % 2))) & 0xFF)
+    return out
+
+
+def keccak256(data: jnp.ndarray, width: int) -> jnp.ndarray:
+    """keccak-256 of the big-endian byte serialization of a bitvector.
+
+    ``data``: [..., nlimbs(width)] uint32; ``width`` must be a multiple of 8
+    (the term layer guarantees byte-width hash inputs).  Returns [..., 16]
+    limbs (a 256-bit word)."""
+    assert width % 8 == 0, "keccak input must be byte-aligned"
+    msg = _gather_bytes(data, width)
+    n = len(msg)
+    zero = jnp.zeros(jnp.shape(data)[:-1], jnp.uint32)
+    msg = [jnp.broadcast_to(b, zero.shape).astype(jnp.uint32) for b in msg]
+
+    # keccak (pre-NIST) padding: 0x01 ... 0x80 within the last rate block
+    nblocks = n // RATE_BYTES + 1
+    padded = msg + [zero] * (nblocks * RATE_BYTES - n)
+    padded[n] = padded[n] | 0x01
+    padded[nblocks * RATE_BYTES - 1] = padded[nblocks * RATE_BYTES - 1] | 0x80
+
+    state = jnp.zeros(zero.shape + (25, 4), jnp.uint32)
+    for blk in range(nblocks):
+        block = padded[blk * RATE_BYTES : (blk + 1) * RATE_BYTES]
+        # absorb: XOR 17 lanes (8 bytes each, little-endian within the lane)
+        lanes = []
+        for t in range(17):
+            limbs = [
+                block[8 * t + 2 * u] | (block[8 * t + 2 * u + 1] << 8)
+                for u in range(4)
+            ]
+            lanes.append(jnp.stack(limbs, axis=-1))
+        absorb = jnp.stack(lanes, axis=-2)  # [..., 17, 4]
+        state = state.at[..., :17, :].set(state[..., :17, :] ^ absorb)
+        state = keccak_f1600(state)
+
+    # squeeze 32 bytes = lanes 0..3; output word is big-endian bytes
+    out_bytes = []  # big-endian byte list, most significant first
+    for t in range(4):
+        for u in range(8):  # byte u of lane t, little-endian in the lane
+            out_bytes.append((state[..., t, u // 2] >> (8 * (u % 2))) & 0xFF)
+    # out_bytes[0] is the FIRST digest byte = most significant of the word
+    limbs = []
+    for i in range(16):  # little-endian 16-bit limbs of the 256-bit word
+        b_lo = out_bytes[31 - 2 * i]
+        b_hi = out_bytes[31 - (2 * i + 1)]
+        limbs.append(b_lo | (b_hi << 8))
+    return jnp.stack(limbs, axis=-1)

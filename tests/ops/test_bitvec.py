@@ -1,0 +1,186 @@
+"""Differential tests: JAX limb algebra vs exact Python big-int semantics.
+
+Oracle is plain Python int arithmetic with the same EVM conventions the host
+evaluator uses (mythril_tpu/smt/concrete_eval.py): x/0 == 0, truncated signed
+division, shifts saturating at the width.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import bitvec as bb
+from mythril_tpu.smt.terms import mask, to_signed
+
+WIDTHS = [8, 16, 24, 160, 256]
+random.seed(0xC0FFEE)
+
+
+def _samples(width, n=24):
+    edge = [0, 1, 2, (1 << width) - 1, 1 << (width - 1), (1 << (width - 1)) - 1]
+    rnd = [random.getrandbits(width) for _ in range(n - len(edge))]
+    small = [random.getrandbits(min(8, width)) for _ in range(4)]
+    return [mask(v, width) for v in (edge + rnd + small)]
+
+
+def _pairs(width):
+    xs = _samples(width)
+    ys = list(reversed(_samples(width)))
+    return xs, ys
+
+
+def _check_binop(fn_jax, fn_py, width):
+    xs, ys = _pairs(width)
+    a = bb.from_ints(xs, width)
+    b = bb.from_ints(ys, width)
+    got = bb.to_ints(fn_jax(a, b, width), width)
+    want = [mask(fn_py(x, y), width) for x, y in zip(xs, ys)]
+    assert got == want
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_roundtrip(width):
+    xs = _samples(width)
+    assert bb.to_ints(bb.from_ints(xs, width), width) == xs
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_add_sub_mul(width):
+    _check_binop(bb.add, lambda x, y: x + y, width)
+    _check_binop(bb.sub, lambda x, y: x - y, width)
+    _check_binop(bb.mul, lambda x, y: x * y, width)
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_bitwise_neg(width):
+    _check_binop(bb.and_, lambda x, y: x & y, width)
+    _check_binop(bb.or_, lambda x, y: x | y, width)
+    _check_binop(bb.xor, lambda x, y: x ^ y, width)
+    xs = _samples(width)
+    a = bb.from_ints(xs, width)
+    assert bb.to_ints(bb.not_(a, width), width) == [mask(~x, width) for x in xs]
+    assert bb.to_ints(bb.neg(a, width), width) == [mask(-x, width) for x in xs]
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_compares(width):
+    xs, ys = _pairs(width)
+    a, b = bb.from_ints(xs, width), bb.from_ints(ys, width)
+    assert list(np.asarray(bb.eq(a, b))) == [x == y for x, y in zip(xs, ys)]
+    assert list(np.asarray(bb.ult(a, b))) == [x < y for x, y in zip(xs, ys)]
+    assert list(np.asarray(bb.ule(a, b))) == [x <= y for x, y in zip(xs, ys)]
+    assert list(np.asarray(bb.slt(a, b, width))) == [
+        to_signed(x, width) < to_signed(y, width) for x, y in zip(xs, ys)
+    ]
+    assert list(np.asarray(bb.sle(a, b, width))) == [
+        to_signed(x, width) <= to_signed(y, width) for x, y in zip(xs, ys)
+    ]
+
+
+@pytest.mark.parametrize("width", [8, 24, 256])
+def test_shifts(width):
+    xs = _samples(width)
+    shifts = [0, 1, 7, 15, 16, 17, width - 1, width, width + 3, 2 * width, 1 << 100]
+    shifts = [mask(s, width) for s in shifts if s < (1 << width)] + [
+        (1 << width) - 1
+    ]
+    for s in shifts:
+        a = bb.from_ints(xs, width)
+        sv = bb.from_ints([s] * len(xs), width)
+        want_shl = [mask(x << s, width) if s < width else 0 for x in xs]
+        want_lshr = [x >> s if s < width else 0 for x in xs]
+        want_ashr = [
+            mask(to_signed(x, width) >> min(s, width - 1), width) for x in xs
+        ]
+        assert bb.to_ints(bb.shl(a, sv, width), width) == want_shl, s
+        assert bb.to_ints(bb.lshr(a, sv, width), width) == want_lshr, s
+        assert bb.to_ints(bb.ashr(a, sv, width), width) == want_ashr, s
+
+
+@pytest.mark.parametrize("width", [8, 64, 256])
+def test_divmod(width):
+    xs, ys = _pairs(width)
+    ys = ys[:4] + [0, 1, 2] + ys[4:]
+    xs = xs[:4] + [7, 9, (1 << width) - 3] + xs[4:]
+    xs, ys = xs[: len(ys)], ys[: len(xs)]
+    a, b = bb.from_ints(xs, width), bb.from_ints(ys, width)
+    assert bb.to_ints(bb.udiv(a, b, width), width) == [
+        0 if y == 0 else x // y for x, y in zip(xs, ys)
+    ]
+    assert bb.to_ints(bb.urem(a, b, width), width) == [
+        0 if y == 0 else x % y for x, y in zip(xs, ys)
+    ]
+
+    def py_sdiv(x, y):
+        if y == 0:
+            return 0
+        sx, sy = to_signed(x, width), to_signed(y, width)
+        q = abs(sx) // abs(sy)
+        return -q if (sx < 0) != (sy < 0) else q
+
+    def py_srem(x, y):
+        if y == 0:
+            return 0
+        sx, sy = to_signed(x, width), to_signed(y, width)
+        r = abs(sx) % abs(sy)
+        return -r if sx < 0 else r
+
+    assert bb.to_ints(bb.sdiv(a, b, width), width) == [
+        mask(py_sdiv(x, y), width) for x, y in zip(xs, ys)
+    ]
+    assert bb.to_ints(bb.srem(a, b, width), width) == [
+        mask(py_srem(x, y), width) for x, y in zip(xs, ys)
+    ]
+
+
+@pytest.mark.parametrize("width", [8, 64, 256])
+def test_exp(width):
+    xs = [0, 1, 2, 3, 10, 255, (1 << width) - 1]
+    es = [0, 1, 2, 3, 17, width, (1 << width) - 1]
+    pairs = [(x, e) for x in xs for e in es]
+    a = bb.from_ints([p[0] for p in pairs], width)
+    e = bb.from_ints([p[1] for p in pairs], width)
+    assert bb.to_ints(bb.bvexp(a, e, width), width) == [
+        pow(x, ev, 1 << width) for x, ev in pairs
+    ]
+
+
+def test_resize_sext_extract_concat():
+    xs = _samples(256, 12)
+    a = bb.from_ints(xs, 256)
+    # truncate & zero-extend
+    assert bb.to_ints(bb.resize(a, 256, 64), 64) == [mask(x, 64) for x in xs]
+    assert bb.to_ints(bb.resize(bb.from_ints(xs, 256), 256, 512), 512) == xs
+    # sign extend 8 -> 256
+    small = [0, 1, 0x7F, 0x80, 0xFF]
+    s8 = bb.from_ints(small, 8)
+    assert bb.to_ints(bb.sext_to(s8, 8, 256), 256) == [
+        mask(to_signed(v, 8), 256) for v in small
+    ]
+    # extract arbitrary bit ranges
+    for hi, lo in [(255, 0), (255, 248), (7, 0), (131, 4), (40, 33)]:
+        w = hi - lo + 1
+        assert bb.to_ints(bb.extract_bits(a, hi, lo, 256), w) == [
+            (x >> lo) & ((1 << w) - 1) for x in xs
+        ]
+    # concat 256 ++ 256 = 512
+    ys = list(reversed(xs))
+    b = bb.from_ints(ys, 256)
+    assert bb.to_ints(bb.concat_bits(a, b, 256, 256), 512) == [
+        (x << 256) | y for x, y in zip(xs, ys)
+    ]
+    # concat with non-limb-aligned widths
+    c = bb.from_ints([0x5], 3)
+    d = bb.from_ints([0x1F], 5)
+    assert bb.to_ints(bb.concat_bits(c, d, 3, 5), 8) == [(0x5 << 5) | 0x1F]
+
+
+def test_mux_and_sign():
+    xs = [0, 1, 1 << 255, (1 << 256) - 1]
+    a = bb.from_ints(xs, 256)
+    b = bb.from_ints(list(reversed(xs)), 256)
+    cond = np.array([True, False, True, False])
+    got = bb.to_ints(bb.mux(cond, a, b), 256)
+    assert got == [xs[0], xs[2], xs[2], xs[0]]
+    assert list(np.asarray(bb.sign_bit(a, 256))) == [0, 0, 1, 1]
