@@ -67,7 +67,13 @@ class ModuleLoader(metaclass=Singleton):
                     and cls is not DetectionModule
                     and cls.__module__ == mod.__name__
                 ):
-                    if not any(type(m) is cls for m in self._modules):
+                    # dedup by qualified name: exec_module creates a fresh
+                    # class object per load, so identity can never match
+                    key = (cls.__module__, cls.__qualname__)
+                    if not any(
+                        (type(m).__module__, type(m).__qualname__) == key
+                        for m in self._modules
+                    ):
                         self.register_module(cls())
                         log.info("loaded custom detection module %s", cls.__name__)
 

@@ -104,10 +104,54 @@ class IntegerArithmetics(DetectionModule):
         state.mstate.stack[-1].annotate(annotation)
 
     def _handle_exp(self, state: GlobalState) -> None:
-        # exponentiation overflows when base**exp >= 2^256; approximate with
-        # the multiplication predicate on base**(exp-1) * base is costly, so
-        # flag only symbolic exponents (reference uses a similar heuristic cut)
-        return
+        base, exponent = state.mstate.stack[-1], state.mstate.stack[-2]
+        if base.value is not None and exponent.value is not None:
+            return
+        constraint = self._exp_overflow_condition(base, exponent)
+        if constraint is None:
+            return
+        annotation = OverUnderflowAnnotation(state, "exponentiation", constraint)
+        state.mstate.stack[-1].annotate(annotation)
+
+    @staticmethod
+    def _exp_overflow_condition(base: BitVec, exponent: BitVec) -> Optional[Bool]:
+        """base ** exponent >= 2^256, without a symbolic power term.
+
+        One side concrete gives the exact threshold on the other; both
+        symbolic uses a band cover: base >= 2^ceil(256/k) and exponent >= k
+        implies overflow for any band k (sound; bands at ~sqrt(2) spacing
+        keep the miss window small)."""
+        from mythril_tpu.smt import And, Or, UGE, symbol_factory
+
+        def bv(v: int) -> BitVec:
+            return symbol_factory.BitVecVal(v, 256)
+
+        if base.value is not None:
+            b = base.value
+            if b <= 1:
+                return None
+            e, power = 0, 1
+            while power < (1 << 256):
+                power *= b
+                e += 1
+            return UGE(exponent, bv(e))  # smallest e with b**e >= 2^256
+        if exponent.value is not None:
+            e = exponent.value
+            if e == 0:
+                return None
+            if e == 1:
+                return None  # base itself cannot exceed 2^256 - 1
+            if e >= 256:
+                return UGE(base, bv(2))
+            thresh = 2 ** (-(-256 // e))  # smallest b with b**e >= 2^256
+            return UGE(base, bv(thresh))
+        bands = [2, 3, 4, 6, 8, 11, 16, 22, 32, 43, 64, 86, 128, 172, 256]
+        return Or(
+            *[
+                And(UGE(base, bv(2 ** (-(-256 // k)))), UGE(exponent, bv(k)))
+                for k in bands
+            ]
+        )
 
     # -- sinks --------------------------------------------------------------
 

@@ -97,6 +97,8 @@ class SymExecWrapper:
         plugin_loader.load(CoveragePluginBuilder())
         plugin_loader.load(MutationPrunerBuilder())
         plugin_loader.load(CallDepthLimitBuilder())
+        if args.enable_iprof:
+            plugin_loader.load(InstructionProfilerBuilder())
         plugin_loader.add_args("call-depth-limit", call_depth_limit=args.call_depth_limit)
         if not disable_dependency_pruning:
             plugin_loader.load(DependencyPrunerBuilder())

@@ -74,6 +74,7 @@ class MythrilAnalyzer:
         args.sparse_pruning = cmd_args.sparse_pruning
         args.parallel_solving = cmd_args.parallel_solving
         args.solver_log = cmd_args.solver_log
+        args.enable_iprof = cmd_args.enable_iprof
 
     def _sym_exec(self, contract, run_analysis_modules: bool = True) -> SymExecWrapper:
         from mythril_tpu.support.loader import DynLoader
@@ -132,13 +133,12 @@ class MythrilAnalyzer:
                 log.exception("exception during analysis; saving partial results")
                 issues = retrieve_callback_issues(modules or self.cmd_args.modules)
                 exceptions.append(traceback.format_exc())
+            from mythril_tpu.support.signatures import SignatureDB
+
+            sigdb = SignatureDB()
             for issue in issues:
                 issue.add_code_info(contract)
-                issue.resolve_function_name(
-                    __import__(
-                        "mythril_tpu.support.signatures", fromlist=["SignatureDB"]
-                    ).SignatureDB()
-                )
+                issue.resolve_function_name(sigdb)
             log.info("solver statistics: %s", stats)
             all_issues += issues
 

@@ -112,13 +112,17 @@ class MythrilDisassembler:
                         solc_binary=self.solc_binary,
                     )
                 )
-        # solc >= 0.8 has checked arithmetic: disable the integer module
+        # solc >= 0.8 has checked arithmetic: disable the integer module, but
+        # only when EVERY loaded contract is >= 0.8 — the flag is process-wide
+        # and must not leak onto later < 0.8 contracts.
+        pragmas = []
         for contract in contracts:
             source = contract.solidity_files[0].code if contract.solidity_files else ""
             pragma = re.search(r"pragma solidity\s+[^0-9]*0\.([0-9]+)", source)
-            if pragma and int(pragma.group(1)) >= 8:
-                args.use_integer_module = False
-                break
+            if pragma:
+                pragmas.append(int(pragma.group(1)))
+        if pragmas:
+            args.use_integer_module = not all(p >= 8 for p in pragmas)
         self.contracts.extend(contracts)
         return address, contracts
 

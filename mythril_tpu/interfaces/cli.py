@@ -152,7 +152,9 @@ def create_parser() -> argparse.ArgumentParser:
     _add_rpc_options(safe)
     _add_verbosity(safe)
 
-    concolic = subparsers.add_parser("concolic", help="concolic execution / branch flipping")
+    concolic = subparsers.add_parser(
+        "concolic", aliases=["c"], help="concolic execution / branch flipping"
+    )
     concolic.add_argument("input", help="json file with concrete transaction data")
     concolic.add_argument(
         "--branches", help="comma-separated branch addresses to flip", required=True

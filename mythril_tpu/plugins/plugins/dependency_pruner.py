@@ -48,7 +48,6 @@ def get_ws_dependency_annotation(state: GlobalState) -> WSDependencyAnnotation:
 class DependencyPruner(LaserPlugin):
     def __init__(self):
         self.sloads_on_path: Dict[int, Set] = {}
-        self.sstores_on_path: Dict[int, Set] = {}
         self.iteration = 0
 
     def initialize(self, symbolic_vm) -> None:
@@ -62,7 +61,6 @@ class DependencyPruner(LaserPlugin):
             index = global_state.mstate.stack[-1]
             key = index.value if index.value is not None else repr(index.raw)
             annotation.storage_loaded.add(key)
-            address = global_state.get_current_instruction()["address"]
             for block in annotation.path:
                 self.sloads_on_path.setdefault(block, set()).add(key)
 

@@ -8,7 +8,7 @@ from __future__ import annotations
 import logging
 import time
 from collections import defaultdict
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
 
@@ -18,20 +18,23 @@ log = logging.getLogger(__name__)
 class InstructionProfiler(LaserPlugin):
     def __init__(self):
         self.records: Dict[str, Tuple[float, float, float, int]] = {}
-        self._pending: Dict[int, Tuple[str, float]] = {}
+        # the engine executes one instruction at a time, so a single current
+        # sample suffices; post states are copies, so ids cannot pair pre/post
+        self._current: Optional[Tuple[str, float]] = None
         self._sums = defaultdict(lambda: [0.0, float("inf"), 0.0, 0])
 
     def initialize(self, symbolic_vm) -> None:
         def pre_hook(global_state):
             op = global_state.get_current_instruction()["opcode"]
-            self._pending[id(global_state)] = (op, time.time())
+            self._current = (op, time.time())
 
         def post_hook(global_state):
-            key = id(global_state)
-            # post states are copies; attribute the sample to the last pre
-            if not self._pending:
+            # a pre with no post (exception path) is simply overwritten by
+            # the next pre — no leak, no mispairing
+            if self._current is None:
                 return
-            op, t0 = self._pending.popitem()[1]
+            op, t0 = self._current
+            self._current = None
             dt = time.time() - t0
             rec = self._sums[op]
             rec[0] += dt

@@ -30,6 +30,7 @@ class Args:
     solver_log: Optional[str] = None
     use_integer_module: bool = True
     use_attack_as_target: bool = False
+    enable_iprof: bool = False
     # probe solver tuning
     probe_candidates: int = 48
     probe_rounds: int = 4
