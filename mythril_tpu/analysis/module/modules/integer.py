@@ -37,6 +37,20 @@ For every ADD, MUL instruction, check if there's a possible state where op1 + op
 """
 
 
+def _iroot_ceil(n: int, e: int) -> int:
+    """Smallest b with b**e >= n (exact integer e-th root, rounded up)."""
+    if e <= 1 or n <= 1:
+        return n
+    lo, hi = 1, 1 << (-(-n.bit_length() // e) + 1)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if mid**e >= n:
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo
+
+
 class OverUnderflowAnnotation:
     """Attached to a result BitVec: remembers the violating predicate."""
 
@@ -143,12 +157,15 @@ class IntegerArithmetics(DetectionModule):
                 return None  # base itself cannot exceed 2^256 - 1
             if e >= 256:
                 return UGE(base, bv(2))
-            thresh = 2 ** (-(-256 // e))  # smallest b with b**e >= 2^256
+            # smallest b with b**e >= 2^256: integer e-th root of 2^256,
+            # adjusted (2**ceil(256/e) overshoots whenever e does not divide
+            # 256, silently missing a band of real overflows)
+            thresh = _iroot_ceil(1 << 256, e)
             return UGE(base, bv(thresh))
         bands = [2, 3, 4, 6, 8, 11, 16, 22, 32, 43, 64, 86, 128, 172, 256]
         return Or(
             *[
-                And(UGE(base, bv(2 ** (-(-256 // k)))), UGE(exponent, bv(k)))
+                And(UGE(base, bv(_iroot_ceil(1 << 256, k))), UGE(exponent, bv(k)))
                 for k in bands
             ]
         )

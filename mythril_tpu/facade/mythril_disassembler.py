@@ -112,18 +112,19 @@ class MythrilDisassembler:
                         solc_binary=self.solc_binary,
                     )
                 )
-        # solc >= 0.8 has checked arithmetic: disable the integer module, but
-        # only when EVERY loaded contract is >= 0.8 — the flag is process-wide
-        # and must not leak onto later < 0.8 contracts.
-        pragmas = []
-        for contract in contracts:
-            source = contract.solidity_files[0].code if contract.solidity_files else ""
-            pragma = re.search(r"pragma solidity\s+[^0-9]*0\.([0-9]+)", source)
-            if pragma:
-                pragmas.append(int(pragma.group(1)))
-        if pragmas:
-            args.use_integer_module = not all(p >= 8 for p in pragmas)
         self.contracts.extend(contracts)
+        # solc >= 0.8 has checked arithmetic: disable the integer module only
+        # when EVERY contract queued on this disassembler (not just this
+        # call's batch — the analyzer runs them all) provably targets >= 0.8.
+        # A contract without a readable pragma counts as unknown, keeping the
+        # module enabled.
+        pragmas = []
+        for contract in self.contracts:
+            files = getattr(contract, "solidity_files", None)
+            source = files[0].code if files else ""
+            pragma = re.search(r"pragma solidity\s+[^0-9]*0\.([0-9]+)", source)
+            pragmas.append(int(pragma.group(1)) if pragma else 0)
+        args.use_integer_module = not (pragmas and all(p >= 8 for p in pragmas))
         return address, contracts
 
     def get_state_variable_from_storage(self, address: str, params: List[str]) -> str:

@@ -18,6 +18,7 @@ Differentially tested against the host implementation
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -31,6 +32,24 @@ _RC_LIMBS = np.array(
     [[(rc >> (16 * i)) & LIMB_MASK for i in range(4)] for rc in _RC], np.uint32
 )
 
+# Static lane shuffles for one round, flattened over lane index i = x + 5*y.
+# rho+pi: output lane dst = y + 5*((2x+3y)%5) takes input lane x+5y rotated
+# by _ROT[x][y]; chi: out[i] = b[i] ^ (~b[i+1 (mod x)] & b[i+2 (mod x)]).
+_PI_SRC = np.zeros(25, np.int32)
+_PI_ROT = np.zeros(25, np.int32)
+for _x in range(5):
+    for _y in range(5):
+        _PI_SRC[_y + 5 * ((2 * _x + 3 * _y) % 5)] = _x + 5 * _y
+        _PI_ROT[_y + 5 * ((2 * _x + 3 * _y) % 5)] = _ROT[_x][_y] % 64
+_CHI1 = np.array([(i % 5 + 1) % 5 + 5 * (i // 5) for i in range(25)], np.int32)
+_CHI2 = np.array([(i % 5 + 2) % 5 + 5 * (i // 5) for i in range(25)], np.int32)
+_MOD5 = np.arange(25, dtype=np.int32) % 5
+_XM1 = np.array([(x + 4) % 5 for x in range(5)], np.int32)
+_XP1 = np.array([(x + 1) % 5 for x in range(5)], np.int32)
+# Per-lane limb gather for the rho rotations: new[j] = old[(j - q) % 4].
+_ROT_Q, _ROT_S = _PI_ROT // LIMB_BITS, _PI_ROT % LIMB_BITS
+_ROT_JIDX = (np.arange(4)[None, :] - _ROT_Q[:, None]) % 4  # [25, 4]
+
 
 def _rotl64(lane: jnp.ndarray, r: int) -> jnp.ndarray:
     """Rotate a [..., 4]-limb 64-bit lane left by a static amount."""
@@ -43,30 +62,42 @@ def _rotl64(lane: jnp.ndarray, r: int) -> jnp.ndarray:
     return ((rolled << s) | (prev >> (LIMB_BITS - s))) & LIMB_MASK
 
 
+def _rho_rotate(lanes: jnp.ndarray) -> jnp.ndarray:
+    """Rotate each of the 25 [..., 25, 4] lanes by its static rho amount.
+
+    Limb rotation is a static gather; the sub-limb shift uses the limb one
+    below (limbs are < 2^16, so ``prev >> 16`` is 0 exactly when s == 0)."""
+    jidx = jnp.broadcast_to(jnp.asarray(_ROT_JIDX), lanes.shape)
+    rolled = jnp.take_along_axis(lanes, jidx, axis=-1)
+    prev = jnp.take_along_axis(lanes, (jidx - 1) % 4, axis=-1)
+    s = jnp.asarray(_ROT_S[:, None].astype(np.uint32))
+    return ((rolled << s) | (prev >> (LIMB_BITS - s))) & LIMB_MASK
+
+
+def _round(state: jnp.ndarray, rc: jnp.ndarray) -> jnp.ndarray:
+    """One keccak-f round on the [..., 25, 4] state (lane index = x + 5*y)."""
+    s5 = state.reshape(state.shape[:-2] + (5, 5, 4))  # [..., y, x, limb]
+    c = s5[..., 0, :, :]
+    for y in range(1, 5):
+        c = c ^ s5[..., y, :, :]
+    d = jnp.take(c, _XM1, axis=-2) ^ _rotl64(jnp.take(c, _XP1, axis=-2), 1)
+    a = state ^ jnp.take(d, _MOD5, axis=-2)
+    b = _rho_rotate(jnp.take(a, _PI_SRC, axis=-2))
+    chi = b ^ (
+        (jnp.take(b, _CHI1, axis=-2) ^ LIMB_MASK) & jnp.take(b, _CHI2, axis=-2)
+    )
+    return chi.at[..., 0, :].set(chi[..., 0, :] ^ rc)
+
+
 def keccak_f1600(state: jnp.ndarray) -> jnp.ndarray:
-    """One permutation of the [..., 25, 4] state (lane index = x + 5*y)."""
-    a = [state[..., i, :] for i in range(25)]
-    for rnd in range(24):
-        # theta
-        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
-        d = [c[(x + 4) % 5] ^ _rotl64(c[(x + 1) % 5], 1) for x in range(5)]
-        a = [a[i] ^ d[i % 5] for i in range(25)]
-        # rho + pi
-        b = [None] * 25
-        for x in range(5):
-            for y in range(5):
-                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64(
-                    a[x + 5 * y], _ROT[x][y]
-                )
-        # chi
-        a = [
-            b[x + 5 * y] ^ ((b[(x + 1) % 5 + 5 * y] ^ LIMB_MASK) & b[(x + 2) % 5 + 5 * y])
-            for y in range(5)
-            for x in range(5)
-        ]
-        # iota
-        a[0] = a[0] ^ jnp.asarray(_RC_LIMBS[rnd])
-    return jnp.stack(a, axis=-2)
+    """Full 24-round permutation of the [..., 25, 4] state.
+
+    Rounds run under ``lax.scan`` so the compiled graph holds ONE round body —
+    a fully unrolled version takes minutes of XLA compile time."""
+    out, _ = jax.lax.scan(
+        lambda st, rc: (_round(st, rc), None), state, jnp.asarray(_RC_LIMBS)
+    )
+    return out
 
 
 def _gather_bytes(data: jnp.ndarray, width: int) -> list:

@@ -1,0 +1,346 @@
+"""Lower term DAGs to batched JAX evaluators — the device probe path.
+
+The probe solver (mythril_tpu/smt/solver.py) decides satisfiability by
+evaluating a conjunction under many candidate assignments.  The host big-int
+evaluator (mythril_tpu/smt/concrete_eval.py) does one candidate at a time;
+this module compiles the same DAG once into a jitted function that evaluates
+B candidates in a single XLA dispatch, with every 256-bit word held as 16-bit
+limbs (mythril_tpu/ops/bitvec.py) so the arithmetic maps onto TPU vector
+units.  Semantics are bit-exact with concrete_eval — the differential test in
+tests/ops/test_lowering.py is the contract.
+
+Reference counterpart: this plays the role Z3's internal evaluator plays for
+the reference's solver (mythril/laser/smt/solver/solver.py:51-66); there is no
+upstream analogue of batched candidate evaluation, which is the TPU-native
+design win.
+
+Arrays: a `select` over a `store` chain lowers to a mux chain down to the base
+array; a base `array_var` lookup reads a per-candidate finite table
+(idx/val/valid rows + default), exactly the ArrayValue model of concrete_eval.
+Uninterpreted `apply` nodes are not lowerable (rare; host path handles them) —
+compile_conjunction raises LoweringUnsupported and the solver falls back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mythril_tpu.ops import bitvec as bv
+from mythril_tpu.ops.keccak_jax import keccak256
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.terms import Term
+
+
+class LoweringUnsupported(Exception):
+    """DAG contains a node the device evaluator cannot express."""
+
+
+# ---------------------------------------------------------------------------
+# Compiled object
+# ---------------------------------------------------------------------------
+
+
+class CompiledConjunction:
+    """A conjunction compiled to a jitted batched evaluator.
+
+    Call :meth:`evaluate_batch` with a list of Assignments; returns a
+    ``[B, C]`` bool matrix (candidate x conjunct truth).
+    """
+
+    def __init__(
+        self,
+        conjuncts: Sequence[Term],
+        bv_vars: List[Term],
+        bool_vars: List[Term],
+        array_vars: List[Term],
+        fn,
+    ):
+        self.conjuncts = list(conjuncts)
+        self.bv_vars = bv_vars
+        self.bool_vars = bool_vars
+        self.array_vars = array_vars
+        self._fn = fn
+
+    def evaluate_batch(self, assignments) -> np.ndarray:
+        """[B, C] truth matrix for the given candidate assignments."""
+        args = pack_assignments(self, assignments)
+        return np.asarray(self._fn(*args))
+
+
+# ---------------------------------------------------------------------------
+# Compilation
+# ---------------------------------------------------------------------------
+
+_ARRAY_OPS = ("array_var", "const_array", "store")
+
+
+def _collect(conjuncts: Sequence[Term]):
+    """Free variables in deterministic (topo) order + lowerability check."""
+    bv_vars: List[Term] = []
+    bool_vars: List[Term] = []
+    array_vars: List[Term] = []
+    for t in terms.topo_order(conjuncts):
+        if t.op == "apply":
+            raise LoweringUnsupported("uninterpreted function application")
+        if t.op == "var":
+            (bool_vars if t.sort is terms.BOOL else bv_vars).append(t)
+        elif t.op == "array_var":
+            array_vars.append(t)
+    return bv_vars, bool_vars, array_vars
+
+
+def compile_conjunction(conjuncts: Sequence[Term]) -> CompiledConjunction:
+    """Build the jitted batched evaluator for ``And(conjuncts)``.
+
+    The returned function is retraced per distinct input shape signature
+    (batch size, array table sizes); pack_assignments pads table sizes to
+    multiples of 8 to bound retracing.
+    """
+    conjuncts = list(conjuncts)
+    bv_vars, bool_vars, array_vars = _collect(conjuncts)
+
+    def run(scalars, bools, array_tabs):
+        # term tid -> tensor ([B, L] uint32 for bv, [B] bool for bool) or,
+        # for array-sorted terms, a structural representation.
+        val: Dict[int, object] = {}
+        for i, v in enumerate(bv_vars):
+            val[v.tid] = scalars[i]
+        for i, v in enumerate(bool_vars):
+            val[v.tid] = bools[..., i]
+        for i, v in enumerate(array_vars):
+            val[v.tid] = ("base", array_tabs[i], v.sort)
+
+        def select(arr_repr, idx, dom_w, rng_w):
+            kind = arr_repr[0]
+            if kind == "store":
+                _, parent, s_idx, s_val = arr_repr
+                below = select(parent, idx, dom_w, rng_w)
+                return bv.mux(bv.eq(idx, s_idx), s_val, below)
+            if kind == "ite":
+                _, cond, a_repr, b_repr = arr_repr
+                return bv.mux(
+                    cond,
+                    select(a_repr, idx, dom_w, rng_w),
+                    select(b_repr, idx, dom_w, rng_w),
+                )
+            if kind == "const":
+                _, default = arr_repr
+                shape = jnp.broadcast_shapes(
+                    idx.shape[:-1] + (bv.nlimbs(rng_w),), default.shape
+                )
+                return jnp.broadcast_to(default, shape)
+            # base array: finite table + default
+            _, (t_idx, t_val, t_valid, t_default), _sort = arr_repr
+            res = jnp.broadcast_to(
+                t_default, idx.shape[:-1] + (bv.nlimbs(rng_w),)
+            )
+            K = t_idx.shape[-2]
+            for k in range(K):
+                hit = t_valid[..., k] & bv.eq(t_idx[..., k, :], idx)
+                res = bv.mux(hit, t_val[..., k, :], res)
+            return res
+
+        batch_shape = bools.shape[:-1]
+        for t in terms.topo_order(conjuncts):
+            op, a = t.op, t.args
+            if op in ("var", "array_var"):
+                continue
+            val[t.tid] = _lower_node(t, op, a, val, select, batch_shape)
+
+        cols = [val[c.tid] for c in conjuncts]
+        cols = [jnp.broadcast_to(c, bools.shape[:-1]) for c in cols]
+        return jnp.stack(cols, axis=-1)
+
+    fn = jax.jit(run)
+    return CompiledConjunction(conjuncts, bv_vars, bool_vars, array_vars, fn)
+
+
+def _lower_node(t: Term, op: str, a, val, select, batch_shape):
+    w = t.width if terms.is_bv_sort(t.sort) else None
+    if op == "const":
+        # Constants carry the batch dims so every kernel (shifts, division)
+        # sees uniform shapes; XLA folds the broadcast away.
+        if t.sort is terms.BOOL:
+            return jnp.broadcast_to(jnp.asarray(bool(t.aux)), batch_shape)
+        return jnp.broadcast_to(
+            jnp.asarray(bv.from_ints(t.aux, w)), batch_shape + (bv.nlimbs(w),)
+        )
+    if op == "const_array":
+        return ("const", val[a[0].tid])
+    if op == "store":
+        return ("store", val[a[0].tid], val[a[1].tid], val[a[2].tid])
+    if op == "select":
+        arr = a[0]
+        dom_w, rng_w = arr.sort[1], arr.sort[2]
+        return select(val[arr.tid], val[a[1].tid], dom_w, rng_w)
+    if op == "ite":
+        cond = val[a[0].tid]
+        if terms.is_array_sort(t.sort):
+            return ("ite", cond, val[a[1].tid], val[a[2].tid])
+        if t.sort is terms.BOOL:
+            return jnp.where(cond, val[a[1].tid], val[a[2].tid])
+        return bv.mux(cond, val[a[1].tid], val[a[2].tid])
+
+    if op == "bvadd":
+        return bv.add(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvsub":
+        return bv.sub(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvmul":
+        return bv.mul(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvudiv":
+        return bv.udiv(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvsdiv":
+        return bv.sdiv(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvurem":
+        return bv.urem(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvsrem":
+        return bv.srem(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvexp":
+        return bv.bvexp(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvand":
+        return bv.and_(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvor":
+        return bv.or_(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvxor":
+        return bv.xor(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvnot":
+        return bv.not_(val[a[0].tid], w)
+    if op == "bvneg":
+        return bv.neg(val[a[0].tid], w)
+    if op == "bvshl":
+        return bv.shl(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvlshr":
+        return bv.lshr(val[a[0].tid], val[a[1].tid], w)
+    if op == "bvashr":
+        return bv.ashr(val[a[0].tid], val[a[1].tid], w)
+
+    if op == "concat":
+        return bv.concat_bits(
+            val[a[0].tid], val[a[1].tid], a[0].width, a[1].width
+        )
+    if op == "extract":
+        hi, lo = t.aux
+        return bv.extract_bits(val[a[0].tid], hi, lo, a[0].width)
+    if op == "zext":
+        return bv.resize(val[a[0].tid], a[0].width, w)
+    if op == "sext":
+        return bv.sext_to(val[a[0].tid], a[0].width, w)
+
+    if op == "eq":
+        if a[0].sort is terms.BOOL:
+            return val[a[0].tid] == val[a[1].tid]
+        return bv.eq(val[a[0].tid], val[a[1].tid])
+    if op == "ult":
+        return bv.ult(val[a[0].tid], val[a[1].tid])
+    if op == "ule":
+        return bv.ule(val[a[0].tid], val[a[1].tid])
+    if op == "slt":
+        return bv.slt(val[a[0].tid], val[a[1].tid], a[0].width)
+    if op == "sle":
+        return bv.sle(val[a[0].tid], val[a[1].tid], a[0].width)
+
+    if op == "and":
+        out = val[a[0].tid]
+        for x in a[1:]:
+            out = out & val[x.tid]
+        return out
+    if op == "or":
+        out = val[a[0].tid]
+        for x in a[1:]:
+            out = out | val[x.tid]
+        return out
+    if op == "not":
+        return ~val[a[0].tid]
+    if op == "xor":
+        return val[a[0].tid] ^ val[a[1].tid]
+
+    if op == "keccak":
+        return keccak256(val[a[0].tid], a[0].width)
+
+    raise LoweringUnsupported(f"op {op}")
+
+
+# ---------------------------------------------------------------------------
+# Packing candidate assignments into device tensors
+# ---------------------------------------------------------------------------
+
+
+def _round_up(n: int, m: int) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
+def pack_assignments(compiled: CompiledConjunction, assignments) -> tuple:
+    """Assignment objects -> the (scalars, bools, array_tabs) input tuple.
+
+    Array tables take the union of backing keys across the batch per array
+    (padded to a multiple of 8 rows to bound jit retracing); every candidate
+    gets its own value column, defaulting per its ArrayValue.
+    """
+    B = len(assignments)
+    scalars = []
+    for v in compiled.bv_vars:
+        vals = [int(asg.scalars.get(v, 0)) for asg in assignments]
+        scalars.append(jnp.asarray(bv.from_ints(vals, v.width)))
+    bools = np.zeros((B, max(1, len(compiled.bool_vars))), bool)
+    for i, v in enumerate(compiled.bool_vars):
+        for b, asg in enumerate(assignments):
+            bools[b, i] = bool(asg.scalars.get(v, False))
+
+    array_tabs = []
+    for av in compiled.array_vars:
+        dom_w, rng_w = av.sort[1], av.sort[2]
+        keys = sorted(
+            {
+                k
+                for asg in assignments
+                for k in getattr(asg.arrays.get(av), "backing", {})
+            }
+        )
+        K = _round_up(len(keys), 8)
+        Ld, Lr = bv.nlimbs(dom_w), bv.nlimbs(rng_w)
+        idx = np.zeros((B, K, Ld), np.uint32)
+        valn = np.zeros((B, K, Lr), np.uint32)
+        valid = np.zeros((B, K), bool)
+        default = np.zeros((B, Lr), np.uint32)
+        key_rows = bv.from_ints(keys, dom_w) if keys else None
+        for b, asg in enumerate(assignments):
+            arr = asg.arrays.get(av)
+            backing = arr.backing if arr is not None else {}
+            dflt = arr.default if arr is not None else 0
+            default[b] = bv.from_ints(int(dflt), rng_w)
+            for k, key in enumerate(keys):
+                idx[b, k] = key_rows[k]
+                valid[b, k] = True
+                valn[b, k] = bv.from_ints(int(backing.get(key, dflt)), rng_w)
+        array_tabs.append(
+            (
+                jnp.asarray(idx),
+                jnp.asarray(valn),
+                jnp.asarray(valid),
+                jnp.asarray(default),
+            )
+        )
+    return tuple(scalars), jnp.asarray(bools), tuple(array_tabs)
+
+
+# ---------------------------------------------------------------------------
+# Compile cache (terms are interned: tid tuples are stable keys)
+# ---------------------------------------------------------------------------
+
+_CACHE: Dict[tuple, CompiledConjunction] = {}
+_CACHE_CAP = 512
+
+
+def compile_cached(conjuncts: Sequence[Term]) -> CompiledConjunction:
+    key = tuple(c.tid for c in conjuncts)
+    hit = _CACHE.get(key)
+    if hit is None:
+        if len(_CACHE) >= _CACHE_CAP:
+            _CACHE.clear()
+        hit = compile_conjunction(conjuncts)
+        _CACHE[key] = hit
+    return hit

@@ -8,7 +8,7 @@ from __future__ import annotations
 import logging
 import time
 from collections import defaultdict
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
 
@@ -17,7 +17,6 @@ log = logging.getLogger(__name__)
 
 class InstructionProfiler(LaserPlugin):
     def __init__(self):
-        self.records: Dict[str, Tuple[float, float, float, int]] = {}
         # the engine executes one instruction at a time, so a single current
         # sample suffices; post states are copies, so ids cannot pair pre/post
         self._current: Optional[Tuple[str, float]] = None

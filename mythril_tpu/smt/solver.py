@@ -22,6 +22,8 @@ unsat (mythril/support/model.py:60-63).
 
 from __future__ import annotations
 
+import logging
+import os
 import random
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -30,6 +32,9 @@ from mythril_tpu.exceptions import UnsatError
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.concrete_eval import ArrayValue, Assignment, evaluate
 from mythril_tpu.smt.terms import Term, mask
+from mythril_tpu.support.support_args import args as global_args
+
+log = logging.getLogger(__name__)
 
 SAT = "sat"
 UNSAT = "unsat"
@@ -384,6 +389,34 @@ class _Seeder:
 # ---------------------------------------------------------------------------
 
 
+def _device_backend_requested() -> bool:
+    """Whether candidate evaluation should run through the JAX lowering.
+
+    ``args.probe_backend``: "host" never, "jax" always, "auto" only when the
+    process is already pointed at an accelerator platform (checked via env so
+    the decision itself never triggers backend/tunnel initialization).
+    """
+    backend = getattr(global_args, "probe_backend", "auto")
+    if backend == "host":
+        return False
+    if backend == "jax":
+        return True
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    return platforms.startswith(("tpu", "axon"))
+
+
+def _try_compile_device(conjuncts: Sequence[Term]):
+    """Compile for batched device evaluation, or None (unsupported op /
+    lowering failure — the host path handles everything)."""
+    try:
+        from mythril_tpu.ops import lowering
+
+        return lowering.compile_cached(conjuncts)
+    except Exception as e:
+        log.debug("device lowering unavailable for query (%s): %s", type(e).__name__, e)
+        return None
+
+
 class ProbeConfig:
     def __init__(
         self,
@@ -547,21 +580,59 @@ def solve_conjunction(
             break
         candidates.append(build_assignment(fill_iter, i))
 
+    # Device batching only when the deadline still has room: a cache-miss
+    # compile is the dominant cost, and a blown solver_timeout breaks the
+    # engine's wall-clock budgeting.
+    compiled = (
+        _try_compile_device(conjuncts)
+        if _device_backend_requested() and time.time() < deadline
+        else None
+    )
+
     best_asg, best_score = None, -1
-    for asg in candidates:
+    if compiled is not None:
+        # Batched path: every candidate in one XLA dispatch, then host
+        # validation of the winner (exactness belt-and-braces).
+        import numpy as _np
+
         try:
-            vals = evaluate(conjuncts, asg)
-        except NotImplementedError:
-            continue
-        score = sum(1 for c in conjuncts if vals[c])
-        if score == len(conjuncts):
-            stats.probe_hits += 1
-            stats.solver_time += time.time() - t0
-            return SAT, asg
-        if score > best_score:
-            best_score, best_asg = score, asg
-        if time.time() > deadline:
-            break
+            truth = compiled.evaluate_batch(candidates)  # [B, C] bool
+        except Exception as e:
+            log.warning(
+                "device probe evaluation failed, host fallback (%s): %s",
+                type(e).__name__,
+                e,
+            )
+            compiled = None
+        else:
+            scores = truth.sum(axis=1)
+            for b in _np.argsort(-scores, kind="stable"):
+                if scores[b] < len(conjuncts):
+                    break
+                if check_asg(candidates[b]):
+                    stats.probe_hits += 1
+                    stats.solver_time += time.time() - t0
+                    return SAT, candidates[b]
+                if time.time() > deadline:
+                    break
+            if len(candidates):
+                b = int(_np.argmax(scores))
+                best_score, best_asg = int(scores[b]), candidates[b]
+    if compiled is None:
+        for asg in candidates:
+            try:
+                vals = evaluate(conjuncts, asg)
+            except NotImplementedError:
+                continue
+            score = sum(1 for c in conjuncts if vals[c])
+            if score == len(conjuncts):
+                stats.probe_hits += 1
+                stats.solver_time += time.time() - t0
+                return SAT, asg
+            if score > best_score:
+                best_score, best_asg = score, asg
+            if time.time() > deadline:
+                break
 
     # local repair: mutate the best candidate on vars feeding failed conjuncts
     if best_asg is not None and scalar_vars:

@@ -2,13 +2,23 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on virtual CPU devices exactly as the driver's dryrun does.
+
+The environment may pre-register an external TPU backend plugin and pin
+``JAX_PLATFORMS`` to it at interpreter start (sitecustomize), so an env-var
+setdefault is not enough: explicitly override the platform through
+``jax.config`` before any backend is initialized.  This also keeps the suite
+hermetic when the TPU tunnel is unavailable.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

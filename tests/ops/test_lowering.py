@@ -1,0 +1,159 @@
+"""Differential test: JAX lowering must agree bit-exactly with concrete_eval.
+
+This is the contract that keeps the device probe path sound: any model the
+batched evaluator accepts is re-validated on host, but the filter itself must
+be exact or satisfiable candidates would be discarded.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import lowering
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import ArrayValue, Assignment, evaluate
+
+
+def _random_assignments(bv_vars, array_vars, rng, n):
+    out = []
+    for _ in range(n):
+        asg = Assignment()
+        for v in bv_vars:
+            choice = rng.random()
+            if choice < 0.25:
+                asg.scalars[v] = rng.randint(0, 5)
+            elif choice < 0.5:
+                asg.scalars[v] = terms.mask(-rng.randint(1, 5), v.width)
+            else:
+                asg.scalars[v] = rng.getrandbits(v.width)
+        for av in array_vars:
+            backing = {
+                rng.getrandbits(av.sort[1]) % 64: rng.getrandbits(av.sort[2])
+                for _ in range(rng.randint(0, 4))
+            }
+            asg.arrays[av] = ArrayValue(backing, default=rng.getrandbits(8))
+        out.append(asg)
+    return out
+
+
+def _check(conjuncts, assignments):
+    compiled = lowering.compile_conjunction(conjuncts)
+    got = compiled.evaluate_batch(assignments)
+    for b, asg in enumerate(assignments):
+        vals = evaluate(conjuncts, asg)
+        want = [bool(vals[c]) for c in conjuncts]
+        assert list(got[b]) == want, f"candidate {b}: {list(got[b])} != {want}"
+
+
+def test_arithmetic_and_compare_ops():
+    rng = random.Random(7)
+    x = terms.var("x", 256)
+    y = terms.var("y", 256)
+    z = terms.var("z", 64)
+    conjuncts = [
+        terms.eq(terms.add(x, y), terms.const(100, 256)),
+        terms.ult(terms.mul(x, terms.const(3, 256)), y),
+        terms.eq(terms.udiv(x, y), terms.const(2, 256)),
+        terms.eq(terms.sdiv(x, y), terms.const(2, 256)),
+        terms.eq(terms.urem(x, terms.const(7, 256)), terms.const(3, 256)),
+        terms.eq(terms.srem(x, y), terms.sub(x, y)),
+        terms.slt(x, y),
+        terms.sle(terms.neg(x), y),
+        terms.ule(x, terms.bnot(y)),
+        terms.eq(terms.band(x, y), terms.bor(x, y)),
+        terms.eq(terms.bxor(x, y), terms.const(0xFF, 256)),
+        terms.eq(terms.zext(z, 192), x),
+        terms.eq(terms.sext(z, 192), y),
+        terms.eq(terms.bvexp(x, terms.const(3, 256)), y),
+    ]
+    _check(conjuncts, _random_assignments([x, y, z], [], rng, 33))
+
+
+def test_shift_concat_extract_ops():
+    rng = random.Random(11)
+    x = terms.var("x", 256)
+    s = terms.var("s", 256)
+    lo = terms.var("lo", 128)
+    conjuncts = [
+        terms.eq(terms.shl(x, s), terms.lshr(x, s)),
+        terms.eq(terms.ashr(x, s), terms.const(0, 256)),
+        terms.eq(terms.extract(31, 0, x), terms.const(0xAB, 32)),
+        terms.eq(terms.concat2(terms.extract(255, 128, x), lo), x),
+        terms.eq(terms.shl(x, terms.const(300, 256)), terms.const(0, 256)),
+    ]
+    # include boundary shift amounts explicitly
+    asgs = _random_assignments([x, s, lo], [], rng, 17)
+    for amt in (0, 1, 15, 16, 255, 256, 257, 1 << 200):
+        a = Assignment()
+        a.scalars[x] = rng.getrandbits(256)
+        a.scalars[s] = amt
+        a.scalars[lo] = rng.getrandbits(128)
+        asgs.append(a)
+    _check(conjuncts, asgs)
+
+
+def test_bool_ops_and_ite():
+    rng = random.Random(13)
+    x = terms.var("x", 256)
+    y = terms.var("y", 256)
+    p = terms.bool_var("p")
+    q = terms.bool_var("q")
+    conjuncts = [
+        terms.land(p, terms.lnot(q)),
+        terms.lor(terms.eq(x, y), p),
+        terms.lxor(p, q),
+        terms.eq(
+            terms.ite(p, x, y), terms.ite(q, terms.const(1, 256), terms.const(2, 256))
+        ),
+        terms.iff(p, terms.ult(x, y)),
+    ]
+    asgs = _random_assignments([x, y], [], rng, 16)
+    for i, a in enumerate(asgs):
+        a.scalars[p] = bool(i & 1)
+        a.scalars[q] = bool(i & 2)
+    _check(conjuncts, asgs)
+
+
+def test_array_select_store_chains():
+    rng = random.Random(17)
+    arr = terms.array_var("storage", 256, 256)
+    i = terms.var("i", 256)
+    v = terms.var("v", 256)
+    stored = terms.store(arr, terms.const(5, 256), v)
+    stored2 = terms.store(stored, i, terms.const(77, 256))
+    conjuncts = [
+        terms.eq(terms.select(stored2, terms.const(5, 256)), v),
+        terms.eq(terms.select(stored2, i), terms.const(77, 256)),
+        terms.eq(terms.select(arr, i), terms.const(0, 256)),
+        terms.eq(
+            terms.select(terms.const_array(256, 256, terms.const(9, 256)), i),
+            terms.const(9, 256),
+        ),
+    ]
+    asgs = _random_assignments([i, v], [arr], rng, 25)
+    # force some collisions i == 5
+    for a in asgs[::3]:
+        a.scalars[i] = 5
+    _check(conjuncts, asgs)
+
+
+def test_keccak_lowering():
+    rng = random.Random(19)
+    x = terms.var("x", 256)
+    h = terms.keccak(x)
+    conjuncts = [terms.eq(terms.extract(15, 0, h), terms.const(0x1234, 16))]
+    _check(conjuncts, _random_assignments([x], [], rng, 6))
+
+
+def test_apply_raises_unsupported():
+    x = terms.var("x", 256)
+    f = terms.apply_func("power", 256, x)
+    with pytest.raises(lowering.LoweringUnsupported):
+        lowering.compile_conjunction([terms.eq(f, x)])
+
+
+def test_compile_cache_returns_same_object():
+    x = terms.var("x", 256)
+    c = [terms.ult(x, terms.const(10, 256))]
+    assert lowering.compile_cached(c) is lowering.compile_cached(c)

@@ -1,0 +1,64 @@
+"""Solver integration with the batched JAX lowering (forced on CPU).
+
+``args.probe_backend = "jax"`` routes candidate evaluation through
+mythril_tpu/ops/lowering.py; results must be identical in kind to the host
+path (a validated model), including graceful fallback for unlowerable DAGs.
+"""
+
+import pytest
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import evaluate
+from mythril_tpu.smt.solver import SAT, solve_conjunction
+from mythril_tpu.support.support_args import args as global_args
+
+
+@pytest.fixture
+def jax_backend():
+    prev = global_args.probe_backend
+    global_args.probe_backend = "jax"
+    yield
+    global_args.probe_backend = prev
+
+
+def test_device_probe_finds_model(jax_backend):
+    x = terms.var("x", 256)
+    y = terms.var("y", 256)
+    conjuncts = [
+        terms.eq(terms.add(x, y), terms.const(1000, 256)),
+        terms.ult(x, terms.const(10, 256)),
+        terms.ugt(y, terms.const(100, 256)),
+    ]
+    status, asg = solve_conjunction(conjuncts)
+    assert status == SAT
+    vals = evaluate(conjuncts, asg)
+    assert all(vals[c] for c in conjuncts)
+
+
+def test_device_probe_selector_style_constraints(jax_backend):
+    # the realistic hot query: function-selector match + caller alternation
+    calldata = terms.array_var("calldata", 256, 8)
+    word = terms.concat(
+        *[terms.select(calldata, terms.const(i, 256)) for i in range(4)]
+    )
+    caller = terms.var("caller", 256)
+    conjuncts = [
+        terms.eq(word, terms.const(0x41C0E1B5, 32)),
+        terms.lor(
+            terms.eq(caller, terms.const(0xDEADBEEF, 256)),
+            terms.eq(caller, terms.const(0xAFFE, 256)),
+        ),
+    ]
+    status, asg = solve_conjunction(conjuncts)
+    assert status == SAT
+    vals = evaluate(conjuncts, asg)
+    assert all(vals[c] for c in conjuncts)
+
+
+def test_device_probe_falls_back_on_uf(jax_backend):
+    # 'apply' nodes cannot lower; the host path must still answer
+    x = terms.var("x", 256)
+    f = terms.apply_func("oracle", 256, x)
+    conjuncts = [terms.eq(f, terms.const(0, 256)), terms.ult(x, terms.const(5, 256))]
+    status, asg = solve_conjunction(conjuncts)
+    assert status == SAT
