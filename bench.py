@@ -1,0 +1,119 @@
+"""End-to-end benchmark: killbilly-style multi-transaction exploit search.
+
+Workload (mirrors the reference's README headline demo, `myth a killbilly.sol
+-t 3`, and BASELINE.md config #2): a contract whose SELFDESTRUCT is gated on
+a storage flag set by a prior transaction, so the analyzer must chain two
+symbolic transactions (activate() then kill()) and synthesize concrete
+calldata for both.  Recall is asserted — the run only counts if the
+Unprotected-Selfdestruct issue (SWC-106) is actually found with a valid
+2-step transaction sequence.
+
+Metric: explored states per second with the batched device probe
+(`probe_backend="jax"`); ``vs_baseline`` is the speedup over the identical
+run with the host big-int probe (`probe_backend="host"`), the stand-in for
+the reference's CPU solver path — the mounted reference itself cannot run
+here (no z3 wheel in the image; see BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+# activate() selector 0x0a11ce00 -> 0x1e, kill() selector 0x41c0e1b5 -> 0x25
+DISPATCH = (
+    "6000"  # PUSH1 0
+    "35"  # CALLDATALOAD
+    "60e0"  # PUSH1 0xe0
+    "1c"  # SHR
+    "80"  # DUP1
+    "630a11ce00"  # PUSH4 activate()
+    "14"  # EQ
+    "601e"  # PUSH1 0x1e
+    "57"  # JUMPI
+    "6341c0e1b5"  # PUSH4 kill()
+    "14"  # EQ
+    "6025"  # PUSH1 0x25
+    "57"  # JUMPI
+    "60006000fd"  # REVERT(0, 0)
+)
+ACTIVATE = "5b600160005500"  # 0x1e: JUMPDEST; SSTORE(0, 1); STOP
+KILL = (  # 0x25: JUMPDEST; require(storage[0] == 1); SELFDESTRUCT(CALLER)
+    "5b" "600054" "6001" "14" "6034" "57" "60006000fd" "5b" "33" "ff"
+)
+KILLBILLY = DISPATCH + ACTIVATE + KILL
+# constructor: CODECOPY the runtime code to memory and RETURN it
+_L = f"{len(KILLBILLY) // 2:02x}"
+KILLBILLY_CREATION = f"60{_L}600c60003960{_L}6000f3" + KILLBILLY
+
+
+def run_analysis(probe_backend: str):
+    from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.support.support_args import args as global_args
+
+    global_args.probe_backend = probe_backend
+    reset_callback_modules()
+    # the (address, bytecode-hash) issue dedup cache persists across runs in
+    # one process; both configurations must analyze from scratch
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for module in ModuleLoader().get_detection_modules():
+        module.cache.clear()
+    contract = EVMContract(
+        code=KILLBILLY, creation_code=KILLBILLY_CREATION, name="KillBilly"
+    )
+    t0 = time.time()
+    sym = SymExecWrapper(
+        contract,
+        address=0x0901D12E,
+        strategy="bfs",
+        transaction_count=2,
+        execution_timeout=300,
+        modules=["AccidentallyKillable"],
+    )
+    issues = fire_lasers(sym, white_list=["AccidentallyKillable"])
+    wall = time.time() - t0
+    return sym, issues, wall
+
+
+def check_recall(issues) -> None:
+    assert issues, "exploit not found: zero issues"
+    issue = issues[0]
+    assert issue.swc_id == "106", f"wrong SWC id {issue.swc_id}"
+    steps = issue.transaction_sequence["steps"]
+    inputs = [s["input"] for s in steps]
+    assert any(i.startswith("0x0a11ce00") for i in inputs), "missing activate() tx"
+    assert inputs[-1].startswith("0x41c0e1b5"), "final tx is not kill()"
+
+
+def main() -> None:
+    # warm-up + baseline: host big-int probe (the CPU solver path)
+    sym_h, issues_h, wall_h = run_analysis("host")
+    check_recall(issues_h)
+    base_rate = sym_h.laser.total_states / wall_h
+
+    # measured configuration: batched device probe
+    sym_d, issues_d, wall_d = run_analysis("jax")
+    check_recall(issues_d)
+    rate = sym_d.laser.total_states / wall_d
+
+    print(
+        json.dumps(
+            {
+                "metric": "killbilly_2tx_states_per_sec",
+                "value": round(rate, 2),
+                "unit": "states/sec (device probe, exploit recall asserted)",
+                "vs_baseline": round(rate / base_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

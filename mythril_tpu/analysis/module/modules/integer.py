@@ -51,6 +51,13 @@ def _iroot_ceil(n: int, e: int) -> int:
     return lo
 
 
+# overflow bands for fully-symbolic EXP: (exponent, smallest overflowing base)
+_EXP_BANDS = tuple(
+    (k, _iroot_ceil(1 << 256, k))
+    for k in (2, 3, 4, 6, 8, 11, 16, 22, 32, 43, 64, 86, 128, 172, 256)
+)
+
+
 class OverUnderflowAnnotation:
     """Attached to a result BitVec: remembers the violating predicate."""
 
@@ -162,11 +169,10 @@ class IntegerArithmetics(DetectionModule):
             # 256, silently missing a band of real overflows)
             thresh = _iroot_ceil(1 << 256, e)
             return UGE(base, bv(thresh))
-        bands = [2, 3, 4, 6, 8, 11, 16, 22, 32, 43, 64, 86, 128, 172, 256]
         return Or(
             *[
-                And(UGE(base, bv(_iroot_ceil(1 << 256, k))), UGE(exponent, bv(k)))
-                for k in bands
+                And(UGE(base, bv(thresh)), UGE(exponent, bv(k)))
+                for k, thresh in _EXP_BANDS
             ]
         )
 

@@ -68,6 +68,7 @@ class MythrilDisassembler:
                 creation_code=code, name="MAIN", enable_online_lookup=self.enable_online_lookup
             )
         self.contracts.append(contract)
+        self._refresh_integer_module()
         return address, contract
 
     def load_from_address(self, address: str) -> Tuple[str, EVMContract]:
@@ -84,6 +85,7 @@ class MythrilDisassembler:
             code=code[2:], name=address, enable_online_lookup=self.enable_online_lookup
         )
         self.contracts.append(contract)
+        self._refresh_integer_module()
         return address, contract
 
     def load_from_solidity(
@@ -113,11 +115,18 @@ class MythrilDisassembler:
                     )
                 )
         self.contracts.extend(contracts)
-        # solc >= 0.8 has checked arithmetic: disable the integer module only
-        # when EVERY contract queued on this disassembler (not just this
-        # call's batch — the analyzer runs them all) provably targets >= 0.8.
-        # A contract without a readable pragma counts as unknown, keeping the
-        # module enabled.
+        self._refresh_integer_module()
+        return address, contracts
+
+    def _refresh_integer_module(self) -> None:
+        """Re-derive args.use_integer_module over ALL queued contracts.
+
+        solc >= 0.8 has checked arithmetic: disable the integer module only
+        when EVERY contract queued on this disassembler (the analyzer runs
+        them all) provably targets >= 0.8.  A contract without a readable
+        pragma — including raw bytecode and on-chain loads — counts as
+        unknown, keeping the module enabled.
+        """
         pragmas = []
         for contract in self.contracts:
             files = getattr(contract, "solidity_files", None)
@@ -125,7 +134,6 @@ class MythrilDisassembler:
             pragma = re.search(r"pragma solidity\s+[^0-9]*0\.([0-9]+)", source)
             pragmas.append(int(pragma.group(1)) if pragma else 0)
         args.use_integer_module = not (pragmas and all(p >= 8 for p in pragmas))
-        return address, contracts
 
     def get_state_variable_from_storage(self, address: str, params: List[str]) -> str:
         """Read storage slots, incl. mapping/array math (reference :200-318)."""

@@ -64,6 +64,9 @@ class CompiledConjunction:
         self.bool_vars = bool_vars
         self.array_vars = array_vars
         self._fn = fn
+        # The unjitted evaluator: batch-dim polymorphic, safe to re-jit with
+        # explicit shardings (mythril_tpu/parallel) or embed in larger programs.
+        self.raw_fn = getattr(fn, "__wrapped__", fn)
 
     def evaluate_batch(self, assignments) -> np.ndarray:
         """[B, C] truth matrix for the given candidate assignments."""

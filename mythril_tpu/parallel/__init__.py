@@ -1,0 +1,31 @@
+"""Device-mesh parallelism for the probe solver and frontier search.
+
+The reference is a single-threaded CPU tool (SURVEY.md §2.8); its only
+parallelism is Z3-internal.  Here, scaling is an explicit subsystem built the
+TPU way: a 2-D ``jax.sharding.Mesh`` over which the probe workload is SPMD —
+independent frontier paths shard over the ``path`` axis (data parallelism)
+and the candidate-assignment batch of each path shards over the ``cand``
+axis; XLA inserts the ICI collectives for the cross-device score reductions.
+"""
+
+from mythril_tpu.parallel.mesh import (
+    CAND_AXIS,
+    PATH_AXIS,
+    make_frontier_mesh,
+    shard_probe_args,
+)
+from mythril_tpu.parallel.probe import (
+    evaluate_batch_sharded,
+    frontier_step,
+    pack_frontier,
+)
+
+__all__ = [
+    "CAND_AXIS",
+    "PATH_AXIS",
+    "make_frontier_mesh",
+    "shard_probe_args",
+    "evaluate_batch_sharded",
+    "frontier_step",
+    "pack_frontier",
+]

@@ -1,0 +1,77 @@
+"""Frontier mesh construction and probe-input sharding.
+
+Axes:
+  * ``path`` — independent symbolic-execution paths (each with its own
+    constraint conjunction data).  The data-parallel axis: no communication
+    is needed between paths except the final best-score/issue reductions.
+  * ``cand`` — the candidate-assignment batch evaluated for one path.  The
+    intra-problem axis (the sequence-parallel analogue): conjunct truth
+    columns are computed shard-locally, score reductions cross it.
+
+The reference has no counterpart (single worklist, strictly sequential —
+mythril/laser/ethereum/svm.py:272); this subsystem is the pod-scaling story
+of SURVEY.md §5.8.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PATH_AXIS = "path"
+CAND_AXIS = "cand"
+
+
+def _factor_2d(n: int) -> tuple:
+    """Split n devices into (path, cand) with path the largest divisor <= sqrt(n)."""
+    best = 1
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            best = d
+        d += 1
+    return best, n // best
+
+
+def make_frontier_mesh(
+    devices: Optional[Sequence] = None,
+    path_size: Optional[int] = None,
+) -> Mesh:
+    """Build the 2-D (path, cand) mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if path_size is None:
+        p, c = _factor_2d(n)
+    else:
+        if n % path_size:
+            raise ValueError(f"path_size {path_size} does not divide {n} devices")
+        p, c = path_size, n // path_size
+    return Mesh(np.asarray(devices).reshape(p, c), (PATH_AXIS, CAND_AXIS))
+
+
+def _leaf_spec(batch_dims: int) -> P:
+    """PartitionSpec for a probe-input leaf.
+
+    ``batch_dims == 2`` means leaves carry [P, B, ...] (a stacked frontier):
+    dim 0 shards over ``path``, dim 1 over ``cand``.  ``batch_dims == 1``
+    means flat [B, ...] candidate batches: dim 0 shards over both axes
+    flattened (pure data parallelism of candidates).
+    """
+    if batch_dims == 2:
+        return P(PATH_AXIS, CAND_AXIS)
+    return P((PATH_AXIS, CAND_AXIS))
+
+
+def shard_probe_args(args_tree, mesh: Mesh, batch_dims: int = 1):
+    """device_put every probe-input leaf with its frontier NamedSharding.
+
+    ``args_tree`` is the (scalars, bools, array_tabs) tuple produced by
+    mythril_tpu/ops/lowering.pack_assignments (or its stacked-frontier
+    variant).  Leading batch dim(s) shard; trailing structure dims replicate.
+    """
+    spec = _leaf_spec(batch_dims)
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), args_tree)

@@ -1,0 +1,109 @@
+"""Mesh-sharded probe evaluation: the frontier step and flat batched eval.
+
+``frontier_step(compiled)`` is the flagship SPMD program: one round of the
+probe solver over a stacked frontier of P independent paths x B candidate
+assignments each.  Inputs are sharded [path, cand] over the 2-D mesh; the
+step evaluates every conjunct for every candidate, reduces to per-path best
+scores (collectives across ``cand``) and a global sat count (collectives
+across both axes) — XLA places the all-reduces on ICI.
+
+The reference's counterpart is strictly sequential: one Z3 ``check()`` per
+path per prune (mythril/laser/ethereum/svm.py:287-292,
+mythril/laser/smt/solver/solver.py:51-66).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from mythril_tpu.ops.lowering import CompiledConjunction, pack_assignments
+from mythril_tpu.parallel.mesh import make_frontier_mesh, shard_probe_args
+
+
+def frontier_step(compiled: CompiledConjunction):
+    """Build the jittable one-round frontier program for a conjunction shape.
+
+    Returns ``step(scalars, bools, array_tabs)`` expecting leading [P, B]
+    batch dims on every leaf, producing:
+      * ``scores``      [P, B] — satisfied-conjunct count per candidate,
+      * ``best_score``  [P]    — per-path max (cross-``cand`` reduction),
+      * ``best_idx``    [P]    — argmax candidate per path,
+      * ``n_sat``       []     — global count of full models (cross-mesh).
+    """
+    n_conj = len(compiled.conjuncts)
+    raw = compiled.raw_fn
+
+    def step(scalars, bools, array_tabs):
+        truth = raw(scalars, bools, array_tabs)  # [P, B, C] bool
+        scores = truth.sum(axis=-1)  # [P, B]
+        best_score = scores.max(axis=-1)  # [P]
+        best_idx = jnp.argmax(scores, axis=-1)  # [P]
+        n_sat = (scores == n_conj).sum()  # []
+        return scores, best_score, best_idx, n_sat
+
+    return jax.jit(step)
+
+
+def pack_frontier(
+    compiled: CompiledConjunction, assignments_per_path: Sequence[Sequence]
+):
+    """Pack P lists of B assignments into stacked [P, B, ...] probe inputs.
+
+    All paths share the conjunction DAG (SPMD requires one program); array
+    tables take the union of keys across the whole frontier so every leaf is
+    rectangular.
+    """
+    P_ = len(assignments_per_path)
+    sizes = {len(a) for a in assignments_per_path}
+    if len(sizes) != 1:
+        raise ValueError("every path needs the same candidate count")
+    B = sizes.pop()
+    flat = [a for path in assignments_per_path for a in path]
+    scalars, bools, array_tabs = pack_assignments(compiled, flat)
+
+    def unflatten(leaf):
+        return leaf.reshape((P_, B) + leaf.shape[1:])
+
+    return jax.tree.map(unflatten, (scalars, bools, array_tabs))
+
+
+def _pad_batch(args_tree, pad_to: int, batch: int):
+    """Pad the leading candidate dim by repeating the last row."""
+    if pad_to == batch:
+        return args_tree
+
+    def pad(leaf):
+        reps = np.concatenate(
+            [leaf[:batch], np.repeat(np.asarray(leaf[batch - 1 : batch]), pad_to - batch, axis=0)]
+        )
+        return reps
+
+    return jax.tree.map(lambda leaf: pad(np.asarray(leaf)), args_tree)
+
+
+def evaluate_batch_sharded(
+    compiled: CompiledConjunction,
+    assignments: Sequence,
+    mesh: Optional[Mesh] = None,
+) -> np.ndarray:
+    """[B, C] truth matrix with the candidate batch sharded over all devices.
+
+    The flat data-parallel production path used by the solver when more than
+    one device is attached: candidates spread over the whole mesh (both axes
+    flattened), one XLA dispatch, result gathered to host.  Padding rows
+    (batch made divisible by the device count) are sliced off before return.
+    """
+    mesh = mesh or make_frontier_mesh()
+    n_dev = mesh.devices.size
+    B = len(assignments)
+    pad_to = -(-B // n_dev) * n_dev
+    args_tree = pack_assignments(compiled, assignments)
+    args_tree = _pad_batch(args_tree, pad_to, B)
+    scalars, bools, array_tabs = shard_probe_args(args_tree, mesh, batch_dims=1)
+    truth = compiled._fn(scalars, bools, array_tabs)
+    return np.asarray(truth)[:B]

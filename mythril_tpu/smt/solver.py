@@ -405,6 +405,22 @@ def _device_backend_requested() -> bool:
     return platforms.startswith(("tpu", "axon"))
 
 
+def _evaluate_candidates_device(compiled, candidates):
+    """One dispatch over all candidates; mesh-sharded when devices allow.
+
+    With >1 attached device the candidate batch spreads over the full
+    frontier mesh (mythril_tpu/parallel) — the data-parallel production path;
+    single-chip falls through to the plain batched evaluator.
+    """
+    import jax
+
+    if jax.device_count() > 1 and len(candidates) >= 16:
+        from mythril_tpu.parallel import evaluate_batch_sharded
+
+        return evaluate_batch_sharded(compiled, candidates)
+    return compiled.evaluate_batch(candidates)
+
+
 def _try_compile_device(conjuncts: Sequence[Term]):
     """Compile for batched device evaluation, or None (unsupported op /
     lowering failure — the host path handles everything)."""
@@ -596,7 +612,7 @@ def solve_conjunction(
         import numpy as _np
 
         try:
-            truth = compiled.evaluate_batch(candidates)  # [B, C] bool
+            truth = _evaluate_candidates_device(compiled, candidates)  # [B, C]
         except Exception as e:
             log.warning(
                 "device probe evaluation failed, host fallback (%s): %s",
