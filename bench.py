@@ -59,6 +59,13 @@ def run_analysis(probe_backend: str):
 
     global_args.probe_backend = probe_backend
     reset_callback_modules()
+    # both configurations must solve from scratch: drop memoized models at
+    # both cache tiers (solver-level model reuse AND get_model's lru_cache)
+    from mythril_tpu.smt.solver import clear_model_cache
+    from mythril_tpu.support.model import _get_model_cached
+
+    clear_model_cache()
+    _get_model_cached.cache_clear()
     # the (address, bytecode-hash) issue dedup cache persists across runs in
     # one process; both configurations must analyze from scratch
     from mythril_tpu.analysis.module.loader import ModuleLoader

@@ -115,13 +115,18 @@ class StateTransition:
                     f"cannot execute {func.__name__} inside a static call"
                 )
             new_state = _copy.copy(global_state)
-            if self.enable_gas:
-                gmin, gmax = get_opcode_gas(instr_obj.op_code)
-                new_state.mstate.min_gas_used += gmin
-                new_state.mstate.max_gas_used += gmax
-                new_state.mstate.check_gas()
             old_pc = new_state.mstate.pc
             states = func(instr_obj, new_state)
+            # gas accrues on the successors AFTER the handler ran (reference
+            # instructions.py:192-195): terminal ops end the transaction from
+            # inside the handler and so never charge their own opcode gas,
+            # and OOG surfaces on the instruction *after* the budget is blown
+            if self.enable_gas:
+                gmin, gmax = get_opcode_gas(instr_obj.op_code)
+                for s in states:
+                    s.mstate.min_gas_used += gmin
+                    s.mstate.max_gas_used += gmax
+                    s.mstate.check_gas()
             if self.increment_pc:
                 for s in states:
                     if s.mstate.pc == old_pc:
@@ -779,6 +784,7 @@ class Instruction:
         if target.opcode != "JUMPDEST":
             raise InvalidJumpDestination(f"JUMP to non-JUMPDEST {dest.value}")
         global_state.mstate.pc = index
+        global_state.mstate.depth += 1
         return [global_state]
 
     @StateTransition(increment_pc=False)
@@ -794,6 +800,7 @@ class Instruction:
             fallthrough = _copy.copy(global_state)
             fallthrough.world_state.constraints.append(Not(condition))
             fallthrough.mstate.pc += 1
+            fallthrough.mstate.depth += 1
             states.append(fallthrough)
 
         # taken branch
@@ -811,6 +818,7 @@ class Instruction:
                     taken = _copy.copy(global_state)
                     taken.world_state.constraints.append(condition)
                     taken.mstate.pc = index
+                    taken.mstate.depth += 1
                     states.append(taken)
         return states
 

@@ -97,6 +97,7 @@ class MachineState:
         self.min_gas_used += cost
         self.max_gas_used += cost
         self.memory_size = new_size
+        self.check_gas()
 
     @property
     def gas_left(self) -> int:

@@ -201,7 +201,7 @@ class LaserEVM:
                 ]
             self.work_list.extend(new_states)
             self.total_states += len(new_states)
-            if track_gas and not new_states and op_code is not None:
+            if track_gas and not new_states:
                 final_states.append(global_state)
         self._fire("stop_exec")
         return final_states if track_gas else None
@@ -306,8 +306,9 @@ class LaserEVM:
                     kept.append(new_state)
             new_global_states = kept
 
-        for new_state in new_global_states:
-            new_state.mstate.depth = global_state.mstate.depth + 1
+        # depth counts control-flow transfers (JUMP/JUMPI bump it in their
+        # handlers, reference instructions.py:1552,1603,1628) — NOT every
+        # instruction, or max_depth=128 would cap runs at 128 opcodes
         return new_global_states, op_code
 
     def _handle_vm_exception(

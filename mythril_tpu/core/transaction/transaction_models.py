@@ -111,7 +111,13 @@ class BaseTransaction:
 
     def initial_global_state_from_environment(self, environment, active_function):
         """Seed a GlobalState for this tx + the sender-balance constraint."""
-        global_state = GlobalState(self.world_state, environment)
+        from mythril_tpu.core.state.machine_state import MachineState
+
+        global_state = GlobalState(
+            self.world_state,
+            environment,
+            machine_state=MachineState(gas_limit=self.gas_limit),
+        )
         global_state.environment.active_function_name = active_function
         sender = environment.sender
         value = environment.callvalue

@@ -92,8 +92,20 @@ def _round(state: jnp.ndarray, rc: jnp.ndarray) -> jnp.ndarray:
 def keccak_f1600(state: jnp.ndarray) -> jnp.ndarray:
     """Full 24-round permutation of the [..., 25, 4] state.
 
-    Rounds run under ``lax.scan`` so the compiled graph holds ONE round body —
-    a fully unrolled version takes minutes of XLA compile time."""
+    On TPU (or with ``args.keccak_backend = "pallas"``) this dispatches to the
+    hand-scheduled Pallas kernel (mythril_tpu/ops/keccak_pallas.py); the
+    portable path runs the rounds under ``lax.scan`` so the compiled graph
+    holds ONE round body — a fully unrolled version takes minutes of XLA
+    compile time."""
+    from mythril_tpu.support.support_args import args
+
+    backend = getattr(args, "keccak_backend", "auto")
+    if backend == "pallas" or (
+        backend == "auto" and jax.default_backend() == "tpu"
+    ):
+        from mythril_tpu.ops import keccak_pallas
+
+        return keccak_pallas.keccak_f1600(state)
     out, _ = jax.lax.scan(
         lambda st, rc: (_round(st, rc), None), state, jnp.asarray(_RC_LIMBS)
     )

@@ -257,7 +257,10 @@ class _Seeder:
         if t.op in ("ult", "ule", "slt", "sle"):
             a, b = t.args
             if want and a.is_const and not b.is_const:
-                self._propagate_value(b, mask(a.value + 1, b.width), weak=True)
+                # strict bounds need bound+1; non-strict are satisfied at the
+                # bound itself (and must not wrap for an all-ones bound)
+                bump = 1 if t.op in ("ult", "slt") else 0
+                self._propagate_value(b, mask(a.value + bump, b.width), weak=True)
             elif want and not a.is_const:
                 self._propagate_value(a, 0, weak=True)
 
@@ -469,12 +472,54 @@ def _interesting_fills(rng: random.Random, pool: Sequence[int], width: int):
             yield rng.getrandbits(width)
 
 
+class _ModelCache:
+    """Incremental-solving stand-in: recently found models, tried first.
+
+    Engine queries overwhelmingly *extend* a previous query by one conjunct
+    (a JUMPI fork appends one branch condition to the shared path prefix), so
+    a model of the prefix usually still satisfies the extension.  Evaluating
+    a handful of recent models on the host costs microseconds and skips the
+    whole probe (and any device dispatch) on a hit.  Exact results are also
+    memoized per interned conjunct-set so repeated reachability checks of the
+    same world state are free.
+    """
+
+    def __init__(self, max_models: int = 12, max_results: int = 4096):
+        self.models: List[Assignment] = []
+        self.results: Dict[frozenset, Tuple[str, Optional[Assignment]]] = {}
+        self.max_models = max_models
+        self.max_results = max_results
+
+    def remember(self, key: frozenset, status: str, asg: Optional[Assignment]):
+        if len(self.results) >= self.max_results:
+            self.results.clear()
+        self.results[key] = (status, asg)
+        if asg is not None:
+            self.models = [m for m in self.models if m is not asg]
+            self.models.append(asg)
+            del self.models[: -self.max_models]
+
+
+_model_cache = _ModelCache()
+
+
+def clear_model_cache() -> None:
+    _model_cache.models.clear()
+    _model_cache.results.clear()
+
+
 def solve_conjunction(
     conjuncts: Sequence[Term],
     config: Optional[ProbeConfig] = None,
     extra_seeds: Optional[Sequence[Assignment]] = None,
+    use_cache: bool = True,
 ) -> Tuple[str, Optional[Assignment]]:
-    """Core entry: find a model of And(conjuncts) or report unsat/unknown."""
+    """Core entry: find a model of And(conjuncts) or report unsat/unknown.
+
+    ``use_cache=False`` skips both memo tiers — required by callers that need
+    *distinct* models for the same constraint set (Optimize's best-of-N seed
+    loop would otherwise get the identical cached model back N times).
+    """
     config = config or ProbeConfig()
     stats = SolverStatistics()
     stats.query_count += 1
@@ -487,6 +532,24 @@ def solve_conjunction(
             return SAT, Assignment()
         return UNSAT, None
     conjuncts = list(folded.args) if folded.op == "and" else [folded]
+
+    cache_key = frozenset(c.tid for c in conjuncts)
+    if use_cache:
+        hit = _model_cache.results.get(cache_key)
+        if hit is not None:
+            return hit
+
+        # tier 0.5: a recent model may already satisfy this query
+        # (incremental reuse across the shared path prefix)
+        for asg in reversed(_model_cache.models):
+            try:
+                vals = evaluate(conjuncts, asg)
+            except Exception:
+                continue
+            if all(vals[c] for c in conjuncts):
+                stats.probe_hits += 1
+                _model_cache.remember(cache_key, SAT, asg)
+                return SAT, asg
 
     free = terms.free_vars(conjuncts)
     scalar_vars = [v for v in free if v.op == "var"]
@@ -628,6 +691,7 @@ def solve_conjunction(
                 if check_asg(candidates[b]):
                     stats.probe_hits += 1
                     stats.solver_time += time.time() - t0
+                    _model_cache.remember(cache_key, SAT, candidates[b])
                     return SAT, candidates[b]
                 if time.time() > deadline:
                     break
@@ -644,6 +708,7 @@ def solve_conjunction(
             if score == len(conjuncts):
                 stats.probe_hits += 1
                 stats.solver_time += time.time() - t0
+                _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
             if score > best_score:
                 best_score, best_asg = score, asg
@@ -678,6 +743,7 @@ def solve_conjunction(
             if score == len(conjuncts):
                 stats.probe_hits += 1
                 stats.solver_time += time.time() - t0
+                _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
             if score >= best_score:
                 best_score, best_asg = score, asg
@@ -691,8 +757,12 @@ def solve_conjunction(
             status, asg = bitblast.solve(conjuncts, deadline - time.time())
             stats.solver_time += time.time() - t0
             if status == SAT and asg is not None and check_asg(asg):
+                _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
             if status == UNSAT:
+                # exact verdict: safe to memoize (UNKNOWN never is — a larger
+                # budget on a later identical query may still find a model)
+                _model_cache.remember(cache_key, UNSAT, None)
                 return UNSAT, None
     except ImportError:
         pass
@@ -780,7 +850,7 @@ class Optimize(Solver):
                 timeout_ms=max(1, self.config.timeout_ms // 3),
                 rng_seed=self.config.rng_seed + seed,
             )
-            status, asg = solve_conjunction(conj, cfg)
+            status, asg = solve_conjunction(conj, cfg, use_cache=False)
             if status == UNSAT:
                 self._model = None
                 return UNSAT

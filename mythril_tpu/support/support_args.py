@@ -35,6 +35,7 @@ class Args:
     probe_candidates: int = 48
     probe_rounds: int = 4
     probe_backend: str = "auto"  # auto | host | jax
+    keccak_backend: str = "auto"  # auto | jax | pallas (pallas on TPU when auto)
 
 
 args = Args()

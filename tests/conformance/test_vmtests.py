@@ -1,0 +1,193 @@
+"""Conformance suite: official Ethereum VMTests replayed concretely.
+
+This is the backend-independent oracle recommended by SURVEY.md §4 item 1
+(reference harness: tests/laser/evm_testsuite/evm_test.py:1-210): each fixture
+describes a concrete pre-state, one concrete message call, and the expected
+post-state.  We build a concrete ``WorldState`` from ``pre``, replay the call
+through the symbolic engine via the concolic transaction driver, then assert
+
+  (a) the engine's gas lower bound does not exceed the actual gas consumption
+      recorded in the fixture, and min <= max (same fidelity the reference
+      harness asserts: max_gas_used is an over-approximating bound used for
+      OOG detection, not an exact upper bound, so only min is oracle-checked),
+  (b) the post-state accounts (nonce, code, storage) match exactly,
+  (c) fixtures with no ``post`` section (OOG / error cases) leave zero
+      surviving open world states.
+
+Fixture sources, in priority order:
+  1. ``$VMTESTS_DIR`` if set,
+  2. the official fixture tree mounted read-only with the reference at
+     /root/reference/tests/laser/evm_testsuite/VMTests (538 fixtures),
+  3. the small in-repo sample set under tests/testdata/vmtests (always run,
+     so the suite is never empty on machines without the reference mount).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import List, Tuple
+
+import pytest
+
+REFERENCE_FIXTURES = Path("/root/reference/tests/laser/evm_testsuite/VMTests")
+LOCAL_FIXTURES = Path(__file__).parent.parent / "testdata" / "vmtests"
+
+CATEGORIES = [
+    "vmArithmeticTest",
+    "vmBitwiseLogicOperation",
+    "vmEnvironmentalInfo",
+    "vmPushDupSwapTest",
+    "vmTests",
+    "vmSha3Test",
+    "vmSystemOperations",
+    "vmRandomTest",
+    "vmIOandFlowOperations",
+]
+
+# Fixtures exercising behavior that is out of scope for a security analyzer
+# (same feature classes the reference skiplists at evm_test.py:34-60):
+#   - exact-gas-dependent control flow (GAS pushes a fresh symbol here),
+#   - branches on concrete block numbers (block number is a fresh symbol),
+#   - LOG-driven memory expansion accounting,
+#   - stack-limit loops beyond the engine's max-depth envelope.
+SKIP = {
+    "gas0",
+    "gas1",
+    "log1MemExp",
+    "loop_stacklimit_1020",
+    "loop_stacklimit_1021",
+    "BlockNumberDynamicJumpi0",
+    "BlockNumberDynamicJumpi1",
+    "BlockNumberDynamicJump0_jumpdest2",
+    "DynamicJumpPathologicalTest0",
+    "BlockNumberDynamicJumpifInsidePushWithJumpDest",
+    "BlockNumberDynamicJumpiAfterStop",
+    "BlockNumberDynamicJumpifInsidePushWithoutJumpDest",
+    "BlockNumberDynamicJump0_jumpdest0",
+    "BlockNumberDynamicJumpi1_jumpdest",
+    "BlockNumberDynamicJumpiOutsideBoundary",
+    "DynamicJumpJD_DependsOnJumps1",
+    "jumpTo1InstructionafterJump",
+    "sstore_load_2",
+    "jumpi_at_the_end",
+}
+
+
+def _iter_fixture_files() -> List[Path]:
+    env_dir = os.environ.get("VMTESTS_DIR")
+    roots = []
+    if env_dir:
+        roots.append(Path(env_dir))
+    elif REFERENCE_FIXTURES.is_dir():
+        roots.append(REFERENCE_FIXTURES)
+    roots.append(LOCAL_FIXTURES)
+
+    files: List[Path] = []
+    for root in roots:
+        for category in CATEGORIES:
+            cat_dir = root / category
+            if cat_dir.is_dir():
+                files.extend(sorted(cat_dir.glob("*.json")))
+    return files
+
+
+def load_cases() -> List[Tuple[str, dict]]:
+    cases = []
+    seen = set()
+    for path in _iter_fixture_files():
+        with path.open() as fh:
+            top = json.load(fh)
+        for name, data in top.items():
+            if name in seen:
+                continue
+            seen.add(name)
+            cases.append((name, data))
+    return cases
+
+
+CASES = load_cases()
+
+
+@pytest.mark.conformance
+@pytest.mark.parametrize("name, data", CASES, ids=[c[0] for c in CASES])
+def test_vmtest(name: str, data: dict) -> None:
+    if name in SKIP:
+        pytest.skip("feature class out of scope (see module docstring)")
+
+    from mythril_tpu.core.state.account import Account
+    from mythril_tpu.core.state.world_state import WorldState
+    from mythril_tpu.core.svm import LaserEVM
+    from mythril_tpu.core.transaction.concolic import execute_message_call
+    from mythril_tpu.frontend.disassembler import Disassembly
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.support.support_args import args
+    from mythril_tpu.support.time_handler import time_handler
+
+    pre = data["pre"]
+    action = data["exec"]
+    env = data.get("env", {})
+    post = data.get("post", {})
+    gas_before = int(action["gas"], 16)
+    gas_after = data.get("gas")
+    gas_used = gas_before - int(gas_after, 16) if gas_after is not None else None
+
+    args.unconstrained_storage = False
+    world_state = WorldState()
+    for address, details in pre.items():
+        account = Account(address, concrete_storage=True)
+        account.code = Disassembly(details["code"])
+        account.nonce = int(details["nonce"], 16)
+        for key, value in details["storage"].items():
+            account.storage[symbol_factory.BitVecVal(int(key, 16), 256)] = (
+                symbol_factory.BitVecVal(int(value, 16), 256)
+            )
+        world_state.put_account(account)
+        account.set_balance(int(details["balance"], 16))
+
+    time_handler.start_execution(10000)
+    laser_evm = LaserEVM()
+    laser_evm.open_states = [world_state]
+    laser_evm.time = time.time()
+
+    final_states = execute_message_call(
+        laser_evm,
+        callee_address=symbol_factory.BitVecVal(int(action["address"], 16), 256),
+        caller_address=symbol_factory.BitVecVal(int(action["caller"], 16), 256),
+        origin_address=symbol_factory.BitVecVal(int(action["origin"], 16), 256),
+        code=action["code"][2:],
+        gas_limit=gas_before,
+        data=list(bytes.fromhex(action["data"][2:])),
+        gas_price=int(action["gasPrice"], 16),
+        value=int(action["value"], 16),
+        track_gas=True,
+    )
+
+    block_gas_limit = int(env.get("currentGasLimit", "0x7fffffffffffffff"), 16)
+    if gas_used is not None and gas_used < block_gas_limit:
+        bounds = [(s.mstate.min_gas_used, s.mstate.max_gas_used) for s in final_states]
+        assert all(lo <= hi for lo, hi in bounds)
+        assert any(lo <= gas_used for lo, _ in bounds)
+
+    if post == {}:
+        assert len(laser_evm.open_states) == 0
+        return
+
+    assert len(laser_evm.open_states) == 1
+    result_state = laser_evm.open_states[0]
+    for address, details in post.items():
+        account = result_state[symbol_factory.BitVecVal(int(address, 16), 256)]
+        assert account.nonce == int(details["nonce"], 16)
+        code_bytes = account.code.bytecode if account.code is not None else b""
+        assert code_bytes == bytes.fromhex(details["code"][2:])
+        for index, value in details["storage"].items():
+            expected = int(value, 16)
+            actual = account.storage[symbol_factory.BitVecVal(int(index, 16), 256)]
+            actual_val = getattr(actual, "value", actual)
+            if actual_val is True:
+                actual_val = 1
+            elif actual_val is False:
+                actual_val = 0
+            assert actual_val == expected, f"storage[{index}]"
