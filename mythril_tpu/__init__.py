@@ -1,1 +1,32 @@
 __version__ = "0.1.0"
+
+
+def enable_persistent_compilation_cache() -> None:
+    """Cache compiled XLA programs on disk across processes.
+
+    The tape-VM interpreter (mythril_tpu/ops/tape_vm.py) and the Pallas
+    keccak kernel compile once per shape bucket; over a tunneled TPU that
+    first compile costs tens of seconds.  JAX's persistent compilation cache
+    turns that into a one-time-per-machine cost.  Best-effort: unsupported
+    backends or read-only homes silently skip it.
+
+    Called from the device-path modules at import time (they import jax
+    anyway); NOT from this package __init__ — host-only workflows must not
+    pay the jax import at startup.
+    """
+    import os
+
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "MYTHRIL_TPU_COMPILATION_CACHE",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "mythril_tpu", "xla"
+            ),
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass

@@ -75,6 +75,20 @@ class SymExecWrapper:
 
         requires_statespace = compulsory_statespace or run_analysis_modules
 
+        # warm the device probe's interpreter BEFORE engine timers start:
+        # the one-time XLA compile must not eat the creation-tx timeout.
+        # Best-effort like every device entry point — a dead tunnel or
+        # missing backend degrades to the host path, never aborts analysis.
+        from mythril_tpu.smt.solver import _device_backend_requested
+
+        if _device_backend_requested():
+            try:
+                from mythril_tpu.ops.tape_vm import warmup
+
+                warmup()
+            except Exception as e:
+                log.warning("device probe warmup failed (host fallback): %s", e)
+
         # seed world state with the actor accounts (reference symbolic.py:100-117)
         world_state = WorldState()
         world_state.accounts_exist_or_load(ACTORS.creator.value, dynloader)

@@ -122,10 +122,17 @@ class MythrilAnalyzer:
         stats.enabled = True
         all_issues: List[Issue] = []
         exceptions = []
+        execution_info = []
         for contract in self.contracts:
             try:
                 sym = self._sym_exec(contract)
                 issues = fire_lasers(sym, modules or self.cmd_args.modules)
+                from mythril_tpu.core.execution_info import (
+                    EngineStatsInfo,
+                    SolverStatsInfo,
+                )
+
+                execution_info = [EngineStatsInfo(sym.laser), SolverStatsInfo()]
             except KeyboardInterrupt:
                 log.critical("keyboard interrupt: saving partial results")
                 issues = retrieve_callback_issues(modules or self.cmd_args.modules)
@@ -143,7 +150,11 @@ class MythrilAnalyzer:
             all_issues += issues
 
         source_data = self.contracts
-        report = Report(contracts=source_data, exceptions=exceptions)
+        report = Report(
+            contracts=source_data,
+            exceptions=exceptions,
+            execution_info=execution_info,
+        )
         for issue in all_issues:
             report.append_issue(issue)
         return report

@@ -1,0 +1,9 @@
+"""Native (C++) runtime components.
+
+The reference's heavy math all lives in native pip wheels (z3, pysha3,
+coincurve — SURVEY.md §2.9); this package holds the equivalents built from
+source in-repo: the bit-blasting CDCL solver (tier 2 of the probe stack) and
+the batched keccak used on the host path.  Libraries are compiled on first
+use with the system toolchain (g++) and cached next to the sources; every
+entry point degrades gracefully when no compiler is available.
+"""

@@ -1,0 +1,389 @@
+"""Tier-2 exact solving: serialize term DAGs to the native CDCL bit-blaster.
+
+Role (see mythril_tpu/smt/solver.py): the probe answers most queries; this
+tier supplies what probing cannot — exact UNSAT verdicts (stronger pruning
+with zero recall loss) and models for hard SAT instances.  The reference
+delegates the same questions to Z3 (mythril/laser/smt/solver/solver.py:51-66).
+
+Abstractions applied before blasting, all sound for UNSAT (they only ever
+ADD behaviors):
+  * ``select`` over ``store``/``ite``/``const_array`` chains is rewritten
+    into mux chains (same rewrite the device lowering performs);
+  * base-array ``select``s, ``keccak``s and uninterpreted ``apply``s become
+    fresh variables with Ackermann congruence constraints
+    (equal arguments => equal results);
+  * ``bvexp`` expands by square-and-multiply for constant exponents /
+    power-of-two bases and is rejected otherwise.
+SAT answers are therefore *candidates*: the caller validates the
+reconstructed model with the exact concrete evaluator before trusting it
+(solver.py does this), so keccak's abstraction can never produce a wrong SAT,
+and UNSAT of the abstraction implies UNSAT of the original formula.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import ArrayValue, Assignment, evaluate
+from mythril_tpu.smt.terms import Term
+
+log = logging.getLogger(__name__)
+
+SAT, UNSAT, UNKNOWN = "sat", "unsat", "unknown"
+
+(
+    OP_CONST, OP_VAR, OP_EQ, OP_AND, OP_OR, OP_NOT, OP_XOR, OP_ITE,
+    OP_ADD, OP_SUB, OP_MUL, OP_UDIV, OP_UREM, OP_SDIV, OP_SREM,
+    OP_BAND, OP_BOR, OP_BXOR, OP_BNOT, OP_NEG, OP_SHL, OP_LSHR, OP_ASHR,
+    OP_CONCAT, OP_EXTRACT, OP_ZEXT, OP_SEXT, OP_ULT, OP_ULE, OP_SLT, OP_SLE,
+) = range(31)
+
+_BINOP = {
+    "bvadd": OP_ADD, "bvsub": OP_SUB, "bvmul": OP_MUL, "bvudiv": OP_UDIV,
+    "bvurem": OP_UREM, "bvsdiv": OP_SDIV, "bvsrem": OP_SREM,
+    "bvand": OP_BAND, "bvor": OP_BOR, "bvxor": OP_BXOR,
+    "bvshl": OP_SHL, "bvlshr": OP_LSHR, "bvashr": OP_ASHR,
+    "ult": OP_ULT, "ule": OP_ULE, "slt": OP_SLT, "sle": OP_SLE,
+    "xor": OP_XOR,
+}
+
+_MAX_NODES = 200_000
+
+
+class Unsupported(Exception):
+    """DAG contains structure the native tier cannot express exactly."""
+
+
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    from mythril_tpu.native.build import library_path
+
+    path = library_path()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+        lib.bb_solve.restype = ctypes.c_int32
+        lib.bb_solve.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+        _lib = lib
+    except OSError as e:
+        log.warning("native library failed to load: %s", e)
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Serialization
+# ---------------------------------------------------------------------------
+
+
+class _Tape:
+    def __init__(self):
+        self.records: List[Tuple[int, int, int, int, int, int, int]] = []
+        self.consts = bytearray()
+        # original Term -> tape node id
+        self.node_of: Dict[int, int] = {}
+        # fresh var bookkeeping, in tape order: (kind, payload)
+        #   ("scalar", term) | ("select", array_term, idx_term)
+        #   | ("keccak", input_term) | ("apply", term)
+        self.var_meta: List[tuple] = []
+        # Ackermann groups
+        self.selects: Dict[int, List[Tuple[int, int, Term]]] = {}  # arr tid -> [(idx node, var node, idx term)]
+        self.keccaks: List[Tuple[int, int, Term]] = []  # (input node, var node, input term)
+        self.applies: Dict[tuple, List[Tuple[List[int], int]]] = {}
+        self.roots: List[int] = []
+
+    def emit(self, op, width, a0=-1, a1=-1, a2=-1, x0=0, x1=0) -> int:
+        self.records.append((op, width, a0, a1, a2, x0, x1))
+        if len(self.records) > _MAX_NODES:
+            raise Unsupported("tape too large")
+        return len(self.records) - 1
+
+    def const(self, value: int, width: int) -> int:
+        nbytes = (width + 7) // 8
+        off = len(self.consts)
+        self.consts += int(value).to_bytes(nbytes, "little")
+        return self.emit(OP_CONST, width, x0=off, x1=nbytes)
+
+    def fresh(self, width: int, meta: tuple) -> int:
+        node = self.emit(OP_VAR, width)
+        self.var_meta.append(meta)
+        return node
+
+
+def _width(t: Term) -> int:
+    return 1 if t.sort is terms.BOOL else t.width
+
+
+def _lower_select(tape: _Tape, arr: Term, idx_node: int, idx_term: Term) -> int:
+    """select(arr, idx) -> tape node, flattening store/ite chains."""
+    rng_w = arr.sort[2]
+    if arr.op == "store":
+        base, s_idx, s_val = arr.args
+        below = _lower_select(tape, base, idx_node, idx_term)
+        hit = tape.emit(OP_EQ, 1, _node(tape, s_idx), idx_node)
+        return tape.emit(OP_ITE, rng_w, hit, _node(tape, s_val), below)
+    if arr.op == "ite":
+        c, a, b = arr.args
+        then = _lower_select(tape, a, idx_node, idx_term)
+        els = _lower_select(tape, b, idx_node, idx_term)
+        return tape.emit(OP_ITE, rng_w, _node(tape, c), then, els)
+    if arr.op == "const_array":
+        return _node(tape, arr.args[0])
+    if arr.op == "array_var":
+        var = tape.fresh(rng_w, ("select", arr, idx_term))
+        tape.selects.setdefault(arr.tid, []).append((idx_node, var, idx_term))
+        return var
+    raise Unsupported(f"array op {arr.op}")
+
+
+def _node(tape: _Tape, t: Term) -> int:
+    return tape.node_of[t.tid]
+
+
+def _serialize_node(tape: _Tape, t: Term) -> Optional[int]:
+    op, a = t.op, t.args
+    if op in ("array_var", "const_array", "store"):
+        return None  # handled structurally at their select sites
+    if op == "ite" and terms.is_array_sort(t.sort):
+        return None  # consumed by select flattening
+    w = _width(t)
+    if op == "const":
+        if t.sort is terms.BOOL:
+            return tape.const(1 if t.aux else 0, 1)
+        return tape.const(t.aux, w)
+    if op == "var":
+        return tape.fresh(w, ("scalar", t))
+    if op == "select":
+        return _lower_select(tape, a[0], _node(tape, a[1]), a[1])
+    if op == "eq":
+        if terms.is_array_sort(a[0].sort):
+            raise Unsupported("array equality")
+        return tape.emit(OP_EQ, 1, _node(tape, a[0]), _node(tape, a[1]))
+    if op in ("and", "or"):
+        code = OP_AND if op == "and" else OP_OR
+        node = _node(tape, a[0])
+        for x in a[1:]:
+            node = tape.emit(code, 1, node, _node(tape, x))
+        return node
+    if op == "not":
+        return tape.emit(OP_NOT, 1, _node(tape, a[0]))
+    if op == "ite":
+        return tape.emit(
+            OP_ITE, w, _node(tape, a[0]), _node(tape, a[1]), _node(tape, a[2])
+        )
+    if op == "bvnot":
+        return tape.emit(OP_BNOT, w, _node(tape, a[0]))
+    if op == "bvneg":
+        return tape.emit(OP_NEG, w, _node(tape, a[0]))
+    if op == "concat":
+        return tape.emit(OP_CONCAT, w, _node(tape, a[0]), _node(tape, a[1]))
+    if op == "extract":
+        hi, lo = t.aux
+        return tape.emit(OP_EXTRACT, w, _node(tape, a[0]), x0=hi, x1=lo)
+    if op == "zext":
+        return tape.emit(OP_ZEXT, w, _node(tape, a[0]))
+    if op == "sext":
+        return tape.emit(OP_SEXT, w, _node(tape, a[0]))
+    if op == "bvexp":
+        return _serialize_exp(tape, t)
+    if op == "keccak":
+        var = tape.fresh(256, ("keccak", a[0]))
+        tape.keccaks.append((_node(tape, a[0]), var, a[0]))
+        return var
+    if op == "apply":
+        var = tape.fresh(w, ("apply", t))
+        key = (t.aux, len(a))
+        tape.applies.setdefault(key, []).append(
+            ([_node(tape, x) for x in a], var)
+        )
+        return var
+    code = _BINOP.get(op)
+    if code is not None:
+        return tape.emit(code, w, _node(tape, a[0]), _node(tape, a[1]))
+    raise Unsupported(f"op {op}")
+
+
+def _serialize_exp(tape: _Tape, t: Term) -> int:
+    base, expo = t.args
+    w = t.width
+    if expo.is_const:
+        e = expo.value
+        if e > 64:
+            raise Unsupported("huge constant exponent")
+        result = tape.const(1, w)
+        b = _node(tape, base)
+        for bit in reversed(range(max(1, e.bit_length()))):
+            result = tape.emit(OP_MUL, w, result, result)
+            if (e >> bit) & 1:
+                result = tape.emit(OP_MUL, w, result, b)
+        return result
+    if base.is_const and base.value != 0 and (base.value & (base.value - 1)) == 0:
+        # (2^k)^e == 1 << (k*e), but k*e must be computed WITHOUT wrapping:
+        # guard on e < ceil(w/k) (above which the true result is 0); inside
+        # the guard k*e < w so the w-bit multiply is exact.
+        k = base.value.bit_length() - 1
+        if k == 0:  # base == 1
+            return tape.const(1, w)
+        bound = (w + k - 1) // k
+        e_node = _node(tape, expo)
+        e_small = tape.emit(OP_ULT, 1, e_node, tape.const(bound, w))
+        shift = (
+            tape.emit(OP_MUL, w, tape.const(k, w), e_node)
+            if k != 1
+            else e_node
+        )
+        shifted = tape.emit(OP_SHL, w, tape.const(1, w), shift)
+        return tape.emit(OP_ITE, w, e_small, shifted, tape.const(0, w))
+    raise Unsupported("bvexp with symbolic base and exponent")
+
+
+def _add_congruence(tape: _Tape, pairs: List[Tuple[List[int], int]]):
+    """For every pair of sites: args equal => results equal."""
+    for i in range(len(pairs)):
+        for j in range(i + 1, len(pairs)):
+            args_i, var_i = pairs[i]
+            args_j, var_j = pairs[j]
+            eqs = [
+                tape.emit(OP_EQ, 1, x, y) for x, y in zip(args_i, args_j)
+            ]
+            all_eq = eqs[0]
+            for e in eqs[1:]:
+                all_eq = tape.emit(OP_AND, 1, all_eq, e)
+            out_eq = tape.emit(OP_EQ, 1, var_i, var_j)
+            na = tape.emit(OP_NOT, 1, all_eq)
+            tape.roots.append(tape.emit(OP_OR, 1, na, out_eq))
+
+
+def serialize(conjuncts: Sequence[Term]) -> _Tape:
+    tape = _Tape()
+    for t in terms.topo_order(conjuncts):
+        node = _serialize_node(tape, t)
+        if node is not None:
+            tape.node_of[t.tid] = node
+    tape.roots.extend(_node(tape, c) for c in conjuncts)
+    for sites in tape.selects.values():
+        _add_congruence(tape, [([idx], var) for idx, var, _ in sites])
+    if tape.keccaks:
+        _add_congruence(tape, [([inp], var) for inp, var, _ in tape.keccaks])
+    for sites in tape.applies.values():
+        _add_congruence(tape, sites)
+    return tape
+
+
+# ---------------------------------------------------------------------------
+# Model reconstruction
+# ---------------------------------------------------------------------------
+
+
+def _rebuild_assignment(tape: _Tape, model: bytes) -> Assignment:
+    """Parse packed VAR bits, then resolve array/UF sites in topo order.
+
+    Tape order IS topo order of the original DAG, so by the time a select's
+    value is installed every sub-select inside its index expression has
+    already been written into the ArrayValue backing — concrete evaluation
+    of the index under the partial assignment is exact.
+    """
+    values: List[int] = []
+    off = 0
+    for op, width, *_ in tape.records:
+        if op != OP_VAR:
+            continue
+        nbytes = (width + 7) // 8
+        values.append(int.from_bytes(model[off : off + nbytes], "little"))
+        off += nbytes
+    asg = Assignment()
+    deferred = []  # (kind, payload, value) resolved in tape order
+    for (meta, value) in zip(tape.var_meta, values):
+        kind = meta[0]
+        if kind == "scalar":
+            t = meta[1]
+            asg.scalars[t] = bool(value) if t.sort is terms.BOOL else value
+        else:
+            deferred.append((meta, value))
+    for meta, value in deferred:
+        kind = meta[0]
+        if kind == "select":
+            _, arr, idx_term = meta
+            idx_val = evaluate([idx_term], asg)[idx_term]
+            asg.arrays.setdefault(arr, ArrayValue()).backing[idx_val] = value
+        elif kind == "apply":
+            t = meta[1]
+            arg_vals = tuple(evaluate([x], asg)[x] for x in t.args)
+            asg.ufs[(t.aux, arg_vals)] = value
+        # keccak: intentionally NOT installed — validation recomputes real
+        # hashes; a model relying on a fake hash value must fail validation
+    return asg
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def solve(
+    conjuncts: Sequence[Term], timeout_s: float
+) -> Tuple[str, Optional[Assignment]]:
+    """Exact solve; returns (status, assignment-or-None).
+
+    SAT models are reconstructed but NOT validated here — the caller owns
+    validation (mythril_tpu/smt/solver.py re-checks with concrete_eval).
+    """
+    lib = _load()
+    if lib is None or timeout_s <= 0:
+        return UNKNOWN, None
+    try:
+        tape = serialize(conjuncts)
+    except Unsupported as e:
+        log.debug("native tier: %s", e)
+        return UNKNOWN, None
+
+    rec = np.asarray(tape.records, dtype=np.int32).reshape(-1)
+    consts = np.frombuffer(bytes(tape.consts) or b"\x00", dtype=np.uint8)
+    roots = np.asarray(tape.roots, dtype=np.int32)
+    model_size = sum(
+        (w + 7) // 8 for op, w, *_ in tape.records if op == OP_VAR
+    )
+    model = np.zeros(max(1, model_size), dtype=np.uint8)
+
+    status = lib.bb_solve(
+        rec.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(tape.records),
+        consts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(consts),
+        roots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(roots),
+        float(timeout_s),
+        model.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(model),
+    )
+    if status == 0:
+        return UNSAT, None
+    if status != 1:
+        return UNKNOWN, None
+    try:
+        return SAT, _rebuild_assignment(tape, model.tobytes())
+    except Exception as e:  # reconstruction must never crash the solver
+        log.debug("native model reconstruction failed: %s", e)
+        return UNKNOWN, None

@@ -67,7 +67,22 @@ def keccak_f1600(lanes):
 
 
 def keccak256(data: bytes) -> bytes:
-    """Ethereum's keccak256 (rate 1088, capacity 512, pad 0x01)."""
+    """Ethereum's keccak256 (rate 1088, capacity 512, pad 0x01).
+
+    Dispatches to the native C++ implementation when built
+    (mythril_tpu/native/keccak.py); ``keccak256_py`` is the portable
+    fallback and the differential oracle for both accelerated paths."""
+    from mythril_tpu.native import keccak as native_keccak
+
+    if native_keccak.available():
+        digest = native_keccak.keccak256(data)
+        if digest is not None:
+            return digest
+    return keccak256_py(data)
+
+
+def keccak256_py(data: bytes) -> bytes:
+    """Pure-Python keccak256 (reference oracle)."""
     rate = 136  # bytes
     # pad10*1 with Keccak domain byte 0x01
     padded = bytearray(data)

@@ -411,13 +411,20 @@ def _device_backend_requested() -> bool:
 def _evaluate_candidates_device(compiled, candidates):
     """One dispatch over all candidates; mesh-sharded when devices allow.
 
-    With >1 attached device the candidate batch spreads over the full
-    frontier mesh (mythril_tpu/parallel) — the data-parallel production path;
-    single-chip falls through to the plain batched evaluator.
+    With >1 attached device the per-conjunction compiled path spreads the
+    candidate batch over the full frontier mesh (mythril_tpu/parallel); the
+    tape VM ships fixed-bucket shapes so its single dispatch is already the
+    production path on one chip.
     """
     import jax
 
-    if jax.device_count() > 1 and len(candidates) >= 16:
+    from mythril_tpu.ops.tape_vm import TapeCompiled
+
+    if (
+        not isinstance(compiled, TapeCompiled)
+        and jax.device_count() > 1
+        and len(candidates) >= 16
+    ):
         from mythril_tpu.parallel import evaluate_batch_sharded
 
         return evaluate_batch_sharded(compiled, candidates)
@@ -425,11 +432,21 @@ def _evaluate_candidates_device(compiled, candidates):
 
 
 def _try_compile_device(conjuncts: Sequence[Term]):
-    """Compile for batched device evaluation, or None (unsupported op /
-    lowering failure — the host path handles everything)."""
-    try:
-        from mythril_tpu.ops import lowering
+    """Compile for batched device evaluation, or None (host handles all).
 
+    The tape VM is the primary path: the interpreter program is compiled
+    once per shape bucket, so a fresh conjunction costs only tensor packing.
+    DAGs it cannot express fall back to the per-conjunction lowering (its
+    own XLA compile per distinct conjunction — the expensive legacy path),
+    and anything else falls through to the host evaluator.
+    """
+    try:
+        from mythril_tpu.ops import lowering, tape_vm
+
+        try:
+            return tape_vm.compile_tape(conjuncts)
+        except tape_vm.TapeUnsupported as e:
+            log.debug("tape VM unsupported (%s); per-conjunction fallback", e)
         return lowering.compile_cached(conjuncts)
     except Exception as e:
         log.debug("device lowering unavailable for query (%s): %s", type(e).__name__, e)
@@ -754,7 +771,16 @@ def solve_conjunction(
 
         if bitblast.available():
             stats.cdcl_calls += 1
-            status, asg = bitblast.solve(conjuncts, deadline - time.time())
+            budget = deadline - time.time()
+            if compiled is not None:
+                # device-path queries may have burned the deadline on an XLA
+                # compile (first bucket in a cold process); that warm-up cost
+                # is not this query's fault — guarantee the exact tier a
+                # minimal slice instead of silently disabling it with a
+                # nonpositive timeout.  Host-only queries keep strict
+                # wall-clock discipline (mutation pruner's 500ms etc.).
+                budget = max(1.0, budget)
+            status, asg = bitblast.solve(conjuncts, budget)
             stats.solver_time += time.time() - t0
             if status == SAT and asg is not None and check_asg(asg):
                 _model_cache.remember(cache_key, SAT, asg)

