@@ -8,11 +8,14 @@ calldata for both.  Recall is asserted — the run only counts if the
 Unprotected-Selfdestruct issue (SWC-106) is actually found with a valid
 2-step transaction sequence.
 
-Metric: explored states per second with the batched device probe
-(`probe_backend="jax"`); ``vs_baseline`` is the speedup over the identical
-run with the host big-int probe (`probe_backend="host"`), the stand-in for
-the reference's CPU solver path — the mounted reference itself cannot run
-here (no z3 wheel in the image; see BASELINE.md).
+Metric: explored states per second in the PRODUCTION configuration
+(`probe_backend="auto"`: the latency-aware hybrid that dispatches a query to
+the TPU tape-VM probe only past the host/device break-even, keeps the host
+big-int evaluator for cheap queries, and backs both with the native CDCL
+tier); ``vs_baseline`` is the speedup over the identical run forced to the
+host-only probe (`probe_backend="host"`), the stand-in for the reference's
+CPU solver path — the mounted reference itself cannot run here (no z3 wheel
+in the image; see BASELINE.md).
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -100,13 +103,27 @@ def check_recall(issues) -> None:
 
 
 def main() -> None:
+    # the "auto" backend gates on JAX_PLATFORMS without initializing jax; on
+    # machines where the TPU is autodetected but the env var is unset, pin it
+    # so the measured configuration actually exercises the device hybrid
+    import os
+
+    if not os.environ.get("JAX_PLATFORMS", "").startswith(("tpu", "axon")):
+        try:
+            import jax
+
+            if jax.default_backend() in ("tpu", "axon"):
+                os.environ["JAX_PLATFORMS"] = jax.default_backend()
+        except Exception:
+            pass
+
     # warm-up + baseline: host big-int probe (the CPU solver path)
     sym_h, issues_h, wall_h = run_analysis("host")
     check_recall(issues_h)
     base_rate = sym_h.laser.total_states / wall_h
 
-    # measured configuration: batched device probe
-    sym_d, issues_d, wall_d = run_analysis("jax")
+    # measured configuration: production hybrid (device past break-even)
+    sym_d, issues_d, wall_d = run_analysis("auto")
     check_recall(issues_d)
     rate = sym_d.laser.total_states / wall_d
 
@@ -115,7 +132,7 @@ def main() -> None:
             {
                 "metric": "killbilly_2tx_states_per_sec",
                 "value": round(rate, 2),
-                "unit": "states/sec (device probe, exploit recall asserted)",
+                "unit": "states/sec (production hybrid probe, exploit recall asserted)",
                 "vs_baseline": round(rate / base_rate, 3),
             }
         )

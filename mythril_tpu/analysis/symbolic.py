@@ -57,6 +57,8 @@ class SymExecWrapper:
         run_analysis_modules: bool = True,
         enable_coverage_strategy: bool = False,
         custom_modules_directory: str = "",
+        checkpoint_path: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         if isinstance(address, str):
             address = int(address, 16)
@@ -104,6 +106,9 @@ class SymExecWrapper:
             requires_statespace=requires_statespace,
         )
 
+        self.laser.checkpoint_path = checkpoint_path or args.checkpoint_path
+        self._resume_from = resume_from or args.resume_from
+
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound=loop_bound)
 
@@ -145,7 +150,9 @@ class SymExecWrapper:
             )
 
         # execute (creation vs runtime, reference symbolic.py:168-220)
-        if isinstance(contract, (bytes, bytearray)):
+        if self._resume_from:
+            self._exec_resumed(address)
+        elif isinstance(contract, (bytes, bytearray)):
             # raw runtime bytecode
             from mythril_tpu.frontend.disassembler import Disassembly
 
@@ -170,6 +177,24 @@ class SymExecWrapper:
         self.nodes = self.laser.nodes
         self.edges = self.laser.edges
         self._parse_calls()
+
+    def _exec_resumed(self, address: int) -> None:
+        """Continue a checkpointed run: reload the frontier and hand the
+        engine the restored open states (LaserEVM.resume owns the framing)."""
+        from mythril_tpu.support.checkpoint import load_checkpoint
+
+        completed, open_states, saved_address = load_checkpoint(
+            self._resume_from, dynamic_loader=self.laser.dynamic_loader
+        )
+        if saved_address is not None:
+            address = saved_address
+        log.info(
+            "resuming from %s: %d transactions done, %d open states",
+            self._resume_from,
+            completed,
+            len(open_states),
+        )
+        self.laser.resume(open_states, completed, address)
 
     def _exec_creation(self, contract, world_state: WorldState) -> None:
         from mythril_tpu.core.transaction import symbolic as sym_tx

@@ -78,6 +78,11 @@ class LaserEVM:
         self.time: Optional[float] = None
         self.executed_transactions = False
 
+        # frontier checkpointing (SURVEY.md §5.4): snapshot path + number of
+        # transactions a resumed run has already completed
+        self.checkpoint_path: Optional[str] = None
+        self.resume_offset: int = 0
+
         # hook registries
         self._hooks: Dict[str, List[Callable]] = {t: [] for t in LASER_HOOK_TYPES}
         self._pre_hooks: Dict[str, List[Callable]] = defaultdict(list)
@@ -151,18 +156,44 @@ class LaserEVM:
 
         self._fire("stop_sym_exec")
 
+    def resume(
+        self, open_states: List[WorldState], completed_transactions: int, address: int
+    ) -> None:
+        """Continue from a checkpointed frontier: same start/stop framing as
+        ``sym_exec`` but seeded with restored open states and skipping the
+        transactions a previous run already completed."""
+        self._fire("start_sym_exec")
+        time_handler.start_execution(self.execution_timeout)
+        self.time = time.time()
+        self.open_states = open_states
+        self.resume_offset = completed_transactions
+        self._execute_transactions(address)
+        self._fire("stop_sym_exec")
+
     def _execute_transactions(self, address: int) -> None:
-        """Symbolic-tx loop: each round reseeds from surviving open states."""
+        """Symbolic-tx loop: each round reseeds from surviving open states.
+
+        When ``checkpoint_path`` is set, the surviving frontier is snapshot
+        to disk after every completed transaction (the recovery story the
+        reference lacks, SURVEY.md §5.4); ``resume_offset`` counts
+        transactions already completed by a resumed run.
+        """
         from mythril_tpu.core.transaction import symbolic as sym_tx
 
         self.executed_transactions = True
-        for i in range(self.transaction_count):
+        for i in range(self.resume_offset, self.transaction_count):
             if not self.open_states:
                 break
-            # prune unreachable open states before the next round
+            # prune unreachable open states before the next round (batched:
+            # one device sweep over all open world states)
             if not args.sparse_pruning:
+                from mythril_tpu.smt.solver import check_satisfiable_batch
+
+                flags = check_satisfiable_batch(
+                    [s.constraints.get_all_raw() for s in self.open_states]
+                )
                 self.open_states = [
-                    s for s in self.open_states if s.constraints.is_possible
+                    s for s, ok in zip(self.open_states, flags) if ok
                 ]
             if not self.open_states:
                 break
@@ -174,6 +205,18 @@ class LaserEVM:
             self._fire("start_sym_trans")
             sym_tx.execute_message_call(self, address)
             self._fire("stop_sym_trans")
+            if self.checkpoint_path:
+                from mythril_tpu.support.checkpoint import save_checkpoint
+
+                try:
+                    save_checkpoint(
+                        self.checkpoint_path,
+                        i + 1,
+                        self.open_states,
+                        target_address=address,
+                    )
+                except Exception as e:  # snapshots are best-effort
+                    log.warning("checkpoint write failed: %s", e)
 
     # ------------------------------------------------------------------
     # main loop (reference svm.py:261-304)
@@ -196,15 +239,33 @@ class LaserEVM:
             if self.requires_statespace:
                 self.manage_cfg(op_code, new_states)
             if not args.sparse_pruning:
-                new_states = [
-                    s for s in new_states if s.world_state.constraints.is_possible
-                ]
+                new_states = self._prune_unsatisfiable(new_states)
             self.work_list.extend(new_states)
             self.total_states += len(new_states)
             if track_gas and not new_states:
                 final_states.append(global_state)
         self._fire("stop_exec")
         return final_states if track_gas else None
+
+    @staticmethod
+    def _prune_unsatisfiable(states: List[GlobalState]) -> List[GlobalState]:
+        """Drop successors with unsatisfiable path conditions.
+
+        Multiple successors (JUMPI siblings) are decided in ONE batched
+        solver sweep — on device backends that is a single tape-VM dispatch
+        for the whole fork instead of one per state (SURVEY.md §7: the
+        pruner as a batched masked reduction over the frontier).
+        """
+        if not states:
+            return states
+        if len(states) == 1:
+            return states if states[0].world_state.constraints.is_possible else []
+        from mythril_tpu.smt.solver import check_satisfiable_batch
+
+        flags = check_satisfiable_batch(
+            [s.world_state.constraints.get_all_raw() for s in states]
+        )
+        return [s for s, ok in zip(states, flags) if ok]
 
     # ------------------------------------------------------------------
     # single-instruction execution (reference svm.py:336-449)

@@ -41,6 +41,15 @@ class TxIdManager:
     def restart_counter(self) -> None:
         self._next = 0
 
+    def ensure_above(self, used_id: str) -> None:
+        """Advance past an id restored from a checkpoint: new transactions
+        must never reuse a restored id (symbols are named by tx id and
+        interned, so a collision aliases variables across transactions)."""
+        try:
+            self._next = max(self._next, int(used_id))
+        except ValueError:
+            pass
+
 
 tx_id_manager = TxIdManager()
 

@@ -40,6 +40,8 @@ class AnalyzerArgs:
     enable_iprof: bool = False
     enable_coverage_strategy: bool = False
     custom_modules_directory: str = ""
+    checkpoint_file: Optional[str] = None
+    resume_from: Optional[str] = None
 
 
 class MythrilAnalyzer:
@@ -75,6 +77,8 @@ class MythrilAnalyzer:
         args.parallel_solving = cmd_args.parallel_solving
         args.solver_log = cmd_args.solver_log
         args.enable_iprof = cmd_args.enable_iprof
+        args.checkpoint_path = getattr(cmd_args, "checkpoint_file", None)
+        args.resume_from = getattr(cmd_args, "resume_from", None)
 
     def _sym_exec(self, contract, run_analysis_modules: bool = True) -> SymExecWrapper:
         from mythril_tpu.support.loader import DynLoader

@@ -105,6 +105,14 @@ def _add_analysis_options(parser) -> None:
     group.add_argument(
         "--custom-modules-directory", default="", help="directory with additional detection modules"
     )
+    group.add_argument(
+        "--checkpoint-file",
+        help="snapshot the open-state frontier to this file after every transaction",
+    )
+    group.add_argument(
+        "--resume-from",
+        help="resume an interrupted analysis from a frontier checkpoint file",
+    )
 
 
 def _add_output_options(parser) -> None:
@@ -263,6 +271,8 @@ def _build_analyzer(parsed, query_signature: bool = False):
         enable_iprof=parsed.enable_iprof,
         enable_coverage_strategy=parsed.enable_coverage_strategy,
         custom_modules_directory=parsed.custom_modules_directory,
+        checkpoint_file=getattr(parsed, "checkpoint_file", None),
+        resume_from=getattr(parsed, "resume_from", None),
     )
     analyzer = MythrilAnalyzer(
         disassembler, cmd_args, strategy=parsed.strategy, address=address

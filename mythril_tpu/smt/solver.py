@@ -393,7 +393,7 @@ class _Seeder:
 
 
 def _device_backend_requested() -> bool:
-    """Whether candidate evaluation should run through the JAX lowering.
+    """Whether candidate evaluation may run through the device path at all.
 
     ``args.probe_backend``: "host" never, "jax" always, "auto" only when the
     process is already pointed at an accelerator platform (checked via env so
@@ -406,6 +406,33 @@ def _device_backend_requested() -> bool:
         return True
     platforms = os.environ.get("JAX_PLATFORMS", "")
     return platforms.startswith(("tpu", "axon"))
+
+
+_topo_size_cache: Dict[frozenset, int] = {}
+
+
+def _device_worthwhile(conjuncts: Sequence[Term], n_candidates: int) -> bool:
+    """Latency-aware dispatch decision for the "auto" backend.
+
+    A device dispatch costs a fixed round trip (milliseconds locally, ~100ms
+    over a tunnel); the host evaluator costs ~DAG-size x candidates Python
+    ops.  Small queries are faster on host, large batches over big DAGs on
+    device — "auto" takes whichever side of the break-even the query lands
+    on ("jax" always dispatches, which is what the raw-device benchmark
+    measures).  Threshold tunable via ``args.device_probe_threshold``.
+    """
+    backend = getattr(global_args, "probe_backend", "auto")
+    if backend == "jax":
+        return True
+    key = frozenset(c.tid for c in conjuncts)
+    size = _topo_size_cache.get(key)
+    if size is None:
+        size = len(terms.topo_order(list(conjuncts)))
+        if len(_topo_size_cache) > 8192:
+            _topo_size_cache.clear()
+        _topo_size_cache[key] = size
+    threshold = getattr(global_args, "device_probe_threshold", 150_000)
+    return size * max(1, n_candidates) >= threshold
 
 
 def _evaluate_candidates_device(compiled, candidates):
@@ -467,6 +494,127 @@ class ProbeConfig:
         self.rng_seed = rng_seed
 
 
+class CandidateGenerator:
+    """Directed candidate construction for one conjunction.
+
+    Wraps the _Seeder hint machinery (constant pools, bit hints, or-group
+    overlays, symbolic-equality links) behind a simple ``generate(n)`` so
+    both the single-query probe (solve_conjunction) and the frontier-batched
+    prune (check_satisfiable_batch) build candidates the same way.
+    """
+
+    def __init__(self, conjuncts: Sequence[Term], config: "ProbeConfig"):
+        self.conjuncts = list(conjuncts)
+        free = terms.free_vars(self.conjuncts)
+        self.scalar_vars = [v for v in free if v.op == "var"]
+        self.array_vars = [v for v in free if v.op == "array_var"]
+        self.seeder = _Seeder(self.conjuncts)
+        self.rng = random.Random(config.rng_seed)
+        self._fill_iter = _interesting_fills(
+            self.rng, self.seeder.const_pool, 256
+        )
+        self._index = 0
+
+    def generate(
+        self, n: int, deadline: Optional[float] = None
+    ) -> List[Assignment]:
+        out = []
+        for _ in range(n):
+            if out and deadline is not None and time.time() > deadline:
+                break
+            out.append(self._build(self._index))
+            self._index += 1
+        return out
+
+    def _build(self, candidate_index: int) -> Assignment:
+        s = self.seeder.overlay_for(candidate_index)
+        rng = self.rng
+        use_weak = candidate_index % 3 != 2  # periodically explore past weak hints
+        asg = Assignment()
+        for v in self.scalar_vars:
+            if v.sort is terms.BOOL:
+                asg.scalars[v] = s.bool_hints.get(v, rng.random() < 0.5)
+                continue
+            hint = s.scalar_hints.get(v)
+            if use_weak and v in s.weak_vals and (hint is None or hint.known == 0):
+                fill = s.weak_vals[v]
+            else:
+                fill = next(self._fill_iter)
+            if hint is not None:
+                asg.scalars[v] = hint.complete(mask(fill, v.width))
+            else:
+                asg.scalars[v] = mask(fill, v.width)
+        for av in self.array_vars:
+            backing = {
+                idx: val for (a, idx), val in s.array_hints.items() if a is av
+            }
+            asg.arrays[av] = ArrayValue(backing, default=0)
+        self._apply_links(s, asg)
+        return asg
+
+    @staticmethod
+    def _link_target(t):
+        """(kind, ...) if ``t`` is directly assignable in a candidate."""
+        if t.op == "var" and t.sort is not terms.BOOL:
+            return ("var", t)
+        if t.op == "select" and t.args[0].op == "array_var" and t.args[1].is_const:
+            return ("sel", t.args[0], t.args[1].value)
+        return None
+
+    def _apply_links(self, s, asg: Assignment) -> None:
+        """Copy evaluated values across symbolic equalities (two passes).
+
+        Direction-aware: the determined side (strong hint, array hint, or a
+        value written by an earlier link) is the source; the undetermined
+        side is the target.  Both-determined pairs are left alone so
+        constant-derived hints are never clobbered.
+        """
+        if not s.link_pairs:
+            return
+        written: set = set()
+        link_target = self._link_target
+
+        def determined(t) -> Optional[tuple]:
+            info = link_target(t)
+            if info is None:
+                return ("expr",)  # complex expression: can only be a source
+            if info[0] == "var":
+                hint = s.scalar_hints.get(info[1])
+                if (hint is not None and hint.known) or info[1] in written:
+                    return ("set",)
+                return None
+            key = (info[1], info[2])
+            if key in s.array_hints or key in written:
+                return ("set",)
+            return None
+
+        def write(target, value) -> None:
+            info = link_target(target)
+            if info[0] == "var":
+                asg.scalars[info[1]] = value
+                written.add(info[1])
+            else:
+                asg.arrays.setdefault(info[1], ArrayValue()).backing[info[2]] = value
+                written.add((info[1], info[2]))
+
+        for _ in range(2):
+            for a, b in s.link_pairs:
+                da, db = determined(a), determined(b)
+                if da is not None and db is None:
+                    target, source = b, a
+                elif db is not None and da is None:
+                    target, source = a, b
+                elif da is None and db is None:
+                    target, source = a, b  # arbitrary: propagate left from right
+                else:
+                    continue  # both determined (or both unassignable)
+                try:
+                    value = evaluate([source], asg)[source]
+                except NotImplementedError:
+                    continue
+                write(target, value)
+
+
 def _interesting_fills(rng: random.Random, pool: Sequence[int], width: int):
     """Yield an endless stream of fill values for unknown bits."""
     yield 0
@@ -487,6 +635,129 @@ def _interesting_fills(rng: random.Random, pool: Sequence[int], width: int):
             yield v
         else:
             yield rng.getrandbits(width)
+
+
+def _fast_path(
+    conjuncts: Sequence[Term], use_cache: bool = True
+) -> Tuple[Optional[Tuple[str, Optional["Assignment"]]], List[Term], frozenset]:
+    """Cheap solving tiers shared by single-query and batched entry points.
+
+    Tier 0 (structural fold), result memo, and tier 0.5 (recent-model
+    replay).  Returns ``(resolved, folded_conjuncts, cache_key)`` where
+    ``resolved`` is the final (status, assignment) when a cheap tier decided
+    the query, else None.
+    """
+    folded = terms.land(*conjuncts)
+    if folded.op == "const":
+        if folded.aux:
+            return (SAT, Assignment()), [], frozenset()
+        return (UNSAT, None), [], frozenset()
+    conj = list(folded.args) if folded.op == "and" else [folded]
+    key = frozenset(c.tid for c in conj)
+    if use_cache:
+        hit = _model_cache.results.get(key)
+        if hit is not None:
+            return hit, conj, key
+        for asg in reversed(_model_cache.models):
+            try:
+                vals = evaluate(conj, asg)
+            except Exception:
+                continue
+            if all(vals[c] for c in conj):
+                SolverStatistics().probe_hits += 1
+                _model_cache.remember(key, SAT, asg)
+                return (SAT, asg), conj, key
+    return None, conj, key
+
+
+def check_satisfiable_batch(
+    constraint_sets: Sequence[Sequence[Term]],
+    config: Optional["ProbeConfig"] = None,
+) -> List[bool]:
+    """Frontier-batched pruning: decide many path conditions in one sweep.
+
+    This is SURVEY.md §7's "pruning = batched sat-probing kernel": the engine
+    hands over EVERY successor state's constraint set per iteration; cheap
+    tiers (structural fold, result memo, recent-model reuse) resolve most,
+    and the residue is merged into ONE tape-VM program — sibling states share
+    their whole path prefix, so the interned DAGs overlap almost entirely —
+    evaluated over a shared candidate pool in a single device dispatch.
+    Anything still undecided falls back to the full per-set probe stack.
+
+    Returns one bool per input set (True = keep the state).
+    """
+    config = config or ProbeConfig(
+        max_rounds=2, candidates_per_round=24, timeout_ms=2000
+    )
+    results: List[Optional[bool]] = [None] * len(constraint_sets)
+    pending: List[Tuple[int, List[Term], frozenset]] = []
+
+    for i, cs in enumerate(constraint_sets):
+        resolved, conj, key = _fast_path(cs)
+        if resolved is not None:
+            results[i] = resolved[0] == SAT
+        else:
+            pending.append((i, conj, key))
+
+    # The merged-dispatch path pays off only when it amortizes over enough
+    # sets: a 2-sibling JUMPI fork is cheaper through the per-set stack
+    # (model-cache reuse solves the prefix; repair + CDCL finish the flip),
+    # measured 3x faster on the killbilly benchmark.  Open-state sweeps and
+    # wide forks (>= 3 pending) take the single merged dispatch.
+    if (
+        len(pending) >= 3
+        and _device_backend_requested()
+        and _device_worthwhile(
+            [c for _i, conj, _k in pending for c in conj],
+            config.max_rounds * config.candidates_per_round,
+        )
+    ):
+        try:
+            _batch_probe_device(pending, results, config)
+        except Exception as e:
+            log.debug("batched device prune failed (%s); per-set fallback", e)
+
+    for i, conj, _key in pending:
+        if results[i] is None:
+            status, _ = solve_conjunction(conj, config)
+            results[i] = status == SAT
+    return [bool(r) for r in results]
+
+
+def _batch_probe_device(pending, results, config) -> None:
+    """One tape-VM dispatch deciding several constraint sets at once."""
+    from mythril_tpu.ops import tape_vm
+
+    # union of conjuncts in deterministic first-seen order
+    all_conjs: List[Term] = []
+    col_of: Dict[int, int] = {}
+    for _i, conj, _key in pending:
+        for c in conj:
+            if c.tid not in col_of:
+                col_of[c.tid] = len(all_conjs)
+                all_conjs.append(c)
+    compiled = tape_vm.compile_tape(all_conjs)
+
+    per_set = max(8, (config.max_rounds * config.candidates_per_round) // max(1, len(pending)))
+    candidates: List[Assignment] = []
+    for _i, conj, _key in pending:
+        candidates.extend(CandidateGenerator(conj, config).generate(per_set))
+    truth = compiled.evaluate_batch(candidates)  # [B, C_total]
+
+    for i, conj, key in pending:
+        cols = [col_of[c.tid] for c in conj]
+        rows = truth[:, cols].all(axis=1)
+        for b in rows.nonzero()[0]:
+            asg = candidates[int(b)]
+            try:
+                vals = evaluate(conj, asg)
+            except Exception:
+                continue
+            if all(vals[c] for c in conj):
+                SolverStatistics().probe_hits += 1
+                _model_cache.remember(key, SAT, asg)
+                results[i] = True
+                break
 
 
 class _ModelCache:
@@ -542,125 +813,16 @@ def solve_conjunction(
     stats.query_count += 1
     t0 = time.time()
 
-    # tier 0: structural
-    folded = terms.land(*conjuncts)
-    if folded.op == "const":
-        if folded.aux:
-            return SAT, Assignment()
-        return UNSAT, None
-    conjuncts = list(folded.args) if folded.op == "and" else [folded]
+    # tiers 0 + memo + 0.5 (shared with check_satisfiable_batch)
+    resolved, conjuncts, cache_key = _fast_path(conjuncts, use_cache)
+    if resolved is not None:
+        return resolved
 
-    cache_key = frozenset(c.tid for c in conjuncts)
-    if use_cache:
-        hit = _model_cache.results.get(cache_key)
-        if hit is not None:
-            return hit
-
-        # tier 0.5: a recent model may already satisfy this query
-        # (incremental reuse across the shared path prefix)
-        for asg in reversed(_model_cache.models):
-            try:
-                vals = evaluate(conjuncts, asg)
-            except Exception:
-                continue
-            if all(vals[c] for c in conjuncts):
-                stats.probe_hits += 1
-                _model_cache.remember(cache_key, SAT, asg)
-                return SAT, asg
-
-    free = terms.free_vars(conjuncts)
-    scalar_vars = [v for v in free if v.op == "var"]
-    array_vars = [v for v in free if v.op == "array_var"]
-
-    seeder = _Seeder(conjuncts)
-    rng = random.Random(config.rng_seed)
+    gen = CandidateGenerator(conjuncts, config)
+    scalar_vars = gen.scalar_vars
+    seeder = gen.seeder
+    rng = gen.rng
     deadline = t0 + config.timeout_ms / 1000.0
-
-    def build_assignment(fill_iter, candidate_index: int) -> Assignment:
-        s = seeder.overlay_for(candidate_index)
-        use_weak = candidate_index % 3 != 2  # periodically explore past weak hints
-        asg = Assignment()
-        for v in scalar_vars:
-            if v.sort is terms.BOOL:
-                asg.scalars[v] = s.bool_hints.get(v, rng.random() < 0.5)
-                continue
-            hint = s.scalar_hints.get(v)
-            if use_weak and v in s.weak_vals and (hint is None or hint.known == 0):
-                fill = s.weak_vals[v]
-            else:
-                fill = next(fill_iter)
-            if hint is not None:
-                asg.scalars[v] = hint.complete(mask(fill, v.width))
-            else:
-                asg.scalars[v] = mask(fill, v.width)
-        for av in array_vars:
-            backing = {
-                idx: val for (a, idx), val in s.array_hints.items() if a is av
-            }
-            asg.arrays[av] = ArrayValue(backing, default=0)
-        apply_links(s, asg)
-        return asg
-
-    def _link_target(t):
-        """(kind, ...) if ``t`` is directly assignable in a candidate."""
-        if t.op == "var" and t.sort is not terms.BOOL:
-            return ("var", t)
-        if t.op == "select" and t.args[0].op == "array_var" and t.args[1].is_const:
-            return ("sel", t.args[0], t.args[1].value)
-        return None
-
-    def apply_links(s, asg: Assignment) -> None:
-        """Copy evaluated values across symbolic equalities (two passes).
-
-        Direction-aware: the determined side (strong hint, array hint, or a
-        value written by an earlier link) is the source; the undetermined side
-        is the target.  Both-determined pairs are left alone so constant-
-        derived hints are never clobbered.
-        """
-        if not s.link_pairs:
-            return
-        written: set = set()
-
-        def determined(t) -> Optional[tuple]:
-            """None if assignable-and-unset, else a truthy marker."""
-            info = _link_target(t)
-            if info is None:
-                return ("expr",)  # complex expression: can only be a source
-            if info[0] == "var":
-                hint = s.scalar_hints.get(info[1])
-                if (hint is not None and hint.known) or info[1] in written:
-                    return ("set",)
-                return None
-            key = (info[1], info[2])
-            if key in s.array_hints or key in written:
-                return ("set",)
-            return None
-
-        def write(target, value) -> None:
-            info = _link_target(target)
-            if info[0] == "var":
-                asg.scalars[info[1]] = value
-                written.add(info[1])
-            else:
-                asg.arrays.setdefault(info[1], ArrayValue()).backing[info[2]] = value
-                written.add((info[1], info[2]))
-
-        for _ in range(2):
-            for a, b in s.link_pairs:
-                da, db = determined(a), determined(b)
-                if da is not None and db is None:
-                    target, source = b, a
-                elif db is not None and da is None:
-                    target, source = a, b
-                elif da is None and db is None:
-                    target, source = a, b  # arbitrary: propagate left from right
-                else:
-                    continue  # both determined (or both unassignable)
-                try:
-                    value = evaluate([source], asg)[source]
-                except NotImplementedError:
-                    continue
-                write(target, value)
 
     def check_asg(asg: Assignment) -> bool:
         vals = evaluate(conjuncts, asg)
@@ -669,19 +831,17 @@ def solve_conjunction(
     candidates: List[Assignment] = []
     if extra_seeds:
         candidates.extend(extra_seeds)
-    fill_iter = _interesting_fills(rng, seeder.const_pool, 256)
     total = config.max_rounds * config.candidates_per_round
-    for i in range(total):
-        if i > 0 and time.time() > deadline:
-            break
-        candidates.append(build_assignment(fill_iter, i))
+    candidates.extend(gen.generate(total, deadline))
 
     # Device batching only when the deadline still has room: a cache-miss
     # compile is the dominant cost, and a blown solver_timeout breaks the
     # engine's wall-clock budgeting.
     compiled = (
         _try_compile_device(conjuncts)
-        if _device_backend_requested() and time.time() < deadline
+        if _device_backend_requested()
+        and _device_worthwhile(conjuncts, len(candidates))
+        and time.time() < deadline
         else None
     )
 

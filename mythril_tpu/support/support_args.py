@@ -36,6 +36,12 @@ class Args:
     probe_rounds: int = 4
     probe_backend: str = "auto"  # auto | host | jax
     keccak_backend: str = "auto"  # auto | jax | pallas (pallas on TPU when auto)
+    # auto-backend break-even: dispatch to device when DAG-size x candidates
+    # exceeds this (host evaluation below it is faster than one round trip)
+    device_probe_threshold: int = 150_000
+    # frontier checkpointing
+    checkpoint_path: Optional[str] = None
+    resume_from: Optional[str] = None
 
 
 args = Args()

@@ -1,0 +1,66 @@
+"""Frontier-batched satisfiability checks (solver.check_satisfiable_batch)."""
+
+import pytest
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.solver import check_satisfiable_batch, clear_model_cache
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_model_cache()
+    yield
+    clear_model_cache()
+
+
+@pytest.fixture
+def jax_backend():
+    from mythril_tpu.support.support_args import args as global_args
+
+    prev = global_args.probe_backend
+    global_args.probe_backend = "jax"
+    yield
+    global_args.probe_backend = prev
+
+
+def _sibling_sets():
+    """A JUMPI-fork shape: shared prefix, contradictory last conjunct."""
+    x = terms.var("bx", 256)
+    y = terms.var("by", 256)
+    prefix = [
+        terms.eq(terms.add(x, y), terms.const(500, 256)),
+        terms.ult(x, terms.const(100, 256)),
+    ]
+    cond = terms.ult(y, terms.const(450, 256))
+    return [prefix + [cond], prefix + [terms.lnot(cond)]]
+
+
+def test_sibling_fork_both_satisfiable():
+    flags = check_satisfiable_batch(_sibling_sets())
+    # x<100 & x+y==500 -> y in (400, 500]; both y<450 and y>=450 reachable
+    assert flags == [True, True]
+
+
+def test_structural_contradiction_pruned():
+    x = terms.var("bcx", 256)
+    sets = [
+        [terms.ult(x, terms.const(5, 256))],
+        [terms.false()],
+        [terms.true()],
+    ]
+    assert check_satisfiable_batch(sets) == [True, False, True]
+
+
+def test_batch_matches_individual_checks():
+    from mythril_tpu.smt.solver import SAT, solve_conjunction
+
+    sets = _sibling_sets()
+    batch = check_satisfiable_batch(sets)
+    clear_model_cache()
+    individual = [solve_conjunction(s)[0] == SAT for s in sets]
+    assert batch == individual
+
+
+def test_device_backend_batch(jax_backend):
+    flags = check_satisfiable_batch(_sibling_sets())
+    assert flags == [True, True]
