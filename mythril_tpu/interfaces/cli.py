@@ -128,6 +128,7 @@ def _add_output_options(parser) -> None:
         "--statespace-json", metavar="JSON_FILE", help="export statespace json"
     )
     parser.add_argument("--enable-physics", action="store_true", help="graph physics")
+    parser.add_argument("--epic", action="store_true", help=argparse.SUPPRESS)
 
 
 def create_parser() -> argparse.ArgumentParser:
@@ -413,7 +414,13 @@ def execute_command(parsed) -> None:
             "text": report.as_text(),
             "markdown": report.as_markdown(),
         }
-        print(outputs[parsed.outform])
+        rendered = outputs[parsed.outform]
+        if getattr(parsed, "epic", False) and parsed.outform in ("text", "markdown"):
+            from mythril_tpu.interfaces.epic import print_epic
+
+            print_epic(rendered)
+        else:
+            print(rendered)
         return
 
     raise CriticalError(f"unknown command {command}")

@@ -8,6 +8,7 @@ and the candidate-assignment batch of each path shards over the ``cand``
 axis; XLA inserts the ICI collectives for the cross-device score reductions.
 """
 
+from mythril_tpu.parallel.corpus import run_corpus, shard_corpus, shard_identity
 from mythril_tpu.parallel.mesh import (
     CAND_AXIS,
     PATH_AXIS,
@@ -21,6 +22,9 @@ from mythril_tpu.parallel.probe import (
 )
 
 __all__ = [
+    "run_corpus",
+    "shard_corpus",
+    "shard_identity",
     "CAND_AXIS",
     "PATH_AXIS",
     "make_frontier_mesh",

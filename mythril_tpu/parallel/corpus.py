@@ -1,0 +1,99 @@
+"""Multi-host corpus sharding — the DCN scaling axis.
+
+SURVEY.md §5.8: ICI carries the candidate/frontier axes inside one host
+(mythril_tpu/parallel/mesh.py); ACROSS hosts the natural unit is a whole
+contract, because contracts share nothing (no collectives needed — the DCN
+traffic is just result gathering).  Each host analyzes a deterministic
+round-robin slice of the corpus; shard identity comes from the JAX
+distributed runtime when initialized, or from ``MYTHRIL_TPU_SHARD``/
+``MYTHRIL_TPU_NUM_SHARDS`` for process-per-host launches without it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
+
+
+def shard_identity() -> Tuple[int, int]:
+    """(shard index, shard count) for this process.
+
+    Order of precedence: explicit env override, the JAX distributed runtime
+    (multi-host pod), else single-shard.  A malformed or out-of-range env
+    identity is a launcher bug that must fail loudly — an index outside the
+    count would silently drop that host's slice of the corpus.
+    """
+    env_idx = os.environ.get("MYTHRIL_TPU_SHARD")
+    env_cnt = os.environ.get("MYTHRIL_TPU_NUM_SHARDS")
+    if env_idx is not None and env_cnt is not None:
+        try:
+            index, count = int(env_idx), int(env_cnt)
+        except ValueError as e:
+            raise ValueError(
+                "MYTHRIL_TPU_SHARD / MYTHRIL_TPU_NUM_SHARDS must be integers, "
+                f"got {env_idx!r} / {env_cnt!r}"
+            ) from e
+        if not (count >= 1 and 0 <= index < count):
+            raise ValueError(
+                f"shard identity out of range: index {index}, count {count}"
+            )
+        return index, count
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index(), jax.process_count()
+    except Exception:
+        pass
+    return 0, 1
+
+
+def _resolve_identity(
+    index: Optional[int], count: Optional[int]
+) -> Tuple[int, int]:
+    """Both-or-neither: explicit (index, count) pair, else shard_identity()."""
+    if (index is None) != (count is None):
+        raise ValueError("pass both index and count, or neither")
+    if index is None:
+        return shard_identity()
+    if not (count >= 1 and 0 <= index < count):
+        raise ValueError(f"shard identity out of range: index {index}, count {count}")
+    return index, count
+
+
+def shard_corpus(
+    items: Sequence, index: Optional[int] = None, count: Optional[int] = None
+) -> List:
+    """Deterministic round-robin slice of ``items`` for one shard.
+
+    Round-robin (not contiguous blocks) so corpora sorted by size spread
+    their heavy tail across hosts.
+    """
+    index, count = _resolve_identity(index, count)
+    if count <= 1:
+        return list(items)
+    return [item for i, item in enumerate(items) if i % count == index]
+
+
+def run_corpus(
+    paths: Sequence[str],
+    analyze_one: Callable[[str], object],
+    index: Optional[int] = None,
+    count: Optional[int] = None,
+) -> List[Tuple[str, object]]:
+    """Analyze this shard's slice; one contract's failure never kills the
+    sweep (graceful degradation, the reference's fire_lasers discipline)."""
+    idx, cnt = _resolve_identity(index, count)
+    mine = shard_corpus(list(paths), idx, cnt)
+    log.info("corpus shard %d/%d: %d of %d contracts", idx, cnt, len(mine), len(paths))
+    results: List[Tuple[str, object]] = []
+    for path in mine:
+        try:
+            results.append((path, analyze_one(path)))
+        except Exception as e:  # noqa: BLE001 - per-contract isolation
+            log.exception("corpus item %s failed", path)
+            results.append((path, e))
+    return results

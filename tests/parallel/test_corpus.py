@@ -1,0 +1,38 @@
+"""Multi-host corpus sharding (mythril_tpu/parallel/corpus.py)."""
+
+import os
+
+from mythril_tpu.parallel import run_corpus, shard_corpus, shard_identity
+
+
+def test_round_robin_partition_is_exact():
+    items = [f"c{i}" for i in range(10)]
+    shards = [shard_corpus(items, index=i, count=3) for i in range(3)]
+    # disjoint and complete
+    flat = [x for s in shards for x in s]
+    assert sorted(flat) == sorted(items)
+    assert len(set(flat)) == len(items)
+    # round-robin spreads the head evenly
+    assert shards[0][0] == "c0" and shards[1][0] == "c1" and shards[2][0] == "c2"
+
+
+def test_single_shard_returns_all():
+    assert shard_corpus([1, 2, 3], index=0, count=1) == [1, 2, 3]
+
+
+def test_identity_env_override(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_SHARD", "2")
+    monkeypatch.setenv("MYTHRIL_TPU_NUM_SHARDS", "5")
+    assert shard_identity() == (2, 5)
+
+
+def test_run_corpus_isolates_failures():
+    def analyze(path):
+        if path == "bad":
+            raise RuntimeError("boom")
+        return f"ok:{path}"
+
+    results = dict(run_corpus(["a", "bad", "b"], analyze, index=0, count=1))
+    assert results["a"] == "ok:a"
+    assert results["b"] == "ok:b"
+    assert isinstance(results["bad"], RuntimeError)
