@@ -77,17 +77,17 @@ class SymExecWrapper:
 
         requires_statespace = compulsory_statespace or run_analysis_modules
 
-        # warm the device probe's interpreter BEFORE engine timers start:
-        # the one-time XLA compile must not eat the creation-tx timeout.
-        # Best-effort like every device entry point — a dead tunnel or
-        # missing backend degrades to the host path, never aborts analysis.
-        from mythril_tpu.smt.solver import _device_backend_requested
-
-        if _device_backend_requested():
+        # forced device backend: compile the probe interpreter BEFORE engine
+        # timers start (the one-time XLA compile must not eat the creation-tx
+        # timeout); best-effort — failure degrades to the host path.  The
+        # "auto" backend instead warms lazily in the background when a query
+        # first crosses the device break-even (solver._try_compile_device)
+        # and uses the host path until ready.
+        if args.probe_backend == "jax":
             try:
-                from mythril_tpu.ops.tape_vm import warmup
+                from mythril_tpu.ops import tape_vm
 
-                warmup()
+                tape_vm.warmup()
             except Exception as e:
                 log.warning("device probe warmup failed (host fallback): %s", e)
 

@@ -40,6 +40,12 @@ def setup_concrete_initial_state(concrete_data: ConcreteData) -> WorldState:
 
 def concrete_execution(concrete_data: ConcreteData) -> Tuple[WorldState, List]:
     """Replay all steps; returns (initial world state, [(pc, tx_id)] trace)."""
+    from mythril_tpu.core.transaction.transaction_models import tx_id_manager
+
+    # the trace pairs (pc, tx_id) and flip_branches restarts the id counter
+    # before the symbolic re-execution — the concrete replay must start from
+    # the same ids or a second concolic run in one process never matches
+    tx_id_manager.restart_counter()
     world_state = setup_concrete_initial_state(concrete_data)
     laser_evm = LaserEVM(
         execution_timeout=1000,

@@ -28,6 +28,7 @@ storage-slot hashing produces).  Unsupported structure raises
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -39,6 +40,8 @@ import mythril_tpu
 from mythril_tpu.ops import bitvec as bv
 
 mythril_tpu.enable_persistent_compilation_cache()
+
+log = logging.getLogger(__name__)
 from mythril_tpu.ops.keccak_jax import keccak256
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.terms import Term
@@ -449,6 +452,17 @@ class TapeCompiled:
         self.array_vars = program.array_vars
 
     def evaluate_batch(self, assignments) -> np.ndarray:
+        args, (T, V, A, K, R) = self.pack_args(assignments)
+        truth = _run_tape(*args, T=T, V=V, A=A, K=K, R=R)
+        return np.asarray(truth)[: len(assignments), : len(self.conjuncts)]
+
+    def pack_args(self, assignments) -> Tuple[tuple, tuple]:
+        """Candidate assignments -> the _run_tape input tensors + shape.
+
+        Exposed separately so callers embedding the interpreter in larger
+        jitted programs (driver entry points, mesh-sharded dispatch) can
+        build the exact argument tuple the compiled program expects.
+        """
         t = self.tensors
         T, V, A, K, R = t["shape"]
         B_real = len(assignments)
@@ -489,7 +503,7 @@ class TapeCompiled:
                     )
                     tab_valid[b, ai, ki] = True
 
-        truth = _run_tape(
+        args = (
             jnp.asarray(leaf_vals),
             jnp.asarray(tab_idx),
             jnp.asarray(tab_val),
@@ -499,13 +513,14 @@ class TapeCompiled:
             jnp.asarray(t["a2"]), jnp.asarray(t["aux"]),
             jnp.asarray(t["wmask"]),
             jnp.asarray(t["root_rows"]), jnp.asarray(t["root_valid"]),
-            T=T, V=V, A=A, K=K, R=R,
         )
-        out = np.asarray(truth)[:B_real, : len(self.conjuncts)]
-        return out
+        return args, (T, V, A, K, R)
 
 
-_warmed = False
+import threading
+
+_warm_lock = threading.Lock()
+_warm_state = "cold"  # cold | warming | ready
 
 
 def warmup() -> None:
@@ -513,14 +528,17 @@ def warmup() -> None:
 
     Engine timers (notably the 10s creation-transaction timeout, reference
     cli default) must not pay the one-time interpreter compile; callers that
-    are about to start timed symbolic execution on a device backend invoke
-    this first.  With the persistent compilation cache enabled this is
-    seconds on a warm machine and a no-op within a process.
+    are about to start timed symbolic execution with a FORCED device backend
+    invoke this synchronously.  The "auto" backend instead calls
+    ``ensure_warming`` (non-blocking) and keeps using the host path until
+    ``interpreter_ready`` — the compile can take tens of seconds over a
+    tunneled TPU, which small workloads would never amortize.
     """
-    global _warmed
-    if _warmed:
-        return
-    _warmed = True
+    global _warm_state
+    with _warm_lock:
+        if _warm_state == "ready":
+            return
+        _warm_state = "warming"
     from mythril_tpu.smt import terms
     from mythril_tpu.smt.concrete_eval import Assignment
 
@@ -532,6 +550,37 @@ def warmup() -> None:
     # (-> bucket 64), get_model dispatches 192 (-> bucket 256)
     for b in _BATCH_BUCKETS:
         compiled.evaluate_batch([asg] * b)
+    with _warm_lock:
+        _warm_state = "ready"
+
+
+def ensure_warming() -> None:
+    """Kick the interpreter compile on a background thread (idempotent).
+
+    Deliberately NOT a daemon thread: interpreter shutdown while an XLA
+    compile is in flight aborts the process ("FATAL: exception not
+    rethrown"), so exit waits for the compile to finish.  Callers only kick
+    this once a query has actually crossed the device break-even, so short
+    host-only runs never start (or wait for) it.
+    """
+    with _warm_lock:
+        if _warm_state != "cold":
+            return
+
+    def _guarded():
+        global _warm_state
+        try:
+            warmup()
+        except Exception:  # failed compile: allow a later retry
+            log.warning("background tape-VM warmup failed; will retry", exc_info=True)
+            with _warm_lock:
+                _warm_state = "cold"
+
+    threading.Thread(target=_guarded, daemon=False, name="tape-vm-warmup").start()
+
+
+def interpreter_ready() -> bool:
+    return _warm_state == "ready"
 
 
 _CACHE: Dict[tuple, TapeCompiled] = {}

@@ -28,6 +28,11 @@ def shard_identity() -> Tuple[int, int]:
     """
     env_idx = os.environ.get("MYTHRIL_TPU_SHARD")
     env_cnt = os.environ.get("MYTHRIL_TPU_NUM_SHARDS")
+    if (env_idx is None) != (env_cnt is None):
+        raise ValueError(
+            "set BOTH MYTHRIL_TPU_SHARD and MYTHRIL_TPU_NUM_SHARDS (or "
+            "neither) — a partial override would silently duplicate the sweep"
+        )
     if env_idx is not None and env_cnt is not None:
         try:
             index, count = int(env_idx), int(env_cnt)

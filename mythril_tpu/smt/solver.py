@@ -470,6 +470,12 @@ def _try_compile_device(conjuncts: Sequence[Term]):
     try:
         from mythril_tpu.ops import lowering, tape_vm
 
+        if getattr(global_args, "probe_backend", "auto") != "jax":
+            # auto: never BLOCK a query on the one-time interpreter compile —
+            # kick it in the background and stay on the host path until ready
+            if not tape_vm.interpreter_ready():
+                tape_vm.ensure_warming()
+                return None
         try:
             return tape_vm.compile_tape(conjuncts)
         except tape_vm.TapeUnsupported as e:
@@ -637,6 +643,62 @@ def _interesting_fills(rng: random.Random, pool: Sequence[int], width: int):
             yield rng.getrandbits(width)
 
 
+def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
+    """Partition a conjunction into variable-independent buckets.
+
+    Reference parity: the IndependenceSolver's shared-variable union-find
+    (mythril/laser/smt/solver/independence_solver.py:38-83).  Buckets share
+    no free variables, so they are solved separately and their models merged
+    — each bucket is a smaller probe/CDCL instance, and per-bucket memoization
+    means an engine query that extends one bucket leaves every other bucket's
+    cached verdict intact.  Deterministic: buckets ordered by first conjunct.
+    """
+    conjuncts = list(conjuncts)
+    parent: Dict[int, int] = {}
+
+    def find(x: int) -> int:
+        while parent.setdefault(x, x) != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: int, b: int) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    # uninterpreted functions couple buckets through congruence even without
+    # shared variables (two buckets may assign f the same input different
+    # outputs) — do not split in their presence.  keccak is safe: it
+    # evaluates concretely, so per-bucket models are globally consistent.
+    # ONE scan over the whole (shared) DAG — per-conjunct scans would
+    # re-traverse the common path prefix once per conjunct.
+    if any(t.op == "apply" for t in terms.topo_order(conjuncts)):
+        return [list(conjuncts)]
+
+    conj_vars = []
+    for ci, c in enumerate(conjuncts):
+        vars_ = terms.free_vars([c])
+        conj_vars.append(vars_)
+        anchor = None
+        for v in vars_:
+            if anchor is None:
+                anchor = v.tid
+            else:
+                union(anchor, v.tid)
+
+    buckets: Dict[Optional[int], List[Term]] = {}
+    order: List[Optional[int]] = []
+    for ci, c in enumerate(conjuncts):
+        vars_ = conj_vars[ci]
+        key = find(vars_[0].tid) if vars_ else None
+        if key not in buckets:
+            buckets[key] = []
+            order.append(key)
+        buckets[key].append(c)
+    return [buckets[k] for k in order]
+
+
 def _fast_path(
     conjuncts: Sequence[Term], use_cache: bool = True
 ) -> Tuple[Optional[Tuple[str, Optional["Assignment"]]], List[Term], frozenset]:
@@ -728,6 +790,11 @@ def _batch_probe_device(pending, results, config) -> None:
     """One tape-VM dispatch deciding several constraint sets at once."""
     from mythril_tpu.ops import tape_vm
 
+    if getattr(global_args, "probe_backend", "auto") != "jax":
+        if not tape_vm.interpreter_ready():
+            tape_vm.ensure_warming()
+            return  # host fallback until the interpreter is compiled
+
     # union of conjuncts in deterministic first-seen order
     all_conjs: List[Term] = []
     col_of: Dict[int, int] = {}
@@ -817,6 +884,39 @@ def solve_conjunction(
     resolved, conjuncts, cache_key = _fast_path(conjuncts, use_cache)
     if resolved is not None:
         return resolved
+
+    # tier 0.75: independence split (reference independence_solver.py:86-152)
+    # — disjoint-variable buckets solve separately and merge their models
+    buckets = independence_split(conjuncts)
+    if len(buckets) > 1:
+        whole_deadline = t0 + config.timeout_ms / 1000.0
+        merged = Assignment()
+        for bucket in buckets:
+            # buckets share ONE query budget: each recursion gets only the
+            # parent's remaining time, never a fresh full timeout
+            remaining_ms = max(1, int((whole_deadline - time.time()) * 1000))
+            sub_config = ProbeConfig(
+                max_rounds=config.max_rounds,
+                candidates_per_round=config.candidates_per_round,
+                timeout_ms=remaining_ms,
+                rng_seed=config.rng_seed,
+            )
+            status, asg = solve_conjunction(
+                bucket, sub_config, extra_seeds=extra_seeds, use_cache=use_cache
+            )
+            if status == UNSAT:
+                if use_cache:
+                    _model_cache.remember(cache_key, UNSAT, None)
+                return UNSAT, None
+            if status != SAT or asg is None:
+                return UNKNOWN, None
+            merged.scalars.update(asg.scalars)
+            merged.arrays.update(asg.arrays)
+            merged.ufs.update(asg.ufs)
+        stats.probe_hits += 1
+        if use_cache:
+            _model_cache.remember(cache_key, SAT, merged)
+        return SAT, merged
 
     gen = CandidateGenerator(conjuncts, config)
     scalar_vars = gen.scalar_vars

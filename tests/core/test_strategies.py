@@ -1,0 +1,122 @@
+"""Search-strategy behavior (reference test strategy §4 item 5).
+
+Covers worklist ordering (DFS/BFS), beam width, weighted-random coverage,
+and bounded-loops pruning via trace hashes.
+"""
+
+import pytest
+
+from mythril_tpu.core.state.annotation import StateAnnotation
+from mythril_tpu.core.strategy.basic import (
+    BeamSearch,
+    BreadthFirstSearchStrategy,
+    DepthFirstSearchStrategy,
+    ReturnWeightedRandomStrategy,
+)
+
+
+class _FakeState:
+    def __init__(self, depth=0, importance=None):
+        self.mstate = type("M", (), {"depth": depth})()
+        self._importance = importance
+        self.annotations = []
+        self._annotations = self.annotations  # GlobalState-compatible alias
+
+    @property
+    def world_state(self):
+        return self
+
+    def get_annotations(self, kind):
+        return [a for a in self.annotations if isinstance(a, kind)]
+
+
+def test_dfs_pops_newest_first():
+    work = [_FakeState(depth=i) for i in range(3)]
+    strat = DepthFirstSearchStrategy(list(work), max_depth=10)
+    out = list(strat)
+    assert [s.mstate.depth for s in out] == [2, 1, 0]
+
+
+def test_bfs_pops_oldest_first():
+    work = [_FakeState(depth=i) for i in range(3)]
+    strat = BreadthFirstSearchStrategy(list(work), max_depth=10)
+    out = list(strat)
+    assert [s.mstate.depth for s in out] == [0, 1, 2]
+
+
+def test_max_depth_prunes():
+    work = [_FakeState(depth=5), _FakeState(depth=99), _FakeState(depth=7)]
+    strat = DepthFirstSearchStrategy(list(work), max_depth=50)
+    out = list(strat)
+    assert all(s.mstate.depth < 50 for s in out)
+    assert len(out) == 2
+
+
+def test_beam_search_keeps_most_important():
+    class Importance(StateAnnotation):
+        def __init__(self, v):
+            self.v = v
+
+        @property
+        def search_importance(self):
+            return self.v
+
+    states = []
+    for v in [1, 9, 5, 7, 3]:
+        s = _FakeState()
+        s.annotations.append(Importance(v))
+        states.append(s)
+    strat = BeamSearch(list(states), max_depth=10, beam_width=2)
+    out = list(strat)
+    kept = sorted(a.v for s in out for a in s.annotations)
+    assert len(out) == 2
+    assert kept == [7, 9]
+
+
+def test_weighted_random_visits_everything():
+    work = [_FakeState(depth=i) for i in range(6)]
+    strat = ReturnWeightedRandomStrategy(list(work), max_depth=10)
+    out = list(strat)
+    assert len(out) == 6
+
+
+def test_bounded_loops_strategy_caps_repetition():
+    """End-to-end: a tight unbounded loop terminates via the loop bound."""
+    import time
+
+    from mythril_tpu.core.state.account import Account
+    from mythril_tpu.core.state.world_state import WorldState
+    from mythril_tpu.core.svm import LaserEVM
+    from mythril_tpu.core.strategy.extensions.bounded_loops import (
+        BoundedLoopsStrategy,
+    )
+    from mythril_tpu.core.transaction.concolic import execute_message_call
+    from mythril_tpu.frontend.disassembler import Disassembly
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.support.time_handler import time_handler
+
+    # JUMPDEST; PUSH1 0; JUMP -> infinite loop
+    code = "5b600056"
+    ws = WorldState()
+    acct = Account(0xAA, code=Disassembly(code))
+    ws.put_account(acct)
+    acct.set_balance(0)
+
+    time_handler.start_execution(30)
+    evm = LaserEVM(max_depth=10_000)
+    evm.extend_strategy(BoundedLoopsStrategy, loop_bound=3)
+    evm.open_states = [ws]
+    evm.time = time.time()
+    execute_message_call(
+        evm,
+        callee_address=symbol_factory.BitVecVal(0xAA, 256),
+        caller_address=symbol_factory.BitVecVal(0xBB, 256),
+        origin_address=symbol_factory.BitVecVal(0xBB, 256),
+        code=code,
+        gas_limit=10**7,
+        data=[],
+        gas_price=0,
+        value=0,
+    )
+    # the loop bound must terminate the run well under the depth cap
+    assert evm.executed_instruction_count < 200

@@ -1,0 +1,82 @@
+"""Concolic subsystem end-to-end: trace replay + JUMPI branch flipping.
+
+Reference parity: the §3.5 flow (myth concolic input.json --branches N) —
+replay a concrete transaction, then negate the path constraint at a chosen
+JUMPI and solve for inputs that take the other branch.
+"""
+
+import json
+
+# if (calldataload(0) == 5) storage[0] = 1 else storage[0] = 2
+#   0: PUSH1 0; CALLDATALOAD; PUSH1 5; EQ; PUSH1 0x0f; JUMPI   <- pc 8
+#   9: PUSH1 2; PUSH1 0; SSTORE; STOP
+#  15: JUMPDEST; PUSH1 1; PUSH1 0; SSTORE; STOP
+BRANCH_CODE = "600035600514600f576002600055005b600160005500"
+JUMPI_ADDRESS = 8
+CONTRACT = "0x" + "ab" * 20
+CALLER = "0x" + "cd" * 20
+
+
+def _concrete_data(input_hex: str) -> dict:
+    return {
+        "initialState": {
+            "accounts": {
+                CONTRACT: {
+                    "balance": "0x0",
+                    "code": "0x" + BRANCH_CODE,
+                    "nonce": 0,
+                    "storage": {},
+                }
+            }
+        },
+        "steps": [
+            {
+                "address": CONTRACT,
+                "blockCoinbase": "0x" + "00" * 20,
+                "blockDifficulty": "0x0",
+                "blockGasLimit": "0x989680",
+                "blockNumber": "0x1",
+                "blockTime": "0x1",
+                "gasLimit": "0x100000",
+                "gasPrice": "0x0",
+                "input": input_hex,
+                "origin": CALLER,
+                "value": "0x0",
+            }
+        ],
+    }
+
+
+def test_branch_flip_produces_input_for_other_side():
+    from mythril_tpu.concolic.concolic_execution import concolic_execution
+
+    # concrete run takes the != 5 branch; flipping the JUMPI must synthesize
+    # calldata whose first word equals 5
+    data = _concrete_data("0x" + "00" * 32)
+    results = concolic_execution(data, [JUMPI_ADDRESS], solver_timeout=30000)
+    assert len(results) == 1
+    flipped_input = results[0]["steps"][0]["input"]
+    word = int(flipped_input[2:66].ljust(64, "0"), 16)
+    assert word == 5
+
+
+def test_flip_from_taken_branch():
+    from mythril_tpu.concolic.concolic_execution import concolic_execution
+
+    # concrete run TAKES the jump (input word == 5); the flip must find a
+    # word != 5
+    data = _concrete_data("0x" + "00" * 31 + "05")
+    results = concolic_execution(data, [JUMPI_ADDRESS], solver_timeout=30000)
+    assert len(results) == 1
+    flipped_input = results[0]["steps"][0]["input"]
+    word = int(flipped_input[2:66].ljust(64, "0"), 16)
+    assert word != 5
+
+
+def test_concrete_execution_records_trace():
+    from mythril_tpu.concolic.find_trace import concrete_execution
+
+    init_state, trace = concrete_execution(_concrete_data("0x" + "00" * 32))
+    pcs = [pc for pc, _tx in trace]
+    # the fallthrough path executes the SSTORE at pc index 9..13 region
+    assert len(pcs) > 5

@@ -94,5 +94,8 @@ def test_graft_entry_single_chip_and_dryrun():
 
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
-    assert out.shape == (64, 6)
+    # tape-VM output: [batch, root-bucket] — the flagship conjunction's 6
+    # conjuncts occupy the first columns of the padded root axis
+    assert out.shape[0] == 64
+    assert out.shape[1] >= 6
     graft.dryrun_multichip(jax.device_count())

@@ -1,0 +1,88 @@
+"""Independence splitting (solver.independence_split + solve integration).
+
+Reference parity: tests/laser/smt/independece_solver_test.py — bucketing by
+shared variables, and joint-model correctness of the merged result.
+"""
+
+import pytest
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import evaluate
+from mythril_tpu.smt.solver import (
+    SAT,
+    clear_model_cache,
+    independence_split,
+    solve_conjunction,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    clear_model_cache()
+    yield
+    clear_model_cache()
+
+
+def test_disjoint_variables_split():
+    a, b = terms.var("ia", 256), terms.var("ib", 256)
+    c, d = terms.var("ic", 256), terms.var("id", 256)
+    conj = [
+        terms.ult(a, b),
+        terms.eq(c, terms.const(5, 256)),
+        terms.ult(terms.const(1, 256), d),
+    ]
+    buckets = independence_split(conj)
+    assert [len(x) for x in buckets] == [1, 1, 1]
+
+
+def test_shared_variable_joins_buckets():
+    a, b, c = terms.var("ja", 256), terms.var("jb", 256), terms.var("jc", 256)
+    conj = [
+        terms.ult(a, b),       # {a, b}
+        terms.ult(b, c),       # {b, c} -> joins the first
+        terms.eq(terms.var("jd", 256), terms.const(0, 256)),  # {d} separate
+    ]
+    buckets = independence_split(conj)
+    assert sorted(len(x) for x in buckets) == [1, 2]
+
+
+def test_transitive_chain_single_bucket():
+    vs = [terms.var(f"ch{i}", 32) for i in range(5)]
+    conj = [terms.ult(vs[i], vs[i + 1]) for i in range(4)]
+    assert len(independence_split(conj)) == 1
+
+
+def test_uninterpreted_functions_block_splitting():
+    x, y = terms.var("ux", 256), terms.var("uy", 256)
+    conj = [
+        terms.eq(terms.apply_func("g", 256, x), terms.const(1, 256)),
+        terms.eq(terms.apply_func("g", 256, y), terms.const(2, 256)),
+    ]
+    assert len(independence_split(conj)) == 1
+
+
+def test_solve_merges_bucket_models():
+    a, b = terms.var("ma", 256), terms.var("mb", 256)
+    c = terms.var("mc", 64)
+    conj = [
+        terms.eq(terms.add(a, b), terms.const(1000, 256)),
+        terms.ult(a, terms.const(10, 256)),
+        terms.eq(terms.mul(c, terms.const(3, 64)), terms.const(21, 64)),
+    ]
+    status, asg = solve_conjunction(conj)
+    assert status == SAT
+    vals = evaluate(conj, asg)
+    assert all(vals[x] for x in conj)
+    assert asg.scalars[c] == 7
+
+
+def test_unsat_bucket_fails_whole_query():
+    a = terms.var("na", 256)
+    b = terms.var("nb", 8)
+    conj = [
+        terms.ult(a, terms.const(100, 256)),
+        # parity contradiction, decided exactly by the native tier
+        terms.eq(terms.mul(b, terms.const(2, 8)), terms.const(1, 8)),
+    ]
+    status, _ = solve_conjunction(conj)
+    assert status != SAT
