@@ -83,7 +83,7 @@ def run_analysis(probe_backend: str):
         contract,
         address=0x0901D12E,
         strategy="bfs",
-        transaction_count=2,
+        transaction_count=3,
         execution_timeout=300,
         modules=["AccidentallyKillable"],
     )
@@ -117,20 +117,25 @@ def main() -> None:
         except Exception:
             pass
 
-    # warm-up + baseline: host big-int probe (the CPU solver path)
-    sym_h, issues_h, wall_h = run_analysis("host")
-    check_recall(issues_h)
-    base_rate = sym_h.laser.total_states / wall_h
-
-    # measured configuration: production hybrid (device past break-even)
-    sym_d, issues_d, wall_d = run_analysis("auto")
-    check_recall(issues_d)
-    rate = sym_d.laser.total_states / wall_d
+    # Single sub-second runs are dominated by scheduling/solver jitter, and
+    # back-to-back blocks drift with machine load — so the two
+    # configurations run INTERLEAVED three times each and report median
+    # rates (recall asserted on every run).  Baseline = host big-int probe
+    # (the CPU solver path); measured = production hybrid (device past the
+    # break-even).
+    rates = {"host": [], "auto": []}
+    for _ in range(3):
+        for backend in ("host", "auto"):
+            sym, issues, wall = run_analysis(backend)
+            check_recall(issues)
+            rates[backend].append(sym.laser.total_states / wall)
+    base_rate = sorted(rates["host"])[1]
+    rate = sorted(rates["auto"])[1]
 
     print(
         json.dumps(
             {
-                "metric": "killbilly_2tx_states_per_sec",
+                "metric": "killbilly_3tx_states_per_sec",
                 "value": round(rate, 2),
                 "unit": "states/sec (production hybrid probe, exploit recall asserted)",
                 "vs_baseline": round(rate / base_rate, 3),

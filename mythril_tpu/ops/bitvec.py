@@ -59,15 +59,22 @@ def mask_top(a: jnp.ndarray, width: int) -> jnp.ndarray:
 
 
 def from_ints(values: Union[int, Sequence[int]], width: int) -> np.ndarray:
-    """Python int(s) -> uint32 limb array [L] or [B, L]."""
+    """Python int(s) -> uint32 limb array [L] or [B, L].
+
+    Bulk conversion goes through ``int.to_bytes`` + ``np.frombuffer`` (C
+    speed); a per-limb Python loop was the host-side bottleneck when packing
+    thousands of probe candidates per dispatch."""
     scalar = isinstance(values, int)
     vals = [values] if scalar else list(values)
     L = nlimbs(width)
-    out = np.zeros((len(vals), L), np.uint32)
-    for b, v in enumerate(vals):
-        v &= (1 << width) - 1
-        for i in range(L):
-            out[b, i] = (v >> (LIMB_BITS * i)) & LIMB_MASK
+    nbytes = L * 2
+    mask_w = (1 << width) - 1
+    buf = b"".join((v & mask_w).to_bytes(nbytes, "little") for v in vals)
+    out = (
+        np.frombuffer(buf, dtype="<u2")
+        .reshape(len(vals), L)
+        .astype(np.uint32)
+    )
     return out[0] if scalar else out
 
 

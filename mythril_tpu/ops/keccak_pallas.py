@@ -131,17 +131,30 @@ def _kernel(rc_ref, st_ref, out_ref):
     out_ref[:] = st
 
 
+# lanes per grid step: the round body holds several (100, BT) temporaries in
+# VMEM; 1024 lanes keeps the scoped allocation well under the ~16MB limit
+# (observed: 4096 lanes in one block exceeds it)
+_LANE_TILE = 1024
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _permute_tile(tile: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
-    """Run keccak-f[1600] on a (100, B) tile (B a multiple of 128)."""
+    """Run keccak-f[1600] on a (100, B) tile (B a multiple of 128).
+
+    Large batches are tiled along the lane axis with a pallas grid so each
+    block's working set stays within scoped VMEM."""
+    B = tile.shape[1]
+    bt = min(B, _LANE_TILE)
+    grid = (B + bt - 1) // bt
     return pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct(tile.shape, jnp.uint32),
+        grid=(grid,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((100, bt), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((100, bt), lambda i: (0, i), memory_space=pltpu.VMEM),
         interpret=interpret,
     )(jnp.asarray(_RC_LIMBS), tile)
 

@@ -470,13 +470,14 @@ class TapeCompiled:
         if B is None:
             B = ((B_real + 255) // 256) * 256
 
+        # packing is bulk per column (one from_ints call over the whole
+        # batch) — per-candidate Python loops were the large-batch bottleneck
         leaf_vals = np.tile(t["leaf_consts"][None], (B, 1, 1))
         n_consts = t["n_consts"]
+        n = len(assignments)
         for vi, var in enumerate(self.program.leaf_vars):
-            row = n_consts + vi
-            for b, asg in enumerate(assignments):
-                val = asg.scalars.get(var, 0)
-                leaf_vals[b, row] = bv.from_ints(int(val), 256)
+            vals = [int(asg.scalars.get(var, 0)) for asg in assignments]
+            leaf_vals[:n, n_consts + vi] = bv.from_ints(vals, 256)
 
         tab_idx = np.zeros((B, A, K, L), np.uint32)
         tab_val = np.zeros((B, A, K, L), np.uint32)
@@ -490,18 +491,18 @@ class TapeCompiled:
                     for k in getattr(asg.arrays.get(av), "backing", {})
                 }
             )[:K]
-            key_rows = [bv.from_ints(int(k), 256) for k in keys]
-            for b, asg in enumerate(assignments):
-                arr = asg.arrays.get(av)
-                backing = arr.backing if arr is not None else {}
-                dflt = int(arr.default) if arr is not None else 0
-                tab_default[b, ai] = bv.from_ints(dflt, 256)
+            arrs = [asg.arrays.get(av) for asg in assignments]
+            defaults = [int(a.default) if a is not None else 0 for a in arrs]
+            tab_default[:n, ai] = bv.from_ints(defaults, 256)
+            if keys:
+                tab_idx[:, ai, : len(keys)] = bv.from_ints(keys, 256)[None]
+                tab_valid[:n, ai, : len(keys)] = True
                 for ki, k in enumerate(keys):
-                    tab_idx[b, ai, ki] = key_rows[ki]
-                    tab_val[b, ai, ki] = bv.from_ints(
-                        int(backing.get(k, dflt)), 256
-                    )
-                    tab_valid[b, ai, ki] = True
+                    vals = [
+                        int(a.backing.get(k, d)) if a is not None else 0
+                        for a, d in zip(arrs, defaults)
+                    ]
+                    tab_val[:n, ai, ki] = bv.from_ints(vals, 256)
 
         args = (
             jnp.asarray(leaf_vals),
@@ -521,24 +522,10 @@ import threading
 
 _warm_lock = threading.Lock()
 _warm_state = "cold"  # cold | warming | ready
+_warm_event = threading.Event()
 
 
-def warmup() -> None:
-    """Pre-compile the interpreter for the common (profile, batch) buckets.
-
-    Engine timers (notably the 10s creation-transaction timeout, reference
-    cli default) must not pay the one-time interpreter compile; callers that
-    are about to start timed symbolic execution with a FORCED device backend
-    invoke this synchronously.  The "auto" backend instead calls
-    ``ensure_warming`` (non-blocking) and keeps using the host path until
-    ``interpreter_ready`` — the compile can take tens of seconds over a
-    tunneled TPU, which small workloads would never amortize.
-    """
-    global _warm_state
-    with _warm_lock:
-        if _warm_state == "ready":
-            return
-        _warm_state = "warming"
+def _do_warmup_compiles() -> None:
     from mythril_tpu.smt import terms
     from mythril_tpu.smt.concrete_eval import Assignment
 
@@ -550,31 +537,75 @@ def warmup() -> None:
     # (-> bucket 64), get_model dispatches 192 (-> bucket 256)
     for b in _BATCH_BUCKETS:
         compiled.evaluate_batch([asg] * b)
-    with _warm_lock:
-        _warm_state = "ready"
+
+
+def _run_claimed_warmup() -> None:
+    """Body for a caller that already moved the state to 'warming'."""
+    global _warm_state
+    try:
+        _do_warmup_compiles()
+        with _warm_lock:
+            _warm_state = "ready"
+    except BaseException:
+        with _warm_lock:
+            _warm_state = "cold"  # allow a later retry
+        raise
+    finally:
+        _warm_event.set()
+
+
+def warmup() -> None:
+    """Pre-compile the interpreter for the common (profile, batch) buckets.
+
+    Engine timers (notably the 10s creation-transaction timeout, reference
+    cli default) must not pay the one-time interpreter compile; callers that
+    are about to start timed symbolic execution with a FORCED device backend
+    invoke this synchronously (waiting for an in-flight background warm-up
+    rather than duplicating it).  The "auto" backend instead calls
+    ``ensure_warming`` (non-blocking) and keeps using the host path until
+    ``interpreter_ready`` — the compile can take tens of seconds over a
+    tunneled TPU, which small workloads would never amortize.
+    """
+    global _warm_state
+    while True:
+        with _warm_lock:
+            if _warm_state == "ready":
+                return
+            if _warm_state == "cold":
+                _warm_state = "warming"
+                _warm_event.clear()
+                claimed = True
+            else:
+                claimed = False
+        if claimed:
+            _run_claimed_warmup()
+            return
+        _warm_event.wait()  # another thread is compiling; re-check after
 
 
 def ensure_warming() -> None:
     """Kick the interpreter compile on a background thread (idempotent).
 
+    The claim happens HERE under the lock (before the thread starts), so
+    back-to-back callers can never spawn duplicate compile threads.
     Deliberately NOT a daemon thread: interpreter shutdown while an XLA
     compile is in flight aborts the process ("FATAL: exception not
     rethrown"), so exit waits for the compile to finish.  Callers only kick
     this once a query has actually crossed the device break-even, so short
     host-only runs never start (or wait for) it.
     """
+    global _warm_state
     with _warm_lock:
         if _warm_state != "cold":
             return
+        _warm_state = "warming"
+        _warm_event.clear()
 
     def _guarded():
-        global _warm_state
         try:
-            warmup()
-        except Exception:  # failed compile: allow a later retry
+            _run_claimed_warmup()
+        except Exception:
             log.warning("background tape-VM warmup failed; will retry", exc_info=True)
-            with _warm_lock:
-                _warm_state = "cold"
 
     threading.Thread(target=_guarded, daemon=False, name="tape-vm-warmup").start()
 

@@ -654,10 +654,11 @@ def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
     cached verdict intact.  Deterministic: buckets ordered by first conjunct.
     """
     conjuncts = list(conjuncts)
-    parent: Dict[int, int] = {}
+    # union-find over CONJUNCT indices
+    parent = list(range(len(conjuncts)))
 
     def find(x: int) -> int:
-        while parent.setdefault(x, x) != x:
+        while parent[x] != x:
             parent[x] = parent[parent[x]]
             x = parent[x]
         return x
@@ -667,31 +668,42 @@ def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
         if ra != rb:
             parent[ra] = rb
 
-    # uninterpreted functions couple buckets through congruence even without
-    # shared variables (two buckets may assign f the same input different
-    # outputs) — do not split in their presence.  keccak is safe: it
+    # ONE global pass over the shared DAG: compute per-node "contains a free
+    # variable", and reject uninterpreted functions — they couple buckets
+    # through congruence even without shared variables (two buckets may
+    # assign f the same input different outputs).  keccak is safe: it
     # evaluates concretely, so per-bucket models are globally consistent.
-    # ONE scan over the whole (shared) DAG — per-conjunct scans would
-    # re-traverse the common path prefix once per conjunct.
-    if any(t.op == "apply" for t in terms.topo_order(conjuncts)):
-        return [list(conjuncts)]
+    dag = terms.topo_order(conjuncts)
+    has_var: Dict[int, bool] = {}
+    for t in dag:
+        if t.op == "apply":
+            return [conjuncts]
+        has_var[t.tid] = t.op in ("var", "array_var") or any(
+            has_var[a.tid] for a in t.args
+        )
 
-    conj_vars = []
+    # ONE ownership sweep: each variable-bearing node is claimed by the
+    # first conjunct to reach it; later conjuncts stop at claimed nodes and
+    # union with the owner, so every node is descended into at most once
+    # across ALL conjuncts (shared path prefixes are not re-traversed).
+    owner: Dict[int, int] = {}
     for ci, c in enumerate(conjuncts):
-        vars_ = terms.free_vars([c])
-        conj_vars.append(vars_)
-        anchor = None
-        for v in vars_:
-            if anchor is None:
-                anchor = v.tid
-            else:
-                union(anchor, v.tid)
+        stack = [c]
+        while stack:
+            t = stack.pop()
+            if not has_var[t.tid]:
+                continue
+            prev = owner.get(t.tid)
+            if prev is not None:
+                union(ci, prev)
+                continue
+            owner[t.tid] = ci
+            stack.extend(t.args)
 
     buckets: Dict[Optional[int], List[Term]] = {}
     order: List[Optional[int]] = []
     for ci, c in enumerate(conjuncts):
-        vars_ = conj_vars[ci]
-        key = find(vars_[0].tid) if vars_ else None
+        key = find(ci) if has_var[c.tid] else None
         if key not in buckets:
             buckets[key] = []
             order.append(key)
