@@ -28,19 +28,25 @@ from mythril_tpu.parallel.mesh import make_frontier_mesh, shard_probe_args
 def frontier_step(compiled: CompiledConjunction):
     """Build the jittable one-round frontier program for a conjunction shape.
 
-    Returns ``step(scalars, bools, array_tabs)`` expecting leading [P, B]
-    batch dims on every leaf, producing:
+    Returns ``step(scalars, bools, array_tabs, valid)`` expecting leading
+    [P, B] batch dims on every args leaf and the [P, B] ``valid`` mask from
+    ``pack_frontier``, producing:
       * ``scores``      [P, B] — satisfied-conjunct count per candidate,
+                                 ``-1`` in masked (padding) slots,
       * ``best_score``  [P]    — per-path max (cross-``cand`` reduction),
       * ``best_idx``    [P]    — argmax candidate per path,
-      * ``n_sat``       []     — global count of full models (cross-mesh).
+      * ``n_sat``       []     — global count of full models (cross-mesh),
+                                 padding excluded.
     """
     n_conj = len(compiled.conjuncts)
     raw = compiled.raw_fn
 
-    def step(scalars, bools, array_tabs):
+    def step(scalars, bools, array_tabs, valid):
         truth = raw(scalars, bools, array_tabs)  # [P, B, C] bool
         scores = truth.sum(axis=-1)  # [P, B]
+        # Padding rows (ragged frontier made rectangular) must never win
+        # the argmax nor count as models.
+        scores = jnp.where(valid, scores, -1)
         best_score = scores.max(axis=-1)  # [P]
         best_idx = jnp.argmax(scores, axis=-1)  # [P]
         n_sat = (scores == n_conj).sum()  # []
@@ -52,30 +58,48 @@ def frontier_step(compiled: CompiledConjunction):
 def pack_frontier(
     compiled: CompiledConjunction, assignments_per_path: Sequence[Sequence]
 ):
-    """Pack P lists of B assignments into stacked [P, B, ...] probe inputs.
+    """Pack P lists of assignments into stacked [P, B, ...] probe inputs.
 
     All paths share the conjunction DAG (SPMD requires one program); array
     tables take the union of keys across the whole frontier so every leaf is
-    rectangular.
+    rectangular.  Paths may carry different candidate counts: short paths are
+    padded to the longest by repeating their last candidate, and the returned
+    ``valid`` [P, B] mask marks the real rows.  Feed ``valid`` to the
+    ``frontier_step`` program so padding can't double-count in ``n_sat`` or
+    win ``best_idx``.
+
+    Returns ``(args_tree, valid)``.
     """
     P_ = len(assignments_per_path)
-    sizes = {len(a) for a in assignments_per_path}
-    if len(sizes) != 1:
-        raise ValueError("every path needs the same candidate count")
-    B = sizes.pop()
-    flat = [a for path in assignments_per_path for a in path]
+    counts = [len(a) for a in assignments_per_path]
+    if not counts or not all(counts):
+        raise ValueError("every path needs at least one candidate")
+    B = max(counts)
+    flat: List = []
+    for path in assignments_per_path:
+        flat.extend(path)
+        flat.extend([path[-1]] * (B - len(path)))
     scalars, bools, array_tabs = pack_assignments(compiled, flat)
 
     def unflatten(leaf):
         return leaf.reshape((P_, B) + leaf.shape[1:])
 
-    return jax.tree.map(unflatten, (scalars, bools, array_tabs))
+    valid = np.zeros((P_, B), dtype=bool)
+    for p, c in enumerate(counts):
+        valid[p, :c] = True
+    return jax.tree.map(unflatten, (scalars, bools, array_tabs)), valid
 
 
 def _pad_batch(args_tree, pad_to: int, batch: int):
-    """Pad the leading candidate dim by repeating the last row."""
+    """Pad the leading candidate dim by repeating the last row.
+
+    Returns ``(args_tree, valid)`` where ``valid`` [pad_to] marks real rows —
+    consumers reducing over the batch (n_sat, argmax) must apply it; slicing
+    ``[:batch]`` off a gathered result is the equivalent for element-wise use.
+    """
+    valid = np.arange(pad_to) < batch
     if pad_to == batch:
-        return args_tree
+        return args_tree, valid
 
     def pad(leaf):
         reps = np.concatenate(
@@ -83,7 +107,7 @@ def _pad_batch(args_tree, pad_to: int, batch: int):
         )
         return reps
 
-    return jax.tree.map(lambda leaf: pad(np.asarray(leaf)), args_tree)
+    return jax.tree.map(lambda leaf: pad(np.asarray(leaf)), args_tree), valid
 
 
 def evaluate_batch_sharded(
@@ -103,7 +127,7 @@ def evaluate_batch_sharded(
     B = len(assignments)
     pad_to = -(-B // n_dev) * n_dev
     args_tree = pack_assignments(compiled, assignments)
-    args_tree = _pad_batch(args_tree, pad_to, B)
+    args_tree, _valid = _pad_batch(args_tree, pad_to, B)
     scalars, bools, array_tabs = shard_probe_args(args_tree, mesh, batch_dims=1)
     truth = compiled._fn(scalars, bools, array_tabs)
     return np.asarray(truth)[:B]

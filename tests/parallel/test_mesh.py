@@ -76,14 +76,36 @@ def test_frontier_step_reductions():
         _assignments([(i + j, 100 - i - j) for j in range(cands)])
         for i in range(paths)
     ]
-    args_tree = pack_frontier(compiled, frontier)
+    args_tree, valid = pack_frontier(compiled, frontier)
     scalars, bools, tabs = shard_probe_args(args_tree, mesh, batch_dims=2)
-    scores, best, best_idx, n_sat = frontier_step(compiled)(scalars, bools, tabs)
+    scores, best, best_idx, n_sat = frontier_step(compiled)(
+        scalars, bools, tabs, valid
+    )
     assert scores.shape == (paths, cands)
     assert best.shape == (paths,)
     # every candidate sums to 100 and all x values are < 60 here
     assert int(n_sat) == paths * cands
     assert int(best.min()) == 2
+
+
+def test_frontier_step_ragged_padding_cannot_double_count():
+    """A ragged frontier padded by row-repeat must not inflate n_sat."""
+    _, _, conj = _problem()
+    compiled = compile_conjunction(conj)
+    # path 0: one fully-sat candidate (gets padded by repetition to len 4)
+    # path 1: four candidates, two sat
+    frontier = [
+        _assignments([(10, 90)]),
+        _assignments([(10, 90), (70, 30), (20, 80), (0, 1)]),
+    ]
+    args_tree, valid = pack_frontier(compiled, frontier)
+    assert valid.tolist() == [[True, False, False, False], [True] * 4]
+    scores, best, best_idx, n_sat = frontier_step(compiled)(*args_tree, valid)
+    # without the mask the repeated (10, 90) rows would make n_sat 6
+    assert int(n_sat) == 3
+    assert int(best_idx[0]) == 0
+    # masked rows surface as -1, never winning a max
+    assert scores[0, 1:].max() == -1
 
 
 def test_graft_entry_single_chip_and_dryrun():
