@@ -225,6 +225,19 @@ class LaserEVM:
     def exec(self, create: bool = False, track_gas: bool = False) -> Optional[List[GlobalState]]:
         final_states: List[GlobalState] = []
         self._fire("start_exec")
+        if args.frontier and not create and not track_gas:
+            # batched device-resident frontier (SURVEY.md §7.1): eligible
+            # seeds execute on the TPU; parked paths fall through to the
+            # host loop below, which also handles anything frontier-ineligible
+            try:
+                from mythril_tpu.frontier import FrontierEngine
+
+                FrontierEngine(self).drain_work_list()
+            except Exception as e:  # graceful degradation, never lose a run
+                log.warning(
+                    "frontier engine failed; host engine continues: %s",
+                    e, exc_info=True,
+                )
         start = time.time()
         deadline = (
             start + self.create_timeout

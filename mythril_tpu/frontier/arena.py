@@ -1,0 +1,311 @@
+"""Host mirror of the device term arena: encode / decode vs the host term IR.
+
+The arena is the frontier's constraint pool (SURVEY.md §7.1): a flat table of
+rows ``(op, a, b, c, width, val[16 limbs], isconst)`` shared by every path in
+the batch.  The host seeds it (PUSH constants, environment symbols, storage /
+balance array bases), the device appends rows as instructions produce symbolic
+results, and at harvest time the host pulls the new rows and decodes each into
+a host ``terms.Term`` — the same IR the solver, the detectors, and the report
+pipeline consume.
+
+Decoding calls the ordinary term constructors, so eager constant folding and
+hash-consing make the decoded terms semantically identical to what the host
+instruction handlers (mythril_tpu/core/instructions.py) would have built for
+the same path; macro rows (A_CDLOAD, A_ADDMOD, ...) decode into the exact
+composites those handlers construct (reference: mythril/laser/ethereum/
+instructions.py:778, :274-288).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.ops.bitvec import from_ints, to_ints
+from mythril_tpu.smt import terms as T
+
+LIMBS = 16  # 256 bits as 16-bit limbs in uint32
+
+
+class HostArena:
+    """Append-only row table with host-side interning and decode memo."""
+
+    def __init__(self, cap: int = 1 << 17):
+        self.cap = cap
+        self.op = np.zeros(cap, np.int32)
+        self.a = np.full(cap, -1, np.int32)
+        self.b = np.full(cap, -1, np.int32)
+        self.c = np.full(cap, -1, np.int32)
+        self.width = np.zeros(cap, np.int32)
+        self.val = np.zeros((cap, LIMBS), np.uint32)
+        self.isconst = np.zeros(cap, bool)
+        self.length = 0
+
+        self._const_memo: Dict[tuple, int] = {}
+        # var table: row id -> host Term (opaque encode / seed symbols)
+        self._vars: List[T.Term] = []
+        self._var_memo: Dict[T.Term, int] = {}
+        self._encode_memo: Dict[T.Term, int] = {}
+        self._decode_memo: Dict[int, T.Term] = {}
+        # per-seed context for macro rows (calldata objects etc.)
+        self.seeds: List = []
+
+    # ------------------------------------------------------------------
+    # row creation (host side)
+    # ------------------------------------------------------------------
+
+    def _append(self, op, a=-1, b=-1, c=-1, width=0, value: Optional[int] = None) -> int:
+        if self.length >= self.cap:
+            raise MemoryError("arena capacity exhausted")
+        i = self.length
+        self.op[i], self.a[i], self.b[i], self.c[i] = op, a, b, c
+        self.width[i] = width
+        if value is not None:
+            self.val[i] = from_ints(value & ((1 << 256) - 1), 256)
+            self.isconst[i] = True
+        self.length += 1
+        return i
+
+    def const_row(self, value: int, width: int = 256) -> int:
+        key = (value, width)
+        row = self._const_memo.get(key)
+        if row is None:
+            row = self._append(O.A_CONST, width=width, value=value)
+            self._const_memo[key] = row
+        return row
+
+    def var_row(self, term: T.Term) -> int:
+        """Opaque row bound to an arbitrary host term (totalizes encoding)."""
+        row = self._var_memo.get(term)
+        if row is None:
+            self._vars.append(term)
+            row = self._append(
+                O.A_VAR,
+                a=len(self._vars) - 1,
+                width=term.width if T.is_bv_sort(term.sort) else 0,
+            )
+            if term.is_const:
+                self.val[row] = from_ints(term.value, 256)
+                self.isconst[row] = True
+            self._var_memo[term] = row
+            self._decode_memo[row] = term
+        return row
+
+    # ------------------------------------------------------------------
+    # structural encode: host term -> rows (fold-friendly on device)
+    # ------------------------------------------------------------------
+
+    _ENC_BIN = {
+        "add": O.A_ADD, "sub": O.A_SUB, "mul": O.A_MUL, "udiv": O.A_UDIV,
+        "sdiv": O.A_SDIV, "urem": O.A_UREM, "srem": O.A_SREM, "and": O.A_AND,
+        "or": O.A_OR, "xor": O.A_XOR, "shl": O.A_SHL, "lshr": O.A_LSHR,
+        "ashr": O.A_ASHR, "exp": O.A_EXP,
+        "ult": O.A_ULT, "ugt": O.A_UGT, "ule": O.A_ULE, "uge": O.A_UGE,
+        "slt": O.A_SLT, "sgt": O.A_SGT, "eq": O.A_EQ, "ne": O.A_NE,
+    }
+
+    def encode(self, term: T.Term) -> int:
+        """Host term -> arena row, structurally where the device understands
+        the op (enables device-side constant folding), opaque VAR otherwise."""
+        memo = self._encode_memo
+        row = memo.get(term)
+        if row is not None:
+            return row
+        # iterative post-order walk (term DAGs can be deep)
+        stack = [(term, False)]
+        while stack:
+            t, ready = stack.pop()
+            if t in memo:
+                continue
+            if not ready:
+                stack.append((t, True))
+                for ch in t.args:
+                    if ch not in memo:
+                        stack.append((ch, False))
+                continue
+            memo[t] = self._encode_one(t)
+        return memo[term]
+
+    def _encode_one(self, t: T.Term) -> int:
+        op = t.op
+        if op == "const":
+            if t.sort is T.BOOL:
+                return self.var_row(t)
+            return self.const_row(t.value, t.width)
+        if op in ("var", "array_var"):
+            return self.var_row(t)
+        ch = [self._encode_memo[c] for c in t.args]
+        w = t.width if T.is_bv_sort(t.sort) else 0
+        if op in self._ENC_BIN and len(ch) == 2:
+            return self._append(self._ENC_BIN[op], a=ch[0], b=ch[1], width=w)
+        if op == "not" and len(ch) == 1:
+            return self._append(O.A_NOT, a=ch[0], width=w)
+        if op == "lnot":
+            return self._append(O.A_BNOT, a=ch[0])
+        if op == "ite" and T.is_bv_sort(t.sort):
+            return self._append(O.A_ITEW, a=ch[0], b=ch[1], c=ch[2], width=w)
+        if op == "concat":
+            return self._append(O.A_CONCAT, a=ch[0], b=ch[1], width=w)
+        if op == "extract":
+            hi, lo = t.aux
+            return self._append(O.A_EXTRACT, a=ch[0], b=hi, c=lo, width=w)
+        if op == "keccak":
+            return self._append(O.A_KECCAK, a=ch[0], width=256)
+        if op == "select" and t.args[0].sort == T.array_sort(256, 256):
+            return self._append(O.A_SELECT, a=ch[0], b=ch[1], width=256)
+        if op == "store" and t.sort == T.array_sort(256, 256):
+            return self._append(O.A_STORE, a=ch[0], b=ch[1], c=ch[2])
+        return self.var_row(t)
+
+    # ------------------------------------------------------------------
+    # device sync
+    # ------------------------------------------------------------------
+
+    def pull_from_device(self, dev_arrays, new_length: int) -> None:
+        """Copy rows [self.length:new_length) appended by the device.
+
+        One batched transfer for all seven slices — the arrays themselves
+        stay device-resident (only the increment crosses the link)."""
+        if new_length <= self.length:
+            return
+        import jax
+
+        lo, hi = self.length, int(new_length)
+        op, a, b, c, width, val, isconst = jax.device_get(
+            tuple(arr[lo:hi] for arr in dev_arrays)
+        )
+        self.op[lo:hi] = op
+        self.a[lo:hi] = a
+        self.b[lo:hi] = b
+        self.c[lo:hi] = c
+        self.width[lo:hi] = width
+        self.val[lo:hi] = val
+        self.isconst[lo:hi] = isconst
+        self.length = hi
+
+    # ------------------------------------------------------------------
+    # decode: arena row -> host term
+    # ------------------------------------------------------------------
+
+    def const_value(self, row: int) -> int:
+        vals = to_ints(self.val[row], 256)
+        return vals[0] & ((1 << self.width[row]) - 1) if self.width[row] else vals[0]
+
+    def decode(self, row: int) -> T.Term:
+        memo = self._decode_memo
+        got = memo.get(row)
+        if got is not None:
+            return got
+        stack = [(int(row), False)]
+        while stack:
+            r, ready = stack.pop()
+            if r in memo:
+                continue
+            if not ready:
+                stack.append((r, True))
+                for ch in (self.a[r], self.b[r], self.c[r]):
+                    ch = int(ch)
+                    if ch >= 0 and ch not in memo and self._row_has_term_arg(r, ch):
+                        stack.append((ch, False))
+                continue
+            memo[r] = self._decode_one(r)
+        return memo[row]
+
+    def _row_has_term_arg(self, r: int, ch: int) -> bool:
+        op = int(self.op[r])
+        if op in (O.A_CONST, O.A_VAR, O.A_VARF):
+            return False
+        if op == O.A_EXTRACT:  # b, c are immediates
+            return ch == int(self.a[r])
+        if op == O.A_CDLOAD:  # b is a seed index
+            return ch == int(self.a[r])
+        return True
+
+    def _decode_one(self, r: int) -> T.Term:
+        op = int(self.op[r])
+        m = self._decode_memo
+        A = lambda: m[int(self.a[r])]  # noqa: E731
+        B = lambda: m[int(self.b[r])]  # noqa: E731
+        C = lambda: m[int(self.c[r])]  # noqa: E731
+        w = int(self.width[r])
+
+        if op == O.A_CONST:
+            return T.const(self.const_value(r), w)
+        if op == O.A_VAR:
+            return self._vars[int(self.a[r])]
+        if op == O.A_VARF:
+            return T.var(f"dev_fresh_{int(self.a[r])}_{r}", w or 256)
+        simple = {
+            O.A_ADD: T.add, O.A_SUB: T.sub, O.A_MUL: T.mul, O.A_UDIV: T.udiv,
+            O.A_SDIV: T.sdiv, O.A_UREM: T.urem, O.A_SREM: T.srem,
+            O.A_AND: T.band, O.A_OR: T.bor, O.A_XOR: T.bxor,
+            O.A_SHL: T.shl, O.A_LSHR: T.lshr, O.A_ASHR: T.ashr,
+            O.A_EXP: T.bvexp,
+            O.A_ULT: T.ult, O.A_UGT: T.ugt, O.A_ULE: T.ule, O.A_UGE: T.uge,
+            O.A_SLT: T.slt, O.A_SGT: T.sgt, O.A_EQ: T.eq, O.A_NE: T.ne,
+        }
+        if op in simple:
+            return simple[op](A(), B())
+        if op == O.A_EQZ:
+            return T.eq(A(), T.const(0, A().width))
+        if op == O.A_NOT:
+            return T.bnot(A())
+        if op == O.A_BNOT:
+            return T.lnot(A())
+        if op == O.A_ITEW:
+            return T.ite(A(), B(), C())
+        if op == O.A_CONCAT:
+            return T.concat2(A(), B())
+        if op == O.A_EXTRACT:
+            return T.extract(int(self.b[r]), int(self.c[r]), A())
+        if op == O.A_KECCAK:
+            return T.keccak(A())
+        if op == O.A_SELECT:
+            return T.select(A(), B())
+        if op == O.A_STORE:
+            return T.store(A(), B(), C())
+        if op == O.A_CDLOAD:
+            from mythril_tpu.smt import BitVec
+
+            calldata = self.seeds[int(self.b[r])].environment.calldata
+            return calldata.get_word_at(BitVec(A())).raw
+        if op == O.A_ADDMOD or op == O.A_MULMOD:
+            # mirror mythril_tpu/core/instructions.py addmod_/mulmod_
+            # (reference mythril/laser/ethereum/instructions.py:274-288)
+            wide_op = T.add if op == O.A_ADDMOD else T.mul
+            wide = T.urem(
+                wide_op(T.zext(A(), 256), T.zext(B(), 256)), T.zext(C(), 256)
+            )
+            return T.extract(255, 0, wide)
+        if op == O.A_SIGNEXT:
+            # mirror signextend_ symbolic composite (instructions.py:297-321)
+            b_t, x = A(), B()
+            result = x
+            for i in range(31):
+                bits = 8 * (i + 1)
+                result = T.ite(
+                    T.eq(b_t, T.const(i, 256)),
+                    T.sext(T.extract(bits - 1, 0, x), 256 - bits),
+                    result,
+                )
+            return result
+        if op == O.A_BYTE:
+            # mirror byte_ symbolic composite (instructions.py:392-410)
+            idx, word = A(), B()
+            shift = T.mul(T.sub(T.const(31, 256), idx), T.const(8, 256))
+            return T.ite(
+                T.ult(idx, T.const(32, 256)),
+                T.band(T.lshr(word, shift), T.const(0xFF, 256)),
+                T.const(0, 256),
+            )
+        raise ValueError(f"cannot decode arena op {op} at row {r}")
+
+    # ------------------------------------------------------------------
+    # device view
+    # ------------------------------------------------------------------
+
+    def device_arrays(self):
+        """Full-capacity numpy views to ship to the device."""
+        return (self.op, self.a, self.b, self.c, self.width, self.val, self.isconst)

@@ -1,0 +1,182 @@
+"""Per-instruction dispatch tables: bytecode -> dense device tables.
+
+The host pre-decodes the instruction stream once per contract (the analogue of
+``Disassembly`` feeding the host engine's dispatch,
+mythril_tpu/core/svm.py:274-317) into flat numpy tables indexed by
+*instruction index* (not byte address — matching the host engine's pc
+convention, reference mythril/laser/ethereum/svm.py:351):
+
+  * ``fam``      handler family (``ops.F_*``) for the lax.switch dispatch
+  * ``aux``      family-specific immediate (binop code, PUSH const row, ...)
+  * ``arity``    required stack inputs (underflow -> exceptional halt)
+  * ``gmin/gmax``  static gas bounds per opcode (dynamic parts added by
+                 handlers, mirroring instruction_data.get_opcode_gas)
+  * ``event``    whether executing this instruction records an event for the
+                 host walker (always-evented ops + every opcode the engine
+                 has detector hooks on)
+  * ``addr``     byte address of the instruction (for PC, reports)
+  * ``jumpmap``  byte address -> instruction index of a JUMPDEST (-1 if not)
+  * ``loop_id``  dense id per JUMPDEST for loop-bound counting (-1 otherwise)
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set
+
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.arena import HostArena
+
+# ops that always record an event regardless of hooks: the walker needs them
+# to keep carrier memory/storage/constraints exact between hook sites
+_ALWAYS_EVENT = {
+    "JUMPI", "SSTORE", "SLOAD", "MSTORE", "MSTORE8",
+    "STOP", "RETURN", "REVERT", "SELFDESTRUCT", "INVALID", "ASSERT_FAIL",
+}
+
+_BINOP = {
+    "ADD": O.A_ADD, "SUB": O.A_SUB, "MUL": O.A_MUL, "DIV": O.A_UDIV,
+    "SDIV": O.A_SDIV, "MOD": O.A_UREM, "SMOD": O.A_SREM, "AND": O.A_AND,
+    "OR": O.A_OR, "XOR": O.A_XOR, "EXP": O.A_EXP,
+    "SHL": O.A_SHL, "SHR": O.A_LSHR, "SAR": O.A_ASHR,
+}
+_SHIFT_OPS = {"SHL", "SHR", "SAR"}  # pop order: (shift, value)
+
+_CMP = {
+    "LT": O.A_ULT, "GT": O.A_UGT, "SLT": O.A_SLT, "SGT": O.A_SGT, "EQ": O.A_EQ,
+}
+
+# env slots in the per-path context vector (state.ctx)
+(
+    CTX_CALLER, CTX_ORIGIN, CTX_CALLVALUE, CTX_ADDRESS, CTX_CDSIZE,
+    CTX_BALANCES, CTX_STORAGE, CTX_GASPRICE, CTX_COINBASE, CTX_TIMESTAMP,
+    CTX_NUMBER, CTX_DIFFICULTY, CTX_GASLIMIT, CTX_CHAINID, CTX_BASEFEE,
+    CTX_SEED,
+) = range(16)
+CTX_W = 16
+
+_ENVPUSH = {
+    "CALLER": CTX_CALLER, "ORIGIN": CTX_ORIGIN, "CALLVALUE": CTX_CALLVALUE,
+    "ADDRESS": CTX_ADDRESS, "CALLDATASIZE": CTX_CDSIZE,
+    "GASPRICE": CTX_GASPRICE, "COINBASE": CTX_COINBASE,
+    "TIMESTAMP": CTX_TIMESTAMP, "NUMBER": CTX_NUMBER,
+    "DIFFICULTY": CTX_DIFFICULTY, "PREVRANDAO": CTX_DIFFICULTY,
+    "GASLIMIT": CTX_GASLIMIT, "CHAINID": CTX_CHAINID, "BASEFEE": CTX_BASEFEE,
+}
+
+
+class CodeTables:
+    def __init__(
+        self,
+        instruction_list: List,
+        arena: HostArena,
+        hooked_opcodes: Optional[Iterable[str]] = None,
+        code_size: Optional[int] = None,
+    ):
+        from mythril_tpu.support.opcodes import OPCODES
+
+        hooked: Set[str] = set(hooked_opcodes or ())
+        n = len(instruction_list)
+        self.n = n
+        self.instruction_list = instruction_list
+        self.fam = np.zeros(n + 1, np.int32)  # +1: implicit STOP off the end
+        self.aux = np.zeros(n + 1, np.int32)
+        self.arity = np.zeros(n + 1, np.int32)
+        self.gmin = np.zeros(n + 1, np.int32)
+        self.gmax = np.zeros(n + 1, np.int32)
+        self.event = np.zeros(n + 1, bool)
+        self.addr = np.zeros(n + 1, np.int32)
+        self.opcode_names: List[str] = []
+
+        max_addr = max((ins.address for ins in instruction_list), default=0)
+        self.jumpmap = np.full(max_addr + 2, -1, np.int32)
+        self.loop_id = np.full(n + 1, -1, np.int32)
+        n_loops = 0
+
+        for i, ins in enumerate(instruction_list):
+            name = ins.opcode
+            self.opcode_names.append(name)
+            self.addr[i] = ins.address
+            info = OPCODES.get(name)
+            if info is not None:
+                _, arity, _, g0, g1 = info
+                self.arity[i], self.gmin[i], self.gmax[i] = arity, g0, g1
+            self.event[i] = name in _ALWAYS_EVENT or name in hooked
+            fam, aux = self._classify(ins, arena, code_size)
+            self.fam[i], self.aux[i] = fam, aux
+            if name == "JUMPDEST":
+                self.jumpmap[ins.address] = i
+                self.loop_id[i] = n_loops
+                n_loops += 1
+
+        # implicit STOP past the end of code (reference svm.py:281-284)
+        self.fam[n] = O.F_STOP
+        self.event[n] = True
+        self.addr[n] = max_addr + 1
+        self.opcode_names.append("STOP")
+        self.n_loops = max(n_loops, 1)
+
+    def _classify(self, ins, arena: HostArena, code_size: Optional[int]):
+        name = ins.opcode
+        if name.startswith("PUSH"):
+            value = ins.arg_int or 0
+            return O.F_PUSH, arena.const_row(value, 256)
+        if name.startswith("DUP"):
+            return O.F_DUP, int(name[3:])
+        if name.startswith("SWAP"):
+            return O.F_SWAP, int(name[4:])
+        if name.startswith("LOG"):
+            return O.F_LOG, int(name[3:])
+        if name in _BINOP:
+            # aux low bits: arena op; bit 8: operands pop as (shift, value)
+            swap = 256 if name in _SHIFT_OPS else 0
+            return O.F_BINOP, _BINOP[name] | swap
+        if name in _CMP:
+            return O.F_CMP, _CMP[name]
+        if name in _ENVPUSH:
+            return O.F_ENVPUSH, _ENVPUSH[name]
+        simple = {
+            "STOP": (O.F_STOP, 0),
+            "POP": (O.F_POP, 0),
+            "ISZERO": (O.F_ISZERO, 0),
+            "NOT": (O.F_NOTOP, 0),
+            "CALLDATALOAD": (O.F_CALLDATALOAD, 0),
+            "BALANCE": (O.F_BALANCE, 0),
+            "SELFBALANCE": (O.F_SELFBALANCE, 0),
+            "SHA3": (O.F_SHA3, 0),
+            "KECCAK256": (O.F_SHA3, 0),
+            "MLOAD": (O.F_MLOAD, 0),
+            "MSTORE": (O.F_MSTORE, 0),
+            "SLOAD": (O.F_SLOAD, 0),
+            "SSTORE": (O.F_SSTORE, 0),
+            "JUMP": (O.F_JUMP, 0),
+            "JUMPI": (O.F_JUMPI, 0),
+            "JUMPDEST": (O.F_JUMPDEST, 0),
+            "GAS": (O.F_GASPUSH, 0),
+            "MSIZE": (O.F_MSIZE, 0),
+            "RETURN": (O.F_RETURN, 0),
+            "REVERT": (O.F_RETURN, 1),
+            "SELFDESTRUCT": (O.F_SELFDESTRUCT, 0),
+            "INVALID": (O.F_INVALID, 0),
+            "ASSERT_FAIL": (O.F_INVALID, 0),
+            "SIGNEXTEND": (O.F_SIGNEXTEND, 0),
+            "BYTE": (O.F_BYTEOP, 0),
+            "ADDMOD": (O.F_ADDMODOP, O.A_ADDMOD),
+            "MULMOD": (O.F_ADDMODOP, O.A_MULMOD),
+        }
+        if name == "PC":
+            return O.F_PUSH, arena.const_row(ins.address, 256)
+        if name == "CODESIZE" and code_size is not None:
+            return O.F_PUSH, arena.const_row(code_size, 256)
+        if name in simple:
+            return simple[name]
+        # everything else (CALL family, CREATE, copies, EXTCODE*, BLOCKHASH,
+        # RETURNDATA*, ...) parks the path for the host engine
+        return O.F_PARK, 0
+
+    def device_tables(self):
+        return (
+            self.fam, self.aux, self.arity, self.gmin, self.gmax,
+            self.event, self.addr, self.jumpmap, self.loop_id,
+        )

@@ -1,0 +1,330 @@
+"""FrontierEngine: orchestrates device segments against the host engine.
+
+Replaces the host work-list loop (reference mythril/laser/ethereum/
+svm.py:261-304) for message-call transactions: eligible seed states are
+packed into the device batch, the jitted segment program executes up to
+``caps.K`` instructions per dispatch for the whole batch, and each harvest
+
+  1. pulls the state mirror + new arena rows,
+  2. threads new fork children into the host-side path records,
+  3. replays completed paths' events through host GlobalStates (walker) —
+     firing detector hooks, archiving open world states, and pushing parked
+     paths onto ``laser.work_list`` for the host engine to continue,
+  4. recycles freed slots for queued seeds / pending forks.
+
+Anything the device cannot run (CALL family, creation txs, symbolic memory
+addressing, cap overflows) degrades gracefully: the path is parked with its
+exact machine state and the ordinary host engine picks it up — the frontier
+is a fast path, never a semantics fork.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.arena import HostArena
+from mythril_tpu.frontier.code import (
+    CTX_ADDRESS,
+    CTX_BALANCES,
+    CTX_BASEFEE,
+    CTX_CALLER,
+    CTX_CALLVALUE,
+    CTX_CDSIZE,
+    CTX_CHAINID,
+    CTX_COINBASE,
+    CTX_DIFFICULTY,
+    CTX_GASLIMIT,
+    CTX_GASPRICE,
+    CTX_NUMBER,
+    CTX_ORIGIN,
+    CTX_SEED,
+    CTX_STORAGE,
+    CTX_TIMESTAMP,
+    CodeTables,
+)
+from mythril_tpu.frontier.records import PathRecord, snapshot_slot
+from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
+from mythril_tpu.frontier.step import ArenaDev, build_segment
+from mythril_tpu.frontier.walker import Walker
+from mythril_tpu.support.support_args import args
+from mythril_tpu.support.time_handler import time_handler
+
+log = logging.getLogger(__name__)
+
+
+def _eligible(gs) -> bool:
+    """Seed states the device can take: fresh outermost message-call frames."""
+    from mythril_tpu.core.transaction.transaction_models import (
+        MessageCallTransaction,
+    )
+
+    try:
+        return (
+            gs.mstate.pc == 0
+            and not gs.mstate.stack
+            and isinstance(gs.current_transaction, MessageCallTransaction)
+            and gs.environment.code is not None
+            and len(gs.environment.code.instruction_list) > 0
+            and not gs.environment.static
+        )
+    except Exception:
+        return False
+
+
+class FrontierEngine:
+    def __init__(self, laser, caps: Optional[Caps] = None):
+        self.laser = laser
+        self.caps = caps or Caps(B=args.frontier_width)
+
+    # ------------------------------------------------------------------
+
+    def drain_work_list(self) -> int:
+        """Run every eligible work-list state on the device; parked paths
+        land back on ``laser.work_list``.  Returns #states executed."""
+        laser = self.laser
+        seeds = [s for s in laser.work_list if _eligible(s)]
+        if not seeds:
+            return 0
+        for s in seeds:
+            laser.work_list.remove(s)
+
+        # one code identity per run: extra code identities stay host-side
+        code0 = seeds[0].environment.code
+        same, rest = [], []
+        for s in seeds:
+            (same if s.environment.code is code0 else rest).append(s)
+        laser.work_list.extend(rest)
+        try:
+            return self._run(same)
+        except Exception:
+            # never lose a seed: hand everything back to the host engine.
+            # Paths a partial frontier run already completed re-run on host;
+            # the per-(address, bytecode) issue cache absorbs duplicates.
+            laser.work_list.extend(same)
+            raise
+
+    # ------------------------------------------------------------------
+
+    def _hooked_opcodes(self) -> set:
+        # defaultdict access creates empty entries; only real hooks count
+        return {
+            op
+            for reg in (self.laser._pre_hooks, self.laser._post_hooks)
+            for op, funcs in reg.items()
+            if op and funcs
+        }
+
+    def _seed_ctx(self, arena: HostArena, gs, seed_idx: int) -> np.ndarray:
+        from mythril_tpu.smt import symbol_factory
+
+        env = gs.environment
+        ctx = np.full(16, -1, np.int32)
+        ctx[CTX_CALLER] = arena.var_row(env.sender.raw)
+        ctx[CTX_ORIGIN] = arena.var_row(env.origin.raw)
+        ctx[CTX_CALLVALUE] = arena.var_row(env.callvalue.raw)
+        ctx[CTX_ADDRESS] = arena.var_row(env.address.raw)
+        ctx[CTX_CDSIZE] = arena.var_row(env.calldata.calldatasize.raw)
+        ctx[CTX_BALANCES] = arena.encode(gs.world_state.balances.raw)
+        ctx[CTX_STORAGE] = arena.encode(
+            env.active_account.storage._array.raw
+        )
+        ctx[CTX_GASPRICE] = arena.var_row(env.gasprice.raw)
+        ctx[CTX_COINBASE] = arena.var_row(gs.new_bitvec("coinbase", 256).raw)
+        ctx[CTX_TIMESTAMP] = arena.var_row(
+            symbol_factory.BitVecSym("timestamp", 256).raw
+        )
+        ctx[CTX_NUMBER] = arena.var_row(env.block_number.raw)
+        ctx[CTX_DIFFICULTY] = arena.var_row(
+            gs.new_bitvec("block_difficulty", 256).raw
+        )
+        ctx[CTX_GASLIMIT] = arena.const_row(gs.mstate.gas_limit, 256)
+        ctx[CTX_CHAINID] = arena.var_row(env.chainid.raw)
+        ctx[CTX_BASEFEE] = arena.var_row(env.basefee.raw)
+        ctx[CTX_SEED] = seed_idx
+        return ctx
+
+    def _inject(self, st: FrontierState, slot: int, seed_idx: int,
+                ctx: np.ndarray) -> None:
+        clear_slot(st, slot)
+        st.seed[slot] = seed_idx
+        st.halt[slot] = O.H_RUNNING
+        st.ctx[slot] = ctx
+
+    # ------------------------------------------------------------------
+
+    def _run(self, seeds: List) -> int:
+        laser = self.laser
+        caps = self.caps
+        t_start = time.time()
+
+        arena = HostArena(caps.ARENA)
+        arena.seeds = seeds
+        row_zero = arena.const_row(0, 256)
+        row_one = arena.const_row(1, 256)
+
+        code = seeds[0].environment.code
+        tables = CodeTables(
+            code.instruction_list,
+            arena,
+            hooked_opcodes=self._hooked_opcodes(),
+            code_size=len(getattr(code, "bytecode", b"") or b"") or None,
+        )
+        segment = build_segment(
+            tables, caps,
+            max_depth=laser.max_depth,
+            loop_bound=args.loop_bound or 0,
+            row_zero=row_zero, row_one=row_one,
+        )
+
+        # seed contexts (also fills the arena with env rows)
+        ctxs = [self._seed_ctx(arena, gs, i) for i, gs in enumerate(seeds)]
+
+        walker = Walker(laser, arena, tables, seeds)
+        st = empty_state(caps, tables.n_loops)
+        records: Dict[int, Optional[PathRecord]] = {i: None for i in range(caps.B)}
+        seed_queue = list(range(len(seeds)))
+        ev_seen = np.zeros(caps.B, np.int64)
+
+        # initial fill
+        for slot in range(caps.B):
+            if not seed_queue:
+                break
+            si = seed_queue.pop(0)
+            self._inject(st, slot, si, ctxs[si])
+            records[slot] = PathRecord(seed_idx=si)
+            ev_seen[slot] = 0
+
+        # the arena stays device-resident across segments; the host pulls
+        # only the newly appended row slices at each harvest
+        import jax
+
+        dev_arena = ArenaDev(
+            *[jax.device_put(a) for a in arena.device_arrays()]
+        )
+        arena_len = arena.length
+        executed = 0
+        deadline = t_start + (laser.execution_timeout or args.execution_timeout)
+
+        while True:
+            if time.time() > deadline or time_handler.time_remaining() <= 0:
+                log.info("frontier: execution timeout; parking live paths")
+                self._park_all(st, records, walker)
+                break
+
+            out_state, dev_arena, out_len, n_exec = segment(
+                st, dev_arena, arena_len
+            )
+            # pull state to host mirrors (writable: harvest mutates slots)
+            st = FrontierState(*[np.array(x) for x in out_state])
+            arena_len_new = int(out_len)
+            arena.pull_from_device(dev_arena, arena_len_new)
+            arena_len = arena_len_new
+            executed += int(n_exec)
+
+            self._harvest(st, records, walker, ev_seen)
+
+            # refill free slots with queued seeds
+            for slot in range(caps.B):
+                if records[slot] is None and seed_queue:
+                    si = seed_queue.pop(0)
+                    self._inject(st, slot, si, ctxs[si])
+                    records[slot] = PathRecord(seed_idx=si)
+                    ev_seen[slot] = 0
+
+            live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
+            if live == 0 and not seed_queue:
+                break
+            if arena_len + caps.B * caps.R * 2 >= caps.ARENA:
+                log.warning("frontier: arena nearly full; parking live paths")
+                self._park_all(st, records, walker)
+                break
+
+        laser.total_states += executed
+        return executed
+
+    # ------------------------------------------------------------------
+
+    def _harvest(self, st: FrontierState, records, walker: Walker,
+                 ev_seen: np.ndarray) -> None:
+        caps = self.caps
+        # 1. append new events and create child records.  A fork event makes
+        # a fresh slot scannable, and that child may itself have forked in
+        # the same segment — iterate until no new records appear.
+        changed = True
+        while changed:
+            changed = False
+            for slot in range(caps.B):
+                rec = records[slot]
+                if rec is None:
+                    continue
+                n_ev = int(st.ev_len[slot])
+                for k in range(int(ev_seen[slot]), n_ev):
+                    ev = st.events[slot, k].copy()
+                    ev_idx = len(rec.events)
+                    rec.events.append(ev)
+                    if (
+                        int(ev[O.EV_KIND]) == O.E_FORK
+                        and int(ev[O.EV_EXTRA]) >= 0
+                    ):
+                        child_slot = int(ev[O.EV_EXTRA])
+                        child = PathRecord(
+                            seed_idx=rec.seed_idx,
+                            parent=rec,
+                            fork_event_idx=ev_idx,
+                        )
+                        rec.children_by_event[ev_idx] = child
+                        records[child_slot] = child
+                        ev_seen[child_slot] = 0
+                        changed = True
+                ev_seen[slot] = n_ev
+
+        # 3. finish halted paths (terminals park/replay through the walker)
+        for slot in range(caps.B):
+            rec = records[slot]
+            if rec is None:
+                continue
+            halt = int(st.halt[slot])
+            if halt == O.H_RUNNING:
+                continue
+            if halt == O.H_PENDING_FORK:
+                # slots freed this harvest: just resume next segment
+                still_free = any(
+                    records[s] is None for s in range(caps.B) if s != slot
+                )
+                if still_free:
+                    st.halt[slot] = O.H_RUNNING
+                    continue
+                # batch saturated: spill to the host engine
+            rec.final = snapshot_slot(st, slot)
+            if halt == O.H_PENDING_FORK:
+                rec.final["halt"] = O.H_PARK
+            try:
+                walker.finish(rec)
+            except Exception as e:  # pragma: no cover - diagnostics
+                log.warning("frontier walker failed on a path: %s", e, exc_info=True)
+            records[slot] = None
+            clear_slot(st, slot)
+            ev_seen[slot] = 0
+
+    def _park_all(self, st: FrontierState, records, walker: Walker) -> None:
+        """Timeout/overflow: hand every live path back to the host engine."""
+        for slot in range(self.caps.B):
+            rec = records[slot]
+            if rec is None:
+                continue
+            if int(st.halt[slot]) == O.H_RUNNING:
+                st.halt[slot] = O.H_PARK
+            rec.final = snapshot_slot(st, slot)
+            if rec.final["halt"] == O.H_PENDING_FORK:
+                rec.final["halt"] = O.H_PARK
+            try:
+                walker.finish(rec)
+            except Exception as e:  # pragma: no cover
+                log.warning("frontier park failed: %s", e, exc_info=True)
+            records[slot] = None
+            clear_slot(st, slot)

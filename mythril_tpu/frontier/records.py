@@ -1,0 +1,56 @@
+"""Host-side path lineage: the fork tree behind the device batch.
+
+Slots in the device batch are recycled, so the host keeps one ``PathRecord``
+per logical path: its parent link (which event in the parent's stream forked
+it), its own accumulated event rows, and — once the path halts — a snapshot
+of its final device state.  This is the host half of the fork bookkeeping the
+reference does implicitly with Python object identity
+(mythril/laser/ethereum/svm.py:296 work_list of forked GlobalStates).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class PathRecord:
+    __slots__ = (
+        "seed_idx",
+        "parent",
+        "fork_event_idx",
+        "events",
+        "final",
+        "dead",
+        "carrier",
+        "carrier_pos",
+        "children_by_event",
+    )
+
+    def __init__(self, seed_idx: int, parent: Optional["PathRecord"] = None,
+                 fork_event_idx: int = -1):
+        self.seed_idx = seed_idx
+        self.parent = parent
+        self.fork_event_idx = fork_event_idx
+        self.events: List[np.ndarray] = []
+        self.final: Optional[dict] = None  # device-state snapshot at halt
+        self.dead = False  # killed by a PluginSkipState / dead branch
+        self.carrier = None  # host GlobalState advanced to carrier_pos
+        self.carrier_pos = 0  # events processed so far
+        self.children_by_event: Dict[int, "PathRecord"] = {}
+
+
+def snapshot_slot(st, slot: int) -> dict:
+    """Copy the per-slot device state (numpy mirror) for final processing."""
+    # carrier memory/storage/constraints are rebuilt from event replay
+    # (code.py _ALWAYS_EVENT), so only walker.finish's inputs are kept here
+    return {
+        "halt": int(st.halt[slot]),
+        "pc": int(st.pc[slot]),
+        "stack": st.stack[slot, : int(st.stack_len[slot])].copy(),
+        "gas_min": int(st.gas_min[slot]),
+        "gas_max": int(st.gas_max[slot]),
+        "depth": int(st.depth[slot]),
+        "mem_size": int(st.mem_size[slot]),
+    }

@@ -1,0 +1,113 @@
+"""The struct-of-arrays frontier state: B paths as dense tensors.
+
+This is the device-resident replacement for the host work list of
+``GlobalState`` objects (SURVEY.md §7.1; reference mythril/laser/ethereum/
+svm.py:67 ``work_list``).  Every per-path field is a fixed-capacity array so
+the whole batch is one XLA-friendly pytree; stack words, memory words and
+storage entries hold *arena row indices* (see arena.py), never Python
+objects.  The host keeps a numpy mirror between device segments: uploads at
+segment start, downloads at harvest.
+
+Caps overflow never loses a path: any overflow parks the path (H_PARK) and
+the host engine continues it from the reconstructed state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.code import CTX_W
+
+
+@dataclass(frozen=True)
+class Caps:
+    B: int = 64  # frontier width (paths)
+    STK: int = 48  # stack slots tracked (EVM limit is 1024; overflow parks)
+    MEM: int = 48  # word-granular memory entries
+    STO: int = 32  # storage assoc entries (concrete-fold cache)
+    CON: int = 96  # device-added path constraints
+    EVT: int = 96  # events per path per lifetime-on-device
+    R: int = 4  # arena rows reserved per path per step
+    K: int = 128  # max steps per device segment
+    ARENA: int = 1 << 17
+
+
+class FrontierState(NamedTuple):
+    """One leading [B] dim on everything; see Caps for trailing dims."""
+
+    pc: np.ndarray  # [B] i32 instruction index
+    halt: np.ndarray  # [B] i32 ops.H_*; free slots marked by seed < 0
+    seed: np.ndarray  # [B] i32 seed index, -1 = free slot
+    stack: np.ndarray  # [B, STK] i32 arena rows
+    stack_len: np.ndarray  # [B] i32
+    mem_addr: np.ndarray  # [B, MEM] i32 byte address, -1 = empty
+    mem_val: np.ndarray  # [B, MEM] i32 arena rows
+    mem_len: np.ndarray  # [B] i32
+    mem_size: np.ndarray  # [B] i32 ceil32 active memory size (msize/gas)
+    sto_key: np.ndarray  # [B, STO] i32 arena rows
+    sto_val: np.ndarray  # [B, STO] i32 arena rows
+    sto_len: np.ndarray  # [B] i32
+    ctx: np.ndarray  # [B, CTX_W] i32 env/context arena rows
+    cons: np.ndarray  # [B, CON] i32 bool arena rows
+    cons_len: np.ndarray  # [B] i32
+    events: np.ndarray  # [B, EVT, EV_W] i32
+    ev_len: np.ndarray  # [B] i32
+    gas_min: np.ndarray  # [B] i32
+    gas_max: np.ndarray  # [B] i32
+    depth: np.ndarray  # [B] i32 control-flow transfers (max_depth cap)
+    loops: np.ndarray  # [B, n_loops] i32 per-JUMPDEST visit counts
+
+
+def empty_state(caps: Caps, n_loops: int) -> FrontierState:
+    B = caps.B
+    return FrontierState(
+        pc=np.zeros(B, np.int32),
+        halt=np.full(B, O.H_STOP, np.int32),
+        seed=np.full(B, -1, np.int32),
+        stack=np.full((B, caps.STK), -1, np.int32),
+        stack_len=np.zeros(B, np.int32),
+        mem_addr=np.full((B, caps.MEM), -1, np.int32),
+        mem_val=np.full((B, caps.MEM), -1, np.int32),
+        mem_len=np.zeros(B, np.int32),
+        mem_size=np.zeros(B, np.int32),
+        sto_key=np.full((B, caps.STO), -1, np.int32),
+        sto_val=np.full((B, caps.STO), -1, np.int32),
+        sto_len=np.zeros(B, np.int32),
+        ctx=np.full((B, CTX_W), -1, np.int32),
+        cons=np.full((B, caps.CON), -1, np.int32),
+        cons_len=np.zeros(B, np.int32),
+        events=np.full((B, caps.EVT, O.EV_W), -1, np.int32),
+        ev_len=np.zeros(B, np.int32),
+        gas_min=np.zeros(B, np.int32),
+        gas_max=np.zeros(B, np.int32),
+        depth=np.zeros(B, np.int32),
+        loops=np.zeros((B, n_loops), np.int32),
+    )
+
+
+def clear_slot(st: FrontierState, i: int) -> None:
+    """Host-side: free slot ``i`` in the numpy mirror (after harvest)."""
+    st.seed[i] = -1
+    st.halt[i] = O.H_STOP
+    st.stack_len[i] = 0
+    st.stack[i] = -1
+    st.mem_len[i] = 0
+    st.mem_addr[i] = -1
+    st.mem_val[i] = -1
+    st.mem_size[i] = 0
+    st.sto_len[i] = 0
+    st.sto_key[i] = -1
+    st.sto_val[i] = -1
+    st.cons_len[i] = 0
+    st.cons[i] = -1
+    st.ev_len[i] = 0
+    st.events[i] = -1
+    st.gas_min[i] = 0
+    st.gas_max[i] = 0
+    st.depth[i] = 0
+    st.loops[i] = 0
+    st.pc[i] = 0

@@ -1,0 +1,967 @@
+"""The jitted device segment: K lockstep instruction steps over the batch.
+
+One call executes up to ``caps.K`` EVM instructions for every live path in
+the frontier — the device-side replacement for the host engine's
+one-state-at-a-time loop (reference mythril/laser/ethereum/svm.py:261-304,
+instructions.py handler dispatch).  Structure per step:
+
+  1. per-path phase (``vmap`` of a ``lax.switch`` over handler families):
+     pops/pushes on the tensor stack, constant folding via the 16-bit-limb
+     algebra (mythril_tpu/ops/bitvec.py), symbolic results as new arena rows
+     (each path owns ``caps.R`` reserved rows per step — no cross-path
+     coordination needed), event recording, fork requests;
+  2. cross-path phase: grant JUMPI forks into free batch slots by prefix-sum
+     rank (masked in-batch duplication — the reference's ``copy.copy`` fork,
+     instructions.py:791-823, as a gather), write fork constraints/events.
+
+Under ``vmap`` every switch branch executes for the whole batch and results
+are selected — that is the intended SIMD trade: handlers are tiny tensor ops,
+and XLA fuses the lot into one kernel per step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.code import (
+    CTX_ADDRESS,
+    CTX_BALANCES,
+    CTX_SEED,
+    CTX_STORAGE,
+    CodeTables,
+)
+from mythril_tpu.frontier.state import Caps, FrontierState
+from mythril_tpu.ops import bitvec as bv
+
+I32 = jnp.int32
+
+
+class ArenaDev(NamedTuple):
+    op: jnp.ndarray  # [T] i32
+    a: jnp.ndarray  # [T] i32
+    b: jnp.ndarray  # [T] i32
+    c: jnp.ndarray  # [T] i32
+    width: jnp.ndarray  # [T] i32
+    val: jnp.ndarray  # [T, 16] u32
+    isconst: jnp.ndarray  # [T] bool
+
+
+class NewRows(NamedTuple):
+    """R rows a path may write this step."""
+
+    op: jnp.ndarray  # [R]
+    a: jnp.ndarray
+    b: jnp.ndarray
+    c: jnp.ndarray
+    width: jnp.ndarray
+    val: jnp.ndarray  # [R, 16]
+    isconst: jnp.ndarray
+
+
+class Fork(NamedTuple):
+    want: jnp.ndarray  # scalar bool
+    target: jnp.ndarray  # instruction index of the taken branch
+    dest_row: jnp.ndarray
+    word_row: jnp.ndarray
+    cond_row: jnp.ndarray  # bool row for the taken constraint
+    ncond_row: jnp.ndarray  # bool row for the fall-through constraint
+
+
+def _memgas(size_bytes):
+    w = size_bytes // 32
+    return 3 * w + (w * w) // 512
+
+
+def build_segment(tables: CodeTables, caps: Caps, max_depth: int, loop_bound: int,
+                  row_zero: int, row_one: int):
+    """Compile the segment program for one contract's code tables."""
+
+    fam_t = jnp.asarray(tables.fam)
+    aux_t = jnp.asarray(tables.aux)
+    arity_t = jnp.asarray(tables.arity)
+    gmin_t = jnp.asarray(tables.gmin)
+    gmax_t = jnp.asarray(tables.gmax)
+    event_t = jnp.asarray(tables.event)
+    jumpmap_t = jnp.asarray(tables.jumpmap)
+    loopid_t = jnp.asarray(tables.loop_id)
+    n_instr = tables.n
+    R, STK, MEM, STO, CON, EVT = caps.R, caps.STK, caps.MEM, caps.STO, caps.CON, caps.EVT
+
+    # ------------------------------------------------------------------
+    # per-path step
+    # ------------------------------------------------------------------
+
+    def path_step(st: FrontierState, ids, arena: ArenaDev):
+        """st: per-path slice (no leading B); ids: [R] reserved arena rows."""
+        pc = jnp.clip(st.pc, 0, n_instr)
+        fam = fam_t[pc]
+        aux = aux_t[pc]
+        arity = arity_t[pc]
+        running = (st.halt == O.H_RUNNING) & (st.seed >= 0)
+
+        gas_pre = (st.gas_min, st.gas_max)
+
+        # operand rows in pop order (pre-dispatch; underflow handled below)
+        def opnd(j):
+            idx = jnp.clip(st.stack_len - 1 - j, 0, STK - 1)
+            return jnp.where(j < arity, st.stack[idx], -1)
+
+        pops = jnp.stack([opnd(j) for j in range(7)])
+
+        underflow = st.stack_len < arity
+
+        rows0 = NewRows(
+            op=jnp.zeros(R, I32),
+            a=jnp.full(R, -1, I32),
+            b=jnp.full(R, -1, I32),
+            c=jnp.full(R, -1, I32),
+            width=jnp.zeros(R, I32),
+            val=jnp.zeros((R, 16), jnp.uint32),
+            isconst=jnp.zeros(R, bool),
+        )
+        no_fork = Fork(
+            want=jnp.asarray(False),
+            target=jnp.asarray(0, I32),
+            dest_row=jnp.asarray(-1, I32),
+            word_row=jnp.asarray(-1, I32),
+            cond_row=jnp.asarray(-1, I32),
+            ncond_row=jnp.asarray(-1, I32),
+        )
+
+        # tiny helpers over the per-path slice -------------------------------
+        def aisc(r):
+            return jnp.where(r >= 0, arena.isconst[jnp.clip(r, 0, None)], False)
+
+        def aval(r):
+            return arena.val[jnp.clip(r, 0, None)]
+
+        def set_row(rows, k, op, a=-1, b=-1, c=-1, width=256, val=None, isconst=False):
+            rows = rows._replace(
+                op=rows.op.at[k].set(op),
+                a=rows.a.at[k].set(a),
+                b=rows.b.at[k].set(b),
+                c=rows.c.at[k].set(c),
+                width=rows.width.at[k].set(width),
+                isconst=rows.isconst.at[k].set(isconst),
+            )
+            if val is not None:
+                rows = rows._replace(val=rows.val.at[k].set(val))
+            return rows
+
+        def stack_after_pop(n):
+            return st.stack_len - n
+
+        def push1(stack, length, row):
+            ok = length < STK
+            stack = stack.at[jnp.clip(length, 0, STK - 1)].set(
+                jnp.where(ok, row, stack[jnp.clip(length, 0, STK - 1)])
+            )
+            return stack, length + 1, ok
+
+        class Out(NamedTuple):
+            st: FrontierState
+            rows: NewRows
+            fork: Fork
+            res_row: jnp.ndarray  # pushed result row (-1 none)
+            ev_ops: jnp.ndarray  # [7] operand rows for the event
+
+        def base_out(st2, rows=rows0, fork=no_fork, res=-1):
+            return Out(
+                st=st2,
+                rows=rows,
+                fork=fork,
+                res_row=jnp.asarray(res, I32),
+                ev_ops=pops,
+            )
+
+        def halted(kind):
+            return base_out(st._replace(halt=jnp.asarray(kind, I32)))
+
+        def pushed(rows, row, extra_pop=0, res=None):
+            """Pop ``arity`` (already accounted) push one row."""
+            length = stack_after_pop(arity)
+            stack, length, ok = push1(st.stack, length, row)
+            st2 = st._replace(stack=stack, stack_len=length)
+            out = base_out(st2, rows=rows, res=row if res is None else res)
+            return out, ok
+
+        # ----- handlers -----------------------------------------------------
+
+        def h_park(_):
+            return halted(O.H_PARK)
+
+        def h_stop(_):
+            return halted(O.H_STOP)
+
+        def h_push_checked(_):
+            out, ok = pushed(rows0, aux)
+            return jax.tree.map(
+                lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK)
+            )
+
+        def h_dup(_):
+            idx = jnp.clip(st.stack_len - aux, 0, STK - 1)
+            row = st.stack[idx]
+            stack, length, ok = push1(st.stack, st.stack_len, row)
+            out = base_out(st._replace(stack=stack, stack_len=length), res=row)
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_swap(_):
+            i = jnp.clip(st.stack_len - 1, 0, STK - 1)
+            j = jnp.clip(st.stack_len - 1 - aux, 0, STK - 1)
+            a, b = st.stack[i], st.stack[j]
+            stack = st.stack.at[i].set(b).at[j].set(a)
+            return base_out(st._replace(stack=stack))
+
+        def h_pop(_):
+            return base_out(st._replace(stack_len=stack_after_pop(1)))
+
+        # cheap folds only: the division family and EXP stay symbolic on
+        # device even for concrete operands (their fold loops would dominate
+        # the fused step kernel); the host decode folds them for free
+        _BIN_FOLDS = {
+            O.A_ADD: lambda x, y: bv.add(x, y, 256),
+            O.A_SUB: lambda x, y: bv.sub(x, y, 256),
+            O.A_MUL: lambda x, y: bv.mul(x, y, 256),
+            O.A_AND: lambda x, y: bv.and_(x, y, 256),
+            O.A_OR: lambda x, y: bv.or_(x, y, 256),
+            O.A_XOR: lambda x, y: bv.xor(x, y, 256),
+            O.A_SHL: lambda x, y: bv.shl(x, y, 256),
+            O.A_LSHR: lambda x, y: bv.lshr(x, y, 256),
+            O.A_ASHR: lambda x, y: bv.ashr(x, y, 256),
+        }
+
+        def h_binop(_):
+            code = aux & 0xFF
+            swap = (aux & 256) != 0
+            p0, p1 = pops[0], pops[1]
+            # term operand order: (left, right); shifts pop (shift, value)
+            left = jnp.where(swap, p1, p0)
+            right = jnp.where(swap, p0, p1)
+            foldable = jnp.asarray(False)
+            for opc in _BIN_FOLDS:
+                foldable = foldable | (code == opc)
+            both_const = aisc(left) & aisc(right) & foldable
+            lv, rv = aval(left), aval(right)
+            folded = jnp.zeros((16,), jnp.uint32)
+            for opc, fn in _BIN_FOLDS.items():
+                folded = jnp.where(code == opc, fn(lv, rv), folded)
+            rows_c = set_row(rows0, 0, O.A_CONST, val=folded, isconst=True)
+            rows_s = set_row(rows0, 0, code, a=left, b=right)
+            rows = jax.tree.map(
+                lambda a, b: jnp.where(both_const, a, b), rows_c, rows_s
+            )
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_cmp(_):
+            p0, p1 = pops[0], pops[1]
+            both_const = aisc(p0) & aisc(p1)
+            lv, rv = aval(p0), aval(p1)
+            t = jnp.asarray(False)
+            for opc, fn in (
+                (O.A_ULT, lambda: bv.ult(lv, rv)),
+                (O.A_UGT, lambda: bv.ult(rv, lv)),
+                (O.A_SLT, lambda: bv.slt(lv, rv, 256)),
+                (O.A_SGT, lambda: bv.slt(rv, lv, 256)),
+                (O.A_EQ, lambda: bv.eq(lv, rv)),
+            ):
+                t = jnp.where(aux == opc, fn(), t)
+            const_row = jnp.where(t, row_one, row_zero)
+            # symbolic: cmp bool row + ITE word row
+            rows_s = set_row(rows0, 0, aux, a=p0, b=p1, width=0)
+            rows_s = set_row(rows_s, 1, O.A_ITEW, a=ids[0], b=row_one, c=row_zero)
+            res_row = jnp.where(both_const, const_row, ids[1])
+            rows = jax.tree.map(
+                lambda a, b: jnp.where(both_const, a, b), rows0, rows_s
+            )
+            out, ok = pushed(rows, res_row)
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_iszero(_):
+            p0 = pops[0]
+            is_c = aisc(p0)
+            z = bv.is_zero(aval(p0))
+            const_row = jnp.where(z, row_one, row_zero)
+            rows_s = set_row(rows0, 0, O.A_EQZ, a=p0, width=0)
+            rows_s = set_row(rows_s, 1, O.A_ITEW, a=ids[0], b=row_one, c=row_zero)
+            res_row = jnp.where(is_c, const_row, ids[1])
+            rows = jax.tree.map(lambda a, b: jnp.where(is_c, a, b), rows0, rows_s)
+            out, ok = pushed(rows, res_row)
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_not(_):
+            p0 = pops[0]
+            is_c = aisc(p0)
+            rows_c = set_row(rows0, 0, O.A_CONST, val=bv.not_(aval(p0), 256), isconst=True)
+            rows_s = set_row(rows0, 0, O.A_NOT, a=p0)
+            rows = jax.tree.map(lambda a, b: jnp.where(is_c, a, b), rows_c, rows_s)
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_envpush(_):
+            row = st.ctx[aux]
+            out, ok = pushed(rows0, row)
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_calldataload(_):
+            rows = set_row(rows0, 0, O.A_CDLOAD, a=pops[0], b=st.ctx[CTX_SEED])
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_balance(_):
+            rows = set_row(rows0, 0, O.A_SELECT, a=st.ctx[CTX_BALANCES], b=pops[0])
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_selfbalance(_):
+            rows = set_row(
+                rows0, 0, O.A_SELECT, a=st.ctx[CTX_BALANCES], b=st.ctx[CTX_ADDRESS]
+            )
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_gaspush(_):
+            rows = set_row(rows0, 0, O.A_VARF, a=pc)
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        def h_msize(_):
+            size = st.mem_size.astype(jnp.uint32)
+            val = jnp.zeros((16,), jnp.uint32)
+            val = val.at[0].set(size & 0xFFFF).at[1].set(size >> 16)
+            rows = set_row(rows0, 0, O.A_CONST, val=val, isconst=True)
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), out, halted(O.H_PARK))
+
+        # ---- memory ----
+
+        def conc_addr(r):
+            """(is_small_concrete, addr) for a row as a byte address."""
+            v = aval(r)
+            small = aisc(r) & (jnp.max(v[2:]) == 0) & (v[1] < 16)  # < 2^20
+            return small, (v[0] | (v[1] << 16)).astype(I32)
+
+        def mem_lookup(addr):
+            hit = (st.mem_addr == addr) & (jnp.arange(MEM) < st.mem_len)
+            any_hit = jnp.any(hit)
+            idx = jnp.argmax(hit)
+            return any_hit, st.mem_val[idx]
+
+        def mem_gas(st2, addr, size):
+            new_size = jnp.maximum(st2.mem_size, ((addr + size + 31) // 32) * 32)
+            cost = _memgas(new_size) - _memgas(st2.mem_size)
+            return st2._replace(
+                mem_size=new_size,
+                gas_min=st2.gas_min + cost,
+                gas_max=st2.gas_max + cost,
+            )
+
+        def h_mload(_):
+            ok_addr, addr = conc_addr(pops[0])
+            any_hit, val_row = mem_lookup(addr)
+            row = jnp.where(any_hit, val_row, row_zero)
+            st2 = mem_gas(st._replace(), addr, 32)
+            length = stack_after_pop(1)
+            stack, length, ok = push1(st2.stack, length, row)
+            out = base_out(st2._replace(stack=stack, stack_len=length), res=row)
+            good = ok_addr & ok
+            return jax.tree.map(lambda a, b: jnp.where(good, a, b), out, halted(O.H_PARK))
+
+        def h_mstore(_):
+            ok_addr, addr = conc_addr(pops[0])
+            val_row = pops[1]
+            # exact hit -> overwrite; overlap with a different entry -> park
+            live = jnp.arange(MEM) < st.mem_len
+            exact = (st.mem_addr == addr) & live
+            overlap = (
+                (jnp.abs(st.mem_addr - addr) < 32) & live & ~exact
+            ).any()
+            any_exact = exact.any()
+            idx = jnp.where(any_exact, jnp.argmax(exact), st.mem_len)
+            ok_cap = idx < MEM
+            mem_addr = st.mem_addr.at[jnp.clip(idx, 0, MEM - 1)].set(addr)
+            mem_val = st.mem_val.at[jnp.clip(idx, 0, MEM - 1)].set(val_row)
+            st2 = st._replace(
+                mem_addr=mem_addr,
+                mem_val=mem_val,
+                mem_len=jnp.where(any_exact, st.mem_len, st.mem_len + 1),
+                stack_len=stack_after_pop(2),
+            )
+            st2 = mem_gas(st2, addr, 32)
+            out = base_out(st2)
+            good = ok_addr & ~overlap & ok_cap
+            return jax.tree.map(lambda a, b: jnp.where(good, a, b), out, halted(O.H_PARK))
+
+        def h_sha3(_):
+            ok_off, off = conc_addr(pops[0])
+            ok_len, ln = conc_addr(pops[1])
+            words = (ln + 31) // 32
+            good = ok_off & ok_len & (ln > 0) & (ln % 32 == 0) & (words <= 4)
+            # gather word rows off, off+32, ...
+            w_rows = []
+            for w in range(4):
+                hit, vr = mem_lookup(off + 32 * w)
+                w_rows.append(jnp.where(hit, vr, row_zero))
+            # build concat chain: data = w0 for words==1,
+            # concat(w0,w1) etc.  rows: up to 3 concats (ids 0..2) + keccak id3
+            rows = rows0
+            cur = w_rows[0]
+            cur_w = jnp.asarray(256, I32)
+            for w in range(1, 4):
+                need = words > w
+                rows = jax.tree.map(
+                    lambda a, b: jnp.where(need, a, b),
+                    set_row(rows, w - 1, O.A_CONCAT, a=cur, b=w_rows[w],
+                            width=cur_w + 256),
+                    rows,
+                )
+                cur = jnp.where(need, ids[w - 1], cur)
+                cur_w = jnp.where(need, cur_w + 256, cur_w)
+            rows = set_row(rows, 3, O.A_KECCAK, a=cur, width=256)
+            sha_gas = 30 + 6 * words
+            st2 = mem_gas(
+                st._replace(gas_min=st.gas_min + sha_gas, gas_max=st.gas_max + sha_gas),
+                off, ln,
+            )
+            length = stack_after_pop(2)
+            stack, length, ok = push1(st2.stack, length, ids[3])
+            out = base_out(
+                st2._replace(stack=stack, stack_len=length), rows=rows, res=ids[3]
+            )
+            good = good & ok
+            return jax.tree.map(lambda a, b: jnp.where(good, a, b), out, halted(O.H_PARK))
+
+        # ---- storage ----
+
+        def h_sload(_):
+            key = pops[0]
+            live = jnp.arange(STO) < st.sto_len
+            hit = (st.sto_key == key) & live
+            any_hit = hit.any()
+            hit_val = st.sto_val[jnp.argmax(hit)]
+            # miss: select row over current storage array + cache it
+            rows = set_row(rows0, 0, O.A_SELECT, a=st.ctx[CTX_STORAGE], b=key)
+            res = jnp.where(any_hit, hit_val, ids[0])
+            idx = st.sto_len
+            ok_cap = any_hit | (idx < STO)
+            sto_key = st.sto_key.at[jnp.clip(idx, 0, STO - 1)].set(
+                jnp.where(any_hit, st.sto_key[jnp.clip(idx, 0, STO - 1)], key)
+            )
+            sto_val = st.sto_val.at[jnp.clip(idx, 0, STO - 1)].set(
+                jnp.where(any_hit, st.sto_val[jnp.clip(idx, 0, STO - 1)], ids[0])
+            )
+            st2 = st._replace(
+                sto_key=sto_key,
+                sto_val=sto_val,
+                sto_len=jnp.where(any_hit, st.sto_len, st.sto_len + 1),
+            )
+            length = stack_after_pop(1)
+            stack, length, ok = push1(st2.stack, length, res)
+            rows = jax.tree.map(lambda a, b: jnp.where(any_hit, a, b), rows0, rows)
+            out = base_out(
+                st2._replace(stack=stack, stack_len=length), rows=rows, res=res
+            )
+            good = ok_cap & ok
+            return jax.tree.map(lambda a, b: jnp.where(good, a, b), out, halted(O.H_PARK))
+
+        def h_sstore(_):
+            key, val = pops[0], pops[1]
+            rows = set_row(rows0, 0, O.A_STORE, a=st.ctx[CTX_STORAGE], b=key, c=val,
+                           width=0)
+            live = jnp.arange(STO) < st.sto_len
+            hit = (st.sto_key == key) & live
+            any_hit = hit.any()
+            idx = jnp.where(any_hit, jnp.argmax(hit), st.sto_len)
+            ok_cap = idx < STO
+            sto_key = st.sto_key.at[jnp.clip(idx, 0, STO - 1)].set(key)
+            sto_val = st.sto_val.at[jnp.clip(idx, 0, STO - 1)].set(val)
+            st2 = st._replace(
+                sto_key=sto_key,
+                sto_val=sto_val,
+                sto_len=jnp.where(any_hit, st.sto_len, st.sto_len + 1),
+                ctx=st.ctx.at[CTX_STORAGE].set(ids[0]),
+                stack_len=stack_after_pop(2),
+            )
+            out = base_out(st2, rows=rows)
+            return jax.tree.map(lambda a, b: jnp.where(ok_cap, a, b), out, halted(O.H_PARK))
+
+        # ---- control flow ----
+
+        def valid_dest(addr):
+            a = jnp.clip(addr, 0, jumpmap_t.shape[0] - 1)
+            idx = jumpmap_t[a]
+            return (addr < jumpmap_t.shape[0]) & (idx >= 0), idx
+
+        def h_jump(_):
+            ok_addr, addr = conc_addr(pops[0])
+            valid, idx = valid_dest(addr)
+            good = ok_addr & valid
+            st2 = st._replace(
+                pc=idx,
+                depth=st.depth + 1,
+                stack_len=stack_after_pop(1),
+            )
+            out = base_out(st2)
+            return jax.tree.map(lambda a, b: jnp.where(good, a, b), out,
+                                halted(O.H_INVALID))
+
+        def h_jumpi(_):
+            dest_row, word_row = pops[0], pops[1]
+            word_const = aisc(word_row)
+            truth = ~bv.is_zero(aval(word_row))
+            ok_dest, addr = conc_addr(dest_row)
+            valid, idx = valid_dest(addr)
+            can_take = ok_dest & valid
+
+            # constraint rows (allocated regardless; decode folds constants):
+            # cond = (word != 0); ncond = Not(cond)   [host jumpi_ parity]
+            rows = set_row(rows0, 0, O.A_NE, a=word_row, b=row_zero, width=0)
+            rows = set_row(rows, 1, O.A_BNOT, a=ids[0], width=0)
+            cond_row, ncond_row = ids[0], ids[1]
+
+            # concrete condition: single branch, no fork
+            def concrete_case():
+                take = truth & can_take
+                dead = truth & ~can_take
+                new_pc = jnp.where(take, idx, st.pc + 1)
+                app_row = jnp.where(take, cond_row, ncond_row)
+                cl = jnp.clip(st.cons_len, 0, CON - 1)
+                cons = jnp.where(dead, st.cons, st.cons.at[cl].set(app_row))
+                ok_cons = st.cons_len < CON
+                st2 = st._replace(
+                    pc=new_pc,
+                    depth=st.depth + 1,
+                    stack_len=stack_after_pop(2),
+                    cons=cons,
+                    cons_len=jnp.where(dead, st.cons_len, st.cons_len + 1),
+                    halt=jnp.where(dead, O.H_INVALID, st.halt),
+                )
+                ok = ok_cons | dead
+                st2 = jax.tree.map(
+                    lambda a, b: jnp.where(ok, a, b), st2,
+                    st._replace(halt=jnp.asarray(O.H_PARK, I32)),
+                )
+                return base_out(st2, rows=rows)
+
+            # symbolic condition (host jumpi_:791-823).  If the taken branch
+            # is viable the path state is left UNTOUCHED here and the batch
+            # phase applies both sides — a denied fork (batch full) must see
+            # the pristine pre-JUMPI state so it can re-run later.  If only
+            # the fall-through survives, apply it in place.
+            def symbolic_case():
+                cl = jnp.clip(st.cons_len, 0, CON - 1)
+                ok_cons = st.cons_len < CON
+                want = can_take & ok_cons
+
+                fall_only = st._replace(
+                    pc=st.pc + 1,
+                    depth=st.depth + 1,
+                    stack_len=stack_after_pop(2),
+                    cons=st.cons.at[cl].set(ncond_row),
+                    cons_len=st.cons_len + 1,
+                )
+                fall_only = jax.tree.map(
+                    lambda a, b: jnp.where(ok_cons, a, b), fall_only,
+                    st._replace(halt=jnp.asarray(O.H_PARK, I32)),
+                )
+                st2 = jax.tree.map(
+                    lambda a, b: jnp.where(can_take, a, b),
+                    st._replace(halt=jnp.where(ok_cons, st.halt,
+                                               jnp.asarray(O.H_PARK, I32))),
+                    fall_only,
+                )
+                fork = Fork(
+                    want=want,
+                    target=idx,
+                    dest_row=dest_row,
+                    word_row=word_row,
+                    cond_row=cond_row,
+                    ncond_row=ncond_row,
+                )
+                return base_out(st2, rows=rows, fork=fork)
+
+            return jax.tree.map(
+                lambda a, b: jnp.where(word_const, a, b),
+                concrete_case(), symbolic_case(),
+            )
+
+        def h_jumpdest(_):
+            lid = loopid_t[pc]
+            count = st.loops[jnp.clip(lid, 0, None)] + 1
+            loops = st.loops.at[jnp.clip(lid, 0, None)].set(count)
+            over = (loop_bound > 0) & (count > loop_bound)
+            st2 = st._replace(
+                loops=loops, halt=jnp.where(over, O.H_LOOP, st.halt)
+            )
+            return base_out(st2)
+
+        def h_log(_):
+            return base_out(st._replace(stack_len=stack_after_pop(arity)))
+
+        def h_return(_):
+            kind = jnp.where(aux == 1, O.H_REVERT, O.H_RETURN)
+            return base_out(
+                st._replace(halt=kind, stack_len=stack_after_pop(2))
+            )
+
+        def h_selfdestruct(_):
+            return base_out(
+                st._replace(
+                    halt=jnp.asarray(O.H_SELFDESTRUCT, I32),
+                    stack_len=stack_after_pop(1),
+                )
+            )
+
+        def h_invalid(_):
+            return halted(O.H_INVALID)
+
+        def h_signextend(_):
+            b_row, x_row = pops[0], pops[1]
+            b_c, x_c = aisc(b_row), aisc(x_row)
+            bval = aval(b_row)
+            b_small = (jnp.max(bval[1:]) == 0) & (bval[0] < 31)
+            # fold: both concrete
+            bits = (8 * (bval[0] + 1)).astype(I32)
+            x = aval(x_row)
+            mask_c = bv.shl(
+                bv.from_ints(1, 256), jnp.full((16,), 0, jnp.uint32).at[0].set(
+                    bits.astype(jnp.uint32)), 256,
+            )
+            mask_m1 = bv.sub(mask_c, bv.from_ints(1, 256), 256)
+            low = bv.and_(x, mask_m1, 256)
+            # sign bit: bit (bits-1)
+            sign_word = bv.lshr(
+                x, jnp.zeros((16,), jnp.uint32).at[0].set((bits - 1).astype(jnp.uint32)),
+                256,
+            )
+            neg = (sign_word[0] & 1) == 1
+            high = bv.not_(mask_m1, 256)
+            folded = jnp.where(neg, bv.or_(low, high, 256), low)
+            folded = jnp.where(b_small, folded, x)  # b >= 31 -> x unchanged
+            rows_c = set_row(rows0, 0, O.A_CONST, val=folded, isconst=True)
+            rows_m = set_row(rows0, 0, O.A_SIGNEXT, a=b_row, b=x_row)
+            both = b_c & x_c
+            rows = jax.tree.map(lambda a, b2: jnp.where(both, a, b2), rows_c, rows_m)
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b2: jnp.where(ok, a, b2), out, halted(O.H_PARK))
+
+        def h_byte(_):
+            i_row, w_row = pops[0], pops[1]
+            both = aisc(i_row) & aisc(w_row)
+            iv = aval(i_row)
+            small = (jnp.max(iv[1:]) == 0) & (iv[0] < 32)
+            # byte index from the big end: byte i = bits [8*(31-i), +8)
+            lo_bit = (8 * (31 - jnp.clip(iv[0], 0, 31))).astype(jnp.uint32)
+            shifted = bv.lshr(
+                aval(w_row), jnp.zeros((16,), jnp.uint32).at[0].set(lo_bit), 256
+            )
+            folded = jnp.zeros((16,), jnp.uint32).at[0].set(shifted[0] & 0xFF)
+            folded = jnp.where(small, folded, jnp.zeros((16,), jnp.uint32))
+            rows_c = set_row(rows0, 0, O.A_CONST, val=folded, isconst=True)
+            rows_m = set_row(rows0, 0, O.A_BYTE, a=i_row, b=w_row)
+            rows = jax.tree.map(lambda a, b2: jnp.where(both, a, b2), rows_c, rows_m)
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b2: jnp.where(ok, a, b2), out, halted(O.H_PARK))
+
+        def h_addmod(_):
+            rows = set_row(rows0, 0, aux, a=pops[0], b=pops[1], c=pops[2])
+            out, ok = pushed(rows, ids[0])
+            return jax.tree.map(lambda a, b2: jnp.where(ok, a, b2), out, halted(O.H_PARK))
+
+        handlers = [
+            h_park,  # F_PARK
+            h_stop,  # F_STOP
+            h_push_checked,  # F_PUSH
+            h_dup,  # F_DUP
+            h_swap,  # F_SWAP
+            h_pop,  # F_POP
+            h_binop,  # F_BINOP
+            h_cmp,  # F_CMP
+            h_iszero,  # F_ISZERO
+            h_not,  # F_NOTOP
+            h_envpush,  # F_ENVPUSH
+            h_calldataload,  # F_CALLDATALOAD
+            h_balance,  # F_BALANCE
+            h_selfbalance,  # F_SELFBALANCE
+            h_sha3,  # F_SHA3
+            h_mload,  # F_MLOAD
+            h_mstore,  # F_MSTORE
+            h_sload,  # F_SLOAD
+            h_sstore,  # F_SSTORE
+            h_jump,  # F_JUMP
+            h_jumpi,  # F_JUMPI
+            h_jumpdest,  # F_JUMPDEST
+            h_log,  # F_LOG
+            h_return,  # F_RETURN
+            h_selfdestruct,  # F_SELFDESTRUCT
+            h_invalid,  # F_INVALID
+            h_gaspush,  # F_GASPUSH
+            h_msize,  # F_MSIZE
+            h_signextend,  # F_SIGNEXTEND
+            h_byte,  # F_BYTEOP
+            h_addmod,  # F_ADDMODOP
+            h_park,  # F_MSTORE8 (parked in v1)
+        ]
+
+        out = jax.lax.switch(jnp.clip(fam, 0, len(handlers) - 1), handlers, None)
+
+        # underflow: exceptional halt, path dies silently
+        # (reference svm.py:289-295 -> _handle_vm_exception -> [])
+        out = jax.tree.map(
+            lambda a, b: jnp.where(underflow, a, b),
+            base_out(st._replace(halt=jnp.asarray(O.H_INVALID, I32))), out,
+        )
+
+        st2 = out.st
+
+        # a path waiting on the batch-phase fork decision stays pristine
+        pending = out.fork.want
+
+        # pc advance for handlers that didn't move it (host StateTransition)
+        terminalish = st2.halt != O.H_RUNNING
+        st2 = st2._replace(
+            pc=jnp.where(
+                pending | terminalish | (st2.pc != st.pc), st2.pc, st2.pc + 1
+            )
+        )
+        # static opcode gas on survivors (host charges after the handler;
+        # terminal handlers end the tx first and parked ops re-execute on
+        # host; forking paths are charged in the batch phase)
+        skip_gas = terminalish | pending
+        st2 = st2._replace(
+            gas_min=jnp.where(skip_gas, st2.gas_min, st2.gas_min + gmin_t[pc]),
+            gas_max=jnp.where(skip_gas, st2.gas_max, st2.gas_max + gmax_t[pc]),
+        )
+        # depth cap (host strategy drops deeper states silently)
+        st2 = st2._replace(
+            halt=jnp.where(
+                (st2.depth > max_depth) & (st2.halt == O.H_RUNNING),
+                O.H_DEPTH, st2.halt,
+            )
+        )
+
+        # ---- event emission.  Three shapes:
+        #   * hooked / terminal ops: E_HOOK / E_TERMINAL with operand rows;
+        #   * non-forking JUMPI (concrete cond or invalid taken dest):
+        #     E_FORK with [dest, word, appended-constraint] rows, the decided
+        #     next pc in the res slot, extra = -3 when the path died;
+        #   * forking JUMPI: emitted by the batch phase (child slot unknown
+        #     here); parked ops re-execute fully on host and need no event.
+        is_jumpi = fam == O.F_JUMPI
+        terminal_halt = (
+            (st2.halt == O.H_STOP)
+            | (st2.halt == O.H_RETURN)
+            | (st2.halt == O.H_REVERT)
+            | (st2.halt == O.H_SELFDESTRUCT)
+            | (st2.halt == O.H_INVALID)
+        )
+        kind = jnp.where(
+            is_jumpi, O.E_FORK,
+            jnp.where(terminal_halt, O.E_TERMINAL, O.E_HOOK),
+        )
+        emit = (
+            event_t[pc]
+            & ~pending
+            & ~underflow
+            & (st2.halt != O.H_PARK)
+            & (st2.halt != O.H_DEPTH)
+            & (st2.halt != O.H_LOOP)
+        )
+        died = st2.halt == O.H_INVALID
+        last_cons = st2.cons[jnp.clip(st2.cons_len - 1, 0, CON - 1)]
+        ev_ops = out.ev_ops.at[2].set(
+            jnp.where(is_jumpi & ~died, last_cons, out.ev_ops[2])
+        )
+        res_slot = jnp.where(is_jumpi, st2.pc, out.res_row)
+        extra_slot = jnp.where(is_jumpi & died, -3, -1)
+        payload = jnp.concatenate([
+            jnp.stack([kind, pc, gas_pre[0], gas_pre[1]]),
+            ev_ops,
+            jnp.stack([res_slot, extra_slot]),
+        ]).astype(I32)
+        ev_ok = st2.ev_len < EVT
+        el = jnp.clip(st2.ev_len, 0, EVT - 1)
+        events = jnp.where(
+            emit & ev_ok,
+            st2.events.at[el].set(payload),
+            st2.events,
+        )
+        st2 = st2._replace(
+            events=events,
+            ev_len=jnp.where(emit & ev_ok, st2.ev_len + 1, st2.ev_len),
+            # event buffer full: park so the host drains and continues
+            halt=jnp.where(
+                emit & ~ev_ok & (st2.halt == O.H_RUNNING), O.H_PARK, st2.halt
+            ),
+        )
+
+        # freeze non-running paths entirely
+        final = jax.tree.map(
+            lambda new, old: jnp.where(running, new, old), st2, st
+        )
+        rows_out = jax.tree.map(
+            lambda r: jnp.where(
+                running, r,
+                jnp.zeros_like(r) if r.dtype != bool else jnp.zeros_like(r),
+            ),
+            out.rows,
+        )
+        fork_out = jax.tree.map(
+            lambda f: jnp.where(running, f, jnp.zeros_like(f)), out.fork
+        )
+        return final, rows_out, fork_out
+
+    vstep = jax.vmap(path_step, in_axes=(0, 0, None))
+
+    # ------------------------------------------------------------------
+    # whole-batch step: per-path phase + arena scatter + fork grants
+    # ------------------------------------------------------------------
+
+    B = caps.B
+
+    def batch_step(carry):
+        state, arena, arena_len, t, n_exec = carry
+        running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
+        n_exec = n_exec + running.sum().astype(I32)
+        ids = arena_len + jnp.arange(B * R, dtype=I32).reshape(B, R)
+        new_state, rows, fork = vstep(state, ids, arena)
+
+        # arena scatter (rows are disjoint fresh slots)
+        flat_ids = ids.reshape(-1)
+        arena = ArenaDev(
+            op=arena.op.at[flat_ids].set(rows.op.reshape(-1)),
+            a=arena.a.at[flat_ids].set(rows.a.reshape(-1)),
+            b=arena.b.at[flat_ids].set(rows.b.reshape(-1)),
+            c=arena.c.at[flat_ids].set(rows.c.reshape(-1)),
+            width=arena.width.at[flat_ids].set(rows.width.reshape(-1)),
+            val=arena.val.at[flat_ids].set(rows.val.reshape(-1, 16)),
+            isconst=arena.isconst.at[flat_ids].set(rows.isconst.reshape(-1)),
+        )
+        arena_len = arena_len + B * R
+
+        # ---- fork grants ----
+        want = fork.want
+        free = new_state.seed < 0
+        n_free = free.sum()
+        rank = jnp.cumsum(want.astype(I32)) - 1
+        granted = want & (rank < n_free)
+        free_list = jnp.argsort(~free)  # free slots first, ascending
+        child_slot = jnp.where(
+            granted, free_list[jnp.clip(rank, 0, B - 1)], B
+        )
+
+        # gather-copy children from parents
+        src = jnp.arange(B, dtype=I32)
+        parent_ids = jnp.arange(B, dtype=I32)
+        src = src.at[child_slot].set(parent_ids, mode="drop")
+        forked_into = jnp.zeros(B, bool).at[child_slot].set(granted, mode="drop")
+        taken_pc = jnp.zeros(B, I32).at[child_slot].set(fork.target, mode="drop")
+        cond_of_child = jnp.zeros(B, I32).at[child_slot].set(
+            fork.cond_row, mode="drop"
+        )
+
+        ncond_of_parent = fork.ncond_row
+
+        def copy_field(f):
+            return jnp.where(
+                forked_into.reshape((B,) + (1,) * (f.ndim - 1)), f[src], f
+            )
+
+        state2 = jax.tree.map(copy_field, new_state)
+
+        # apply the fork to BOTH sides from the pristine pre-JUMPI state:
+        # pops, depth, the JUMPI's static gas, and the branch constraint
+        # (parent = fall-through + Not(cond); child = taken + cond)
+        touched = granted | forked_into
+        jumpi_pc = jnp.clip(jnp.where(forked_into, state.pc[src], state.pc),
+                            0, n_instr)
+        branch_pc = jnp.where(forked_into, taken_pc, jumpi_pc + 1)
+        branch_row = jnp.where(forked_into, cond_of_child, ncond_of_parent)
+        cl = jnp.clip(state2.cons_len, 0, CON - 1)
+        state2 = state2._replace(
+            pc=jnp.where(touched, branch_pc, state2.pc),
+            depth=jnp.where(touched, state2.depth + 1, state2.depth),
+            stack_len=jnp.where(touched, state2.stack_len - 2, state2.stack_len),
+            gas_min=jnp.where(touched, state2.gas_min + gmin_t[jumpi_pc],
+                              state2.gas_min),
+            gas_max=jnp.where(touched, state2.gas_max + gmax_t[jumpi_pc],
+                              state2.gas_max),
+            cons=jnp.where(
+                touched[:, None],
+                state2.cons.at[jnp.arange(B), cl].set(branch_row),
+                state2.cons,
+            ),
+            cons_len=jnp.where(touched, state2.cons_len + 1, state2.cons_len),
+            events=jnp.where(
+                forked_into[:, None, None],
+                jnp.full_like(state2.events, -1),
+                state2.events,
+            ),
+            ev_len=jnp.where(forked_into, 0, state2.ev_len),
+            halt=jnp.where(forked_into, O.H_RUNNING, state2.halt),
+        )
+
+        # a denied fork pends at the pristine JUMPI: the harvest re-runs it
+        # once slots have been freed (or spills it to the host engine)
+        denied = want & ~granted
+        state2 = state2._replace(
+            halt=jnp.where(denied, O.H_PENDING_FORK, state2.halt)
+        )
+        emit_fork = granted
+        payload = jnp.stack(
+            [
+                jnp.full(B, O.E_FORK, I32),
+                state.pc,  # pc of the JUMPI itself
+                state.gas_min,
+                state.gas_max,
+                fork.dest_row,
+                fork.word_row,
+                fork.cond_row,
+                fork.ncond_row,
+                fork.target,  # slot op4: taken-branch instruction index
+                jnp.full(B, -1, I32),
+                jnp.full(B, -1, I32),
+                jnp.full(B, -1, I32),
+                jnp.where(granted, child_slot, -1),
+            ],
+            axis=1,
+        )
+        el = jnp.clip(state2.ev_len, 0, EVT - 1)
+        ev_ok = state2.ev_len < EVT
+        state2 = state2._replace(
+            events=jnp.where(
+                (emit_fork & ev_ok)[:, None, None],
+                state2.events.at[jnp.arange(B), el].set(payload),
+                state2.events,
+            ),
+            ev_len=jnp.where(emit_fork & ev_ok, state2.ev_len + 1, state2.ev_len),
+            halt=jnp.where(
+                emit_fork & ~ev_ok, O.H_PARK, state2.halt
+            ),
+        )
+
+        return (state2, arena, arena_len, t + 1, n_exec)
+
+    def cond(carry):
+        state, _, arena_len, t, _n = carry
+        running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
+        room = arena_len + B * R < caps.ARENA
+        return (t < caps.K) & running.any() & room
+
+    @jax.jit
+    def segment(state: FrontierState, arena: ArenaDev, arena_len):
+        carry = (state, arena, jnp.asarray(arena_len, I32),
+                 jnp.asarray(0, I32), jnp.asarray(0, I32))
+        state, arena, arena_len, t, n_exec = jax.lax.while_loop(
+            cond, batch_step, carry
+        )
+        return state, arena, arena_len, n_exec
+
+    return segment

@@ -1,0 +1,291 @@
+"""Carrier walker: replay device events through real host GlobalStates.
+
+The device executes the pure-opcode flood; everything the analysis layer can
+observe — detector pre/post hooks, plugin signals, transaction-end world
+states, annotations (taint) — is reproduced here by advancing a *carrier*
+``GlobalState`` through the recorded event stream of each path:
+
+  * E_HOOK / E_TERMINAL events route through ``laser.execute_state`` — the
+    exact code path the host engine uses (mythril_tpu/core/svm.py:274-373,
+    reference mythril/laser/ethereum/svm.py:336-449) — so hooks, signal
+    handling, potential-issue checks and open-state archiving behave
+    identically;
+  * E_FORK events fire the JUMPI pre-hooks and then apply the device's
+    branch decision (the fork the host engine would have made via
+    ``copy.copy``, reference instructions.py:791-823);
+  * between events the carrier's stack is synthesized from decoded operand
+    rows — detectors only inspect the operands of the hooked opcode.
+
+Annotation (taint) parity: host taint lives on smt wrapper objects and
+propagates through operators.  The walker binds the wrapper that a hook saw
+(and possibly annotated) to the arena row of that op's result; decoding any
+later row unions the annotations of every bound row in its dependency
+closure — the same reachability the host's operator-level unions compute.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import logging
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.arena import HostArena
+from mythril_tpu.frontier.records import PathRecord
+from mythril_tpu.plugins.signals import PluginSkipState
+
+log = logging.getLogger(__name__)
+
+
+class Walker:
+    def __init__(self, laser, arena: HostArena, tables, seeds: List):
+        self.laser = laser
+        self.arena = arena
+        self.tables = tables
+        self.seeds = seeds  # list of seed GlobalStates (one per tx spawn)
+        # device gas counters start at 0 per path; issues must report
+        # seed-relative totals (carrier copies don't carry custom attrs)
+        self.gas_base = [
+            (s.mstate.min_gas_used, s.mstate.max_gas_used) for s in seeds
+        ]
+        # arena row -> wrapper bound at a hook site (annotation carrier)
+        self.bound: Dict[int, object] = {}
+        self._anno_memo: Dict[int, frozenset] = {}
+
+    # ------------------------------------------------------------------
+    # decode with annotation closure
+    # ------------------------------------------------------------------
+
+    def _annos(self, row: int) -> frozenset:
+        got = self._anno_memo.get(row)
+        if got is not None:
+            return got
+        out: Set = set()
+        stack = [int(row)]
+        seen = set()
+        while stack:
+            r = stack.pop()
+            if r < 0 or r in seen:
+                continue
+            seen.add(r)
+            w = self.bound.get(r)
+            if w is not None:
+                out.update(getattr(w, "annotations", ()))
+            ar = self.arena
+            for ch in (ar.a[r], ar.b[r], ar.c[r]):
+                ch = int(ch)
+                if ch >= 0 and ar._row_has_term_arg(r, ch):
+                    stack.append(ch)
+        result = frozenset(out)
+        self._anno_memo[row] = result
+        return result
+
+    def decode_wrapped(self, row: int):
+        """Arena row -> smt wrapper (BitVec/Bool) with taint closure."""
+        from mythril_tpu.smt import BitVec, Bool
+        from mythril_tpu.smt import terms as T
+
+        row = int(row)
+        bound = self.bound.get(row)
+        if bound is not None:
+            return bound
+        term = self.arena.decode(row)
+        annos = self._annos(row)
+        if term.sort is T.BOOL:
+            return Bool(term, annotations=annos)
+        return BitVec(term, annotations=annos)
+
+    def bind(self, row: int, wrapper) -> None:
+        if row is None or row < 0:
+            return
+        self.bound[int(row)] = wrapper
+        self._anno_memo.clear()
+
+    # ------------------------------------------------------------------
+    # carrier management
+    # ------------------------------------------------------------------
+
+    def root_carrier(self, rec: PathRecord):
+        seed = self.seeds[rec.seed_idx]
+        carrier = _copy.copy(seed)
+        return carrier
+
+    def materialize(self, rec: PathRecord) -> None:
+        """Ensure rec.carrier exists (walking ancestors as needed)."""
+        if rec.carrier is not None or rec.dead:
+            return
+        if rec.parent is None:
+            rec.carrier = self.root_carrier(rec)
+            return
+        parent = rec.parent
+        self.advance(parent, rec.fork_event_idx + 1)
+        if rec.carrier is None and not rec.dead:
+            if parent.dead:
+                # a hook killed the parent before the fork replayed: the
+                # whole subtree dies with it (host parity: the state was
+                # dropped before the JUMPI executed)
+                rec.dead = True
+                return
+            # parent advance should have installed it via the fork event
+            raise RuntimeError("fork event did not produce the child carrier")
+
+    def advance(self, rec: PathRecord, upto: int) -> None:
+        """Process rec.events[rec.carrier_pos:upto) on the carrier."""
+        if rec.dead:
+            return
+        self.materialize(rec)
+        if rec.dead:
+            return
+        while rec.carrier_pos < min(upto, len(rec.events)):
+            ev = rec.events[rec.carrier_pos]
+            rec.carrier_pos += 1
+            self._process_event(rec, ev)
+            if rec.dead:
+                return
+
+    # ------------------------------------------------------------------
+    # event processing
+    # ------------------------------------------------------------------
+
+    def _set_stack_from_ops(self, carrier, ev) -> None:
+        ops = [int(ev[O.EV_OP0 + j]) for j in range(7)]
+        ops = [r for r in ops if r >= 0]
+        # ops are in pop order: stack top is ops[0]
+        carrier.mstate.stack[:] = [self.decode_wrapped(r) for r in reversed(ops)]
+
+    def _set_gas(self, carrier, seed_idx: int, gmin: int, gmax: int) -> None:
+        base = self.gas_base[seed_idx]
+        carrier.mstate.min_gas_used = base[0] + gmin
+        carrier.mstate.max_gas_used = base[1] + gmax
+
+    def _process_event(self, rec: PathRecord, ev: np.ndarray) -> None:
+        carrier = rec.carrier
+        kind = int(ev[O.EV_KIND])
+        pc = int(ev[O.EV_PC])
+        carrier.mstate.pc = pc
+        self._set_gas(carrier, rec.seed_idx, int(ev[O.EV_GMIN]), int(ev[O.EV_GMAX]))
+
+        if kind in (O.E_HOOK, O.E_TERMINAL):
+            self._set_stack_from_ops(carrier, ev)
+            new_states, op_code = self.laser.execute_state(carrier)
+            if self.laser.requires_statespace:
+                self.laser.manage_cfg(op_code, new_states)
+            if not new_states:
+                rec.dead = True  # terminal, exceptional, or skipped
+                rec.carrier = None
+                return
+            rec.carrier = new_states[0]
+            if len(new_states) > 1:
+                # can only happen if a hooked op forked on host; the device
+                # never lets that happen (JUMPI is E_FORK)
+                log.warning("unexpected host fork during event replay")
+            res = int(ev[O.EV_RES])
+            if res >= 0 and rec.carrier.mstate.stack:
+                self.bind(res, rec.carrier.mstate.stack[-1])
+            return
+
+        if kind == O.E_FORK:
+            op_name = self.tables.opcode_names[pc] if pc < len(
+                self.tables.opcode_names) else "JUMPI"
+            dest_row = int(ev[O.EV_OP0 + 0])
+            word_row = int(ev[O.EV_OP0 + 1])
+            if word_row >= 0:
+                carrier.mstate.stack[:] = [
+                    self.decode_wrapped(word_row),
+                    self.decode_wrapped(dest_row),
+                ]
+            else:
+                carrier.mstate.stack[:] = []
+            # JUMPI pre-hooks (detectors); a skip kills the whole subtree,
+            # matching the host engine dropping the state pre-execution
+            try:
+                for hook in self.laser._pre_hooks.get(op_name, []):
+                    hook(carrier)
+            except PluginSkipState:
+                rec.dead = True
+                rec.carrier = None
+                return
+
+            extra = int(ev[O.EV_EXTRA])
+            if extra == -3:  # taken branch with invalid dest: path dies
+                rec.dead = True
+                rec.carrier = None
+                return
+            if extra == -1:  # single-branch decision (concrete or fall-only)
+                cons_row = int(ev[O.EV_OP0 + 2])
+                condition = None
+                if cons_row >= 0:
+                    condition = self.decode_wrapped(cons_row)
+                    carrier.world_state.constraints.append(condition)
+                carrier.mstate.pc = int(ev[O.EV_RES])  # decided next pc
+                carrier.mstate.depth += 1
+                self._branch_node(carrier, condition)
+                return
+            # granted fork: extra = child slot; child record was linked at
+            # harvest via children_by_event
+            cond_row = int(ev[O.EV_OP0 + 2])
+            ncond_row = int(ev[O.EV_OP0 + 3])
+            child = rec.children_by_event.get(rec.carrier_pos - 1)
+            if child is not None and not child.dead:
+                child_carrier = _copy.copy(carrier)
+                cond = self.decode_wrapped(cond_row)
+                child_carrier.world_state.constraints.append(cond)
+                child_carrier.mstate.pc = int(ev[O.EV_OP0 + 4])
+                child_carrier.mstate.depth += 1
+                self._branch_node(child_carrier, cond)
+                child.carrier = child_carrier
+            ncond = self.decode_wrapped(ncond_row)
+            carrier.world_state.constraints.append(ncond)
+            carrier.mstate.pc = pc + 1
+            carrier.mstate.depth += 1
+            self._branch_node(carrier, ncond)
+            return
+
+        log.warning("unknown event kind %d", kind)
+
+    def _branch_node(self, carrier, condition) -> None:
+        """CFG node transition for a JUMPI branch: function-entry naming and
+        statespace bookkeeping (mirrors svm.manage_cfg for JUMPI,
+        reference mythril/laser/ethereum/svm.py:506-532)."""
+        if not self.laser.requires_statespace:
+            return
+        from mythril_tpu.core.cfg import JumpType
+
+        self.laser._new_node_state(carrier, JumpType.CONDITIONAL, condition)
+        if carrier.node is not None:
+            carrier.node.states.append(carrier)
+
+    # ------------------------------------------------------------------
+    # path completion
+    # ------------------------------------------------------------------
+
+    def finish(self, rec: PathRecord) -> None:
+        """Path halted on device: drain events, then act on the halt kind."""
+        self.advance(rec, len(rec.events))
+        if rec.dead or rec.final is None:
+            return
+        halt = rec.final["halt"]
+        if halt in (O.H_STOP, O.H_RETURN, O.H_REVERT, O.H_SELFDESTRUCT,
+                    O.H_INVALID):
+            # the E_TERMINAL event already ran the terminal instruction via
+            # execute_state (transaction end -> open states); nothing to do
+            return
+        if halt in (O.H_DEPTH, O.H_LOOP):
+            return  # silently dropped, host strategy / loop-bound parity
+        if halt in (O.H_PARK, O.H_PENDING_FORK):
+            carrier = rec.carrier
+            if carrier is None:
+                return
+            snap = rec.final
+            carrier.mstate.pc = snap["pc"]
+            carrier.mstate.stack[:] = [
+                self.decode_wrapped(int(r)) for r in snap["stack"]
+            ]
+            self._set_gas(carrier, rec.seed_idx, snap["gas_min"], snap["gas_max"])
+            carrier.mstate.depth = snap["depth"]
+            carrier.mstate.memory_size = snap["mem_size"]
+            self.laser.work_list.append(carrier)
+            return
+        log.warning("unhandled halt kind %d", halt)
