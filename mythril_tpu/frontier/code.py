@@ -175,8 +175,42 @@ class CodeTables:
         # RETURNDATA*, ...) parks the path for the host engine
         return O.F_PARK, 0
 
-    def device_tables(self):
+    def size_bucket(self) -> tuple:
+        """(instr_cap, addr_cap, loops_cap) — padded sizes so one compiled
+        segment program serves every contract in the same bucket.  Base caps
+        fit EIP-170 runtime code (24576 bytes); larger inputs (initcode,
+        arbitrary files) grow the bucket instead of crashing."""
+        n = self.fam.shape[0]
+        instr_cap = 512
+        while instr_cap < n:
+            instr_cap *= 4
+        addr_cap = 32768
+        while addr_cap < self.jumpmap.shape[0]:
+            addr_cap *= 2
+        return instr_cap, addr_cap, 512
+
+    def padded_device_tables(self):
+        """CodeDev-shaped numpy arrays padded to the size bucket; the pad
+        region dispatches F_STOP (unreachable: pc never exceeds n).
+
+        JUMPDESTs beyond the loops cap get loop_id -1 (no loop bound for
+        them, rather than aliasing counters and killing loop-free paths);
+        max_depth and the segment step cap still bound those paths."""
+        instr_cap, addr_cap, loops_cap = self.size_bucket()
+
+        def pad1(a, cap, fill):
+            out = np.full(cap, fill, a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        loop_id = np.where(self.loop_id >= loops_cap, -1, self.loop_id)
         return (
-            self.fam, self.aux, self.arity, self.gmin, self.gmax,
-            self.event, self.addr, self.jumpmap, self.loop_id,
+            pad1(self.fam, instr_cap, O.F_STOP),
+            pad1(self.aux, instr_cap, 0),
+            pad1(self.arity, instr_cap, 0),
+            pad1(self.gmin, instr_cap, 0),
+            pad1(self.gmax, instr_cap, 0),
+            pad1(self.event, instr_cap, True),
+            pad1(self.jumpmap, addr_cap, -1),
+            pad1(loop_id, instr_cap, -1),
         )

@@ -49,7 +49,7 @@ from mythril_tpu.frontier.code import (
 )
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
-from mythril_tpu.frontier.step import ArenaDev, build_segment
+from mythril_tpu.frontier.step import ArenaDev, CfgScalars, CodeDev, cached_segment
 from mythril_tpu.frontier.walker import Walker
 from mythril_tpu.support.support_args import args
 from mythril_tpu.support.time_handler import time_handler
@@ -174,18 +174,26 @@ class FrontierEngine:
             hooked_opcodes=self._hooked_opcodes(),
             code_size=len(getattr(code, "bytecode", b"") or b"") or None,
         )
-        segment = build_segment(
-            tables, caps,
-            max_depth=laser.max_depth,
-            loop_bound=args.loop_bound or 0,
-            row_zero=row_zero, row_one=row_one,
+        instr_cap, addr_cap, loops_cap = tables.size_bucket()
+        segment = cached_segment(caps, instr_cap, addr_cap, loops_cap)
+        import jax
+
+        # tables never change during the run: upload once, reuse per segment
+        code_dev = CodeDev(
+            *[jax.device_put(a) for a in tables.padded_device_tables()]
+        )
+        cfg = CfgScalars(
+            max_depth=np.int32(laser.max_depth),
+            loop_bound=np.int32(args.loop_bound or 0),
+            row_zero=np.int32(row_zero),
+            row_one=np.int32(row_one),
         )
 
         # seed contexts (also fills the arena with env rows)
         ctxs = [self._seed_ctx(arena, gs, i) for i, gs in enumerate(seeds)]
 
         walker = Walker(laser, arena, tables, seeds)
-        st = empty_state(caps, tables.n_loops)
+        st = empty_state(caps, loops_cap)
         records: Dict[int, Optional[PathRecord]] = {i: None for i in range(caps.B)}
         seed_queue = list(range(len(seeds)))
         ev_seen = np.zeros(caps.B, np.int64)
@@ -217,7 +225,7 @@ class FrontierEngine:
                 break
 
             out_state, dev_arena, out_len, n_exec = segment(
-                st, dev_arena, arena_len
+                st, dev_arena, arena_len, code_dev, cfg
             )
             # pull state to host mirrors (writable: harvest mutates slots)
             st = FrontierState(*[np.array(x) for x in out_state])

@@ -21,7 +21,7 @@ and XLA fuses the lot into one kernel per step.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -78,28 +78,48 @@ def _memgas(size_bytes):
     return 3 * w + (w * w) // 512
 
 
-def build_segment(tables: CodeTables, caps: Caps, max_depth: int, loop_bound: int,
-                  row_zero: int, row_one: int):
-    """Compile the segment program for one contract's code tables."""
+class CodeDev(NamedTuple):
+    """Per-instruction dispatch tables as DEVICE INPUTS (padded to a size
+    bucket) so one compiled segment program serves every contract — compile
+    cost is paid once per (caps, bucket), not once per contract."""
 
-    fam_t = jnp.asarray(tables.fam)
-    aux_t = jnp.asarray(tables.aux)
-    arity_t = jnp.asarray(tables.arity)
-    gmin_t = jnp.asarray(tables.gmin)
-    gmax_t = jnp.asarray(tables.gmax)
-    event_t = jnp.asarray(tables.event)
-    jumpmap_t = jnp.asarray(tables.jumpmap)
-    loopid_t = jnp.asarray(tables.loop_id)
-    n_instr = tables.n
+    fam: jnp.ndarray  # [N] i32, padded with F_STOP
+    aux: jnp.ndarray  # [N] i32
+    arity: jnp.ndarray  # [N] i32
+    gmin: jnp.ndarray  # [N] i32
+    gmax: jnp.ndarray  # [N] i32
+    event: jnp.ndarray  # [N] bool
+    jumpmap: jnp.ndarray  # [ADDR_CAP] i32
+    loopid: jnp.ndarray  # [N] i32 (clamped to the loops cap)
+
+
+class CfgScalars(NamedTuple):
+    """Run-config scalars as dynamic inputs (no recompile on change)."""
+
+    max_depth: jnp.ndarray
+    loop_bound: jnp.ndarray  # 0 disables the bound
+    row_zero: jnp.ndarray  # arena row of const 0
+    row_one: jnp.ndarray  # arena row of const 1
+
+
+def build_segment(caps: Caps):
+    """Build the jitted segment program (code tables arrive as arguments)."""
+
     R, STK, MEM, STO, CON, EVT = caps.R, caps.STK, caps.MEM, caps.STO, caps.CON, caps.EVT
 
     # ------------------------------------------------------------------
     # per-path step
     # ------------------------------------------------------------------
 
-    def path_step(st: FrontierState, ids, arena: ArenaDev):
+    def path_step(st: FrontierState, ids, arena: ArenaDev, code: CodeDev,
+                  cfg: CfgScalars):
         """st: per-path slice (no leading B); ids: [R] reserved arena rows."""
-        pc = jnp.clip(st.pc, 0, n_instr)
+        fam_t, aux_t, arity_t = code.fam, code.aux, code.arity
+        gmin_t, gmax_t, event_t = code.gmin, code.gmax, code.event
+        jumpmap_t, loopid_t = code.jumpmap, code.loopid
+        max_depth, loop_bound = cfg.max_depth, cfg.loop_bound
+        row_zero, row_one = cfg.row_zero, cfg.row_one
+        pc = jnp.clip(st.pc, 0, code.fam.shape[0] - 1)
         fam = fam_t[pc]
         aux = aux_t[pc]
         arity = arity_t[pc]
@@ -594,9 +614,11 @@ def build_segment(tables: CodeTables, caps: Caps, max_depth: int, loop_bound: in
 
         def h_jumpdest(_):
             lid = loopid_t[pc]
-            count = st.loops[jnp.clip(lid, 0, None)] + 1
-            loops = st.loops.at[jnp.clip(lid, 0, None)].set(count)
-            over = (loop_bound > 0) & (count > loop_bound)
+            tracked = lid >= 0  # ids beyond the loops cap are unbounded
+            slot = jnp.clip(lid, 0, None)
+            count = st.loops[slot] + 1
+            loops = jnp.where(tracked, st.loops.at[slot].set(count), st.loops)
+            over = tracked & (loop_bound > 0) & (count > loop_bound)
             st2 = st._replace(
                 loops=loops, halt=jnp.where(over, O.H_LOOP, st.halt)
             )
@@ -818,7 +840,7 @@ def build_segment(tables: CodeTables, caps: Caps, max_depth: int, loop_bound: in
         )
         return final, rows_out, fork_out
 
-    vstep = jax.vmap(path_step, in_axes=(0, 0, None))
+    vstep = jax.vmap(path_step, in_axes=(0, 0, None, None, None))
 
     # ------------------------------------------------------------------
     # whole-batch step: per-path phase + arena scatter + fork grants
@@ -827,11 +849,12 @@ def build_segment(tables: CodeTables, caps: Caps, max_depth: int, loop_bound: in
     B = caps.B
 
     def batch_step(carry):
-        state, arena, arena_len, t, n_exec = carry
+        state, arena, arena_len, t, n_exec, code, cfg = carry
+        gmin_t, gmax_t = code.gmin, code.gmax
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         n_exec = n_exec + running.sum().astype(I32)
         ids = arena_len + jnp.arange(B * R, dtype=I32).reshape(B, R)
-        new_state, rows, fork = vstep(state, ids, arena)
+        new_state, rows, fork = vstep(state, ids, arena, code, cfg)
 
         # arena scatter (rows are disjoint fresh slots)
         flat_ids = ids.reshape(-1)
@@ -881,7 +904,7 @@ def build_segment(tables: CodeTables, caps: Caps, max_depth: int, loop_bound: in
         # (parent = fall-through + Not(cond); child = taken + cond)
         touched = granted | forked_into
         jumpi_pc = jnp.clip(jnp.where(forked_into, state.pc[src], state.pc),
-                            0, n_instr)
+                            0, code.fam.shape[0] - 1)
         branch_pc = jnp.where(forked_into, taken_pc, jumpi_pc + 1)
         branch_row = jnp.where(forked_into, cond_of_child, ncond_of_parent)
         cl = jnp.clip(state2.cons_len, 0, CON - 1)
@@ -947,21 +970,33 @@ def build_segment(tables: CodeTables, caps: Caps, max_depth: int, loop_bound: in
             ),
         )
 
-        return (state2, arena, arena_len, t + 1, n_exec)
+        return (state2, arena, arena_len, t + 1, n_exec, code, cfg)
 
     def cond(carry):
-        state, _, arena_len, t, _n = carry
+        state, _, arena_len, t, _n, _code, _cfg = carry
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         room = arena_len + B * R < caps.ARENA
         return (t < caps.K) & running.any() & room
 
     @jax.jit
-    def segment(state: FrontierState, arena: ArenaDev, arena_len):
+    def segment(state: FrontierState, arena: ArenaDev, arena_len,
+                code: CodeDev, cfg: CfgScalars):
         carry = (state, arena, jnp.asarray(arena_len, I32),
-                 jnp.asarray(0, I32), jnp.asarray(0, I32))
-        state, arena, arena_len, t, n_exec = jax.lax.while_loop(
+                 jnp.asarray(0, I32), jnp.asarray(0, I32), code, cfg)
+        state, arena, arena_len, t, n_exec, _code, _cfg = jax.lax.while_loop(
             cond, batch_step, carry
         )
         return state, arena, arena_len, n_exec
 
     return segment
+
+
+@lru_cache(maxsize=16)
+def cached_segment(caps: Caps, instr_cap: int, addr_cap: int, loops_cap: int):
+    """One compiled segment per (caps, size bucket) — shared by every
+    contract whose padded tables fit the bucket, and persisted across
+    processes by the XLA compilation cache."""
+    import mythril_tpu
+
+    mythril_tpu.enable_persistent_compilation_cache()
+    return build_segment(caps)
