@@ -291,6 +291,13 @@ class FrontierEngine:
                         changed = True
                 ev_seen[slot] = n_ev
 
+        # 2b. feasibility prune: the host engine drops unsat successors at
+        # every fork (svm._prune_unsatisfiable); the frontier batches the
+        # same check per segment over every still-running path whose
+        # constraint list grew, freeing slots that can never terminate
+        if not args.sparse_pruning:
+            self._prune_running(st, records, walker, ev_seen)
+
         # 3. finish halted paths (terminals park/replay through the walker)
         for slot in range(caps.B):
             rec = records[slot]
@@ -318,6 +325,43 @@ class FrontierEngine:
             records[slot] = None
             clear_slot(st, slot)
             ev_seen[slot] = 0
+
+    def _prune_running(self, st: FrontierState, records, walker: Walker,
+                       ev_seen: np.ndarray) -> None:
+        from mythril_tpu.smt.solver import check_satisfiable_batch
+
+        todo = []
+        for slot in range(self.caps.B):
+            rec = records[slot]
+            if rec is None or int(st.halt[slot]) != O.H_RUNNING:
+                continue
+            n_cons = int(st.cons_len[slot])
+            if n_cons <= rec._pruned_at:
+                continue
+            seed = walker.seeds[rec.seed_idx]
+            raws = list(seed.world_state.constraints.get_all_raw())
+            try:
+                raws += [
+                    walker.decode_wrapped(int(r)).raw
+                    for r in st.cons[slot, :n_cons]
+                ]
+            except Exception as e:
+                # cannot prune this slot: treat as satisfiable (sound — the
+                # path just keeps running) and don't re-decode every segment
+                log.warning("prune decode failed on slot %d: %s", slot, e)
+                rec._pruned_at = n_cons
+                continue
+            todo.append((slot, rec, n_cons, raws))
+        if not todo:
+            return
+        flags = check_satisfiable_batch([raws for _, _, _, raws in todo])
+        for (slot, rec, n_cons, _), ok in zip(todo, flags):
+            if ok:
+                rec._pruned_at = n_cons
+            else:
+                records[slot] = None
+                clear_slot(st, slot)
+                ev_seen[slot] = 0
 
     def _park_all(self, st: FrontierState, records, walker: Walker) -> None:
         """Timeout/overflow: hand every live path back to the host engine."""

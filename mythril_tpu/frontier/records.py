@@ -26,6 +26,7 @@ class PathRecord:
         "carrier",
         "carrier_pos",
         "children_by_event",
+        "_pruned_at",
     )
 
     def __init__(self, seed_idx: int, parent: Optional["PathRecord"] = None,
@@ -39,6 +40,7 @@ class PathRecord:
         self.carrier = None  # host GlobalState advanced to carrier_pos
         self.carrier_pos = 0  # events processed so far
         self.children_by_event: Dict[int, "PathRecord"] = {}
+        self._pruned_at = 0  # constraint count last proven satisfiable
 
 
 def snapshot_slot(st, slot: int) -> dict:

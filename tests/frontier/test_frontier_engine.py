@@ -108,6 +108,24 @@ def test_differential_timestamp():
     assert issue_keys(host) == issue_keys(dev)
 
 
+def test_multi_tx_killbilly_exploit():
+    """2-tx storage-gated selfdestruct: tx reseeding + storage encode must
+    chain through the device frontier (bench.py's headline workload)."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))
+    import bench
+
+    old = global_args.frontier
+    global_args.frontier = True
+    try:
+        _sym, issues, _wall = bench.run_analysis("auto")
+    finally:
+        global_args.frontier = old
+    bench.check_recall(issues)
+
+
 def test_parked_call_body_falls_back_to_host():
     # CALL is not device-executable: the path parks and the host engine
     # finishes it; issues must match the pure-host run
