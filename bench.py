@@ -92,14 +92,23 @@ def run_analysis(probe_backend: str):
     return sym, issues, wall
 
 
+def _selects(input_hex: str, selector: int) -> bool:
+    """Does this calldata dispatch to ``selector``?  EVM CALLDATALOAD
+    zero-pads past calldatasize, so exact minimization may shave trailing
+    zero bytes off the selector itself (0x0a11ce00 -> 3-byte calldata)."""
+    data = bytes.fromhex(input_hex[2:] if input_hex.startswith("0x") else input_hex)
+    padded = (data + b"\x00" * 4)[:4]
+    return int.from_bytes(padded, "big") == selector
+
+
 def check_recall(issues) -> None:
     assert issues, "exploit not found: zero issues"
     issue = issues[0]
     assert issue.swc_id == "106", f"wrong SWC id {issue.swc_id}"
     steps = issue.transaction_sequence["steps"]
     inputs = [s["input"] for s in steps]
-    assert any(i.startswith("0x0a11ce00") for i in inputs), "missing activate() tx"
-    assert inputs[-1].startswith("0x41c0e1b5"), "final tx is not kill()"
+    assert any(_selects(i, 0x0A11CE00) for i in inputs), "missing activate() tx"
+    assert _selects(inputs[-1], 0x41C0E1B5), "final tx is not kill()"
 
 
 def main() -> None:

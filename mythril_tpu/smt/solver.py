@@ -883,9 +883,10 @@ def solve_conjunction(
 ) -> Tuple[str, Optional[Assignment]]:
     """Core entry: find a model of And(conjuncts) or report unsat/unknown.
 
-    ``use_cache=False`` skips both memo tiers — required by callers that need
-    *distinct* models for the same constraint set (Optimize's best-of-N seed
-    loop would otherwise get the identical cached model back N times).
+    ``use_cache=False`` skips both memo tiers — for callers that need a
+    fresh model for a constraint set that may have been answered before
+    (e.g. differential testing, or re-deriving a model after cache
+    invalidation); normal solving should keep the caches on.
     """
     config = config or ProbeConfig()
     stats = SolverStatistics()
@@ -922,13 +923,31 @@ def solve_conjunction(
                 return UNSAT, None
             if status != SAT or asg is None:
                 return UNKNOWN, None
-            merged.scalars.update(asg.scalars)
-            merged.arrays.update(asg.arrays)
-            merged.ufs.update(asg.ufs)
-        stats.probe_hits += 1
-        if use_cache:
-            _model_cache.remember(cache_key, SAT, merged)
-        return SAT, merged
+            # a bucket model may carry assignments for UNRELATED variables
+            # (tier 0.5 recycles full models from earlier queries, validated
+            # only against this bucket's conjuncts) — merging those would
+            # clobber other buckets' witnesses with stale values.  Only the
+            # bucket's own free variables may contribute.
+            bucket_vars = set(terms.free_vars(bucket))
+            merged.scalars.update(
+                {k: v for k, v in asg.scalars.items() if k in bucket_vars}
+            )
+            merged.arrays.update(
+                {k: v for k, v in asg.arrays.items() if k in bucket_vars}
+            )
+            # no ufs merge: the split path rejects 'apply' terms outright, so
+            # any uf entries in a bucket model are stale recycled carry-over
+        # belt-and-braces: a merged model must satisfy the WHOLE conjunction
+        # before it is returned or memoized (an invalid model here poisons
+        # the result cache for every later identical query)
+        vals = evaluate(conjuncts, merged)
+        if all(vals[c] for c in conjuncts):
+            stats.probe_hits += 1
+            if use_cache:
+                _model_cache.remember(cache_key, SAT, merged)
+            return SAT, merged
+        log.warning("independence-split merge produced an invalid model; "
+                    "falling back to the joint probe")
 
     gen = CandidateGenerator(conjuncts, config)
     scalar_vars = gen.scalar_vars
@@ -1114,13 +1133,23 @@ class Solver:
 
 
 class Optimize(Solver):
-    """Best-effort objective optimization over probe-discovered models.
+    """Exact objective optimization via CDCL-backed bound search.
 
     The reference uses z3.Optimize to minimize calldata size / callvalue for
-    pretty exploit reports (mythril/analysis/solver.py:216-256).  Here we take
-    the best model among the probe's satisfying candidates; exactness of the
-    optimum is not required for soundness anywhere in the pipeline.
+    exploit reports (mythril/analysis/solver.py:216-256, smt/solver/
+    solver.py:109-121).  Here each objective is refined lexicographically:
+    starting from any model, repeatedly assert ``obj <= mid`` (binary search
+    tightened by each new model's actual value) until the CDCL tier proves
+    the bound unsatisfiable — that bound is then the exact optimum and is
+    pinned (``obj == opt``) before refining the next objective.  If a bound
+    query comes back UNKNOWN (probe exhausted, no native CDCL) the best
+    model found so far is kept — never worse than a single plain check.
     """
+
+    # per-objective refinement budget: enough for calldata-size-style
+    # objectives (optima near 0 converge in a handful of steps) while
+    # bounding pathological 256-bit searches
+    MAX_BOUND_STEPS = 48
 
     def __init__(self, config: Optional[ProbeConfig] = None):
         super().__init__(config)
@@ -1133,35 +1162,105 @@ class Optimize(Solver):
     def maximize(self, expr) -> None:
         self._maximize.append(expr.raw if hasattr(expr, "raw") else expr)
 
+    def _refine(self, conj, obj, asg, deadline: float, want_min: bool):
+        """Tighten one objective to its proven optimum (or best effort)."""
+        width = obj.width
+        top = (1 << width) - 1
+        cfg_step = ProbeConfig(
+            max_rounds=self.config.max_rounds,
+            candidates_per_round=self.config.candidates_per_round,
+            timeout_ms=max(1, self.config.timeout_ms // 4),
+            rng_seed=self.config.rng_seed,
+        )
+
+        def value(a) -> int:
+            return evaluate([obj], a)[obj]
+
+        best = value(asg)
+        # fast path: the global optimum in one query
+        target = 0 if want_min else top
+        if best != target and time.time() < deadline:
+            status, a2 = solve_conjunction(
+                conj + [terms.eq(obj, terms.const(target, width))], cfg_step
+            )
+            if status == SAT and a2 is not None:
+                return a2, True
+        steps = 0
+
+        def ask(bound):
+            return solve_conjunction(conj + [bound], cfg_step)
+
+        if want_min:
+            lo, hi = 0, best
+        else:
+            # exponential-up first: a hi anchor of 2^width would need ~width
+            # halvings; doubling from the current model reaches the optimum's
+            # magnitude in log2(opt) SAT steps and one UNSAT caps the range
+            lo, hi = best, top
+            while lo < hi and steps < self.MAX_BOUND_STEPS and time.time() < deadline:
+                steps += 1
+                probe_to = min(2 * best + 1, top)
+                status, a2 = ask(terms.uge(obj, terms.const(probe_to, width)))
+                if status == SAT and a2 is not None:
+                    asg, best = a2, value(a2)
+                    lo = best
+                    if best >= top:
+                        return asg, True
+                elif status == UNSAT:
+                    hi = probe_to - 1
+                    break
+                else:
+                    return asg, False
+        proven = best == target
+        while lo < hi and steps < self.MAX_BOUND_STEPS and time.time() < deadline:
+            steps += 1
+            if want_min:
+                mid = lo + (hi - 1 - lo) // 2  # strictly below current best
+                bound = terms.ule(obj, terms.const(mid, width))
+            else:
+                mid = hi - (hi - lo - 1) // 2  # strictly above current best
+                bound = terms.uge(obj, terms.const(mid, width))
+            status, a2 = ask(bound)
+            if status == SAT and a2 is not None:
+                asg, best = a2, value(a2)
+                if want_min:
+                    hi = best
+                else:
+                    lo = best
+            elif status == UNSAT:  # exact verdict from the CDCL tier
+                if want_min:
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
+                proven = lo >= hi
+            else:  # UNKNOWN: keep the best model found so far
+                return asg, False
+        return asg, proven or lo >= hi
+
     def check(self, *extra) -> str:
         conj = self._raw_conjuncts() + [
             c.raw if hasattr(c, "raw") else c for c in extra
         ]
-        best: Optional[Assignment] = None
-        best_key = None
-        status_any = UNKNOWN
-        # Ask for several models with different seeds, keep the best.
-        for seed in range(3):
-            cfg = ProbeConfig(
-                max_rounds=self.config.max_rounds,
-                candidates_per_round=self.config.candidates_per_round,
-                timeout_ms=max(1, self.config.timeout_ms // 3),
-                rng_seed=self.config.rng_seed + seed,
-            )
-            status, asg = solve_conjunction(conj, cfg, use_cache=False)
-            if status == UNSAT:
-                self._model = None
-                return UNSAT
-            if status == SAT and asg is not None:
-                status_any = SAT
-                vals = evaluate(self._minimize + self._maximize, asg) if (
-                    self._minimize or self._maximize
-                ) else {}
-                key = tuple(
-                    [vals[m] for m in self._minimize]
-                    + [-vals[m] for m in self._maximize]
-                )
-                if best is None or key < best_key:
-                    best, best_key = asg, key
-        self._model = Model(best) if best is not None else None
-        return status_any
+        # ONE timeout budget covers the initial solve AND all refinement
+        # (support/model.py sizes it against the remaining execution time)
+        deadline = time.time() + self.config.timeout_ms / 1000.0
+        status, asg = solve_conjunction(conj, self.config)
+        if status != SAT or asg is None:
+            self._model = None
+            return status
+        # lexicographic: each objective's achievement is pinned before the
+        # next — exactly (==) when proven optimal, as a bound (<=/>=) when
+        # refinement gave up, so later objectives can never regress it
+        for obj, want_min in [(m, True) for m in self._minimize] + [
+            (m, False) for m in self._maximize
+        ]:
+            asg, proven = self._refine(conj, obj, asg, deadline, want_min)
+            achieved = terms.const(evaluate([obj], asg)[obj], obj.width)
+            if proven:
+                conj = conj + [terms.eq(obj, achieved)]
+            elif want_min:
+                conj = conj + [terms.ule(obj, achieved)]
+            else:
+                conj = conj + [terms.uge(obj, achieved)]
+        self._model = Model(asg)
+        return SAT

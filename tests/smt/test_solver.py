@@ -106,14 +106,75 @@ def test_keccak_concrete_in_model():
     assert s.check() == SAT
 
 
-def test_optimize_minimize():
+def test_optimize_minimize_exact():
     x = bv("x")
     o = Optimize()
     o.add(ULT(val(5), x))
     o.minimize(x)
     assert o.check() == SAT
-    # best-effort minimization: should find a small-ish witness, exact min is 6
-    assert o._model.eval(x) >= 6
+    # CDCL-backed bound search proves the exact minimum
+    assert o._model.eval(x) == 6
+
+
+def test_optimize_minimize_stable_across_seeds():
+    from mythril_tpu.smt.solver import ProbeConfig
+
+    for seed in (1, 7, 1234):
+        x = bv(f"xs{seed}")
+        o = Optimize(ProbeConfig(rng_seed=seed))
+        o.add(UGT(x, val(100)))
+        o.add(ULT(x, val(1 << 64)))
+        o.minimize(x)
+        assert o.check() == SAT
+        assert o._model.eval(x) == 101, f"seed {seed} not minimal"
+
+
+def test_optimize_maximize_exact():
+    x = bv("xmax")
+    o = Optimize()
+    o.add(ULT(x, val(77)))
+    o.maximize(x)
+    assert o.check() == SAT
+    assert o._model.eval(x) == 76
+
+
+def test_optimize_lexicographic():
+    # minimize a first, then b under a's pinned optimum
+    a, b = bv("lexa"), bv("lexb")
+    o = Optimize()
+    o.add(UGT(a + b, val(10)))
+    o.add(ULT(a, val(4)))
+    o.minimize(a)
+    o.minimize(b)
+    assert o.check() == SAT
+    assert o._model.eval(a) == 0
+    assert o._model.eval(b) == 11
+
+
+def test_independence_merge_does_not_clobber_other_buckets():
+    """Regression: tier-0.5 recycles FULL models validated against one
+    bucket only; merging must take just that bucket's own variables, or a
+    stale assignment for another bucket's variable clobbers its witness
+    (observed as exploit models violating `caller == ATTACKER`)."""
+    from mythril_tpu.smt.solver import solve_conjunction
+    from mythril_tpu.smt.concrete_eval import evaluate
+
+    s = bv("indep_sender")
+    d = bv("indep_data")
+    AFFE, DEAD = 0xAFFE, 0xDEAD
+    # first query: full model with s=AFFE lands in the recent-model cache
+    st1, m1 = solve_conjunction(
+        [(s == val(AFFE)).raw, (d == val(7)).raw]
+    )
+    assert st1 == SAT
+    # second query splits into {d==7} (replayable from the recent model,
+    # which also carries s=AFFE) and {s==DEAD}
+    conj = [(d == val(7)).raw, (s == val(DEAD)).raw]
+    st2, m2 = solve_conjunction(conj)
+    assert st2 == SAT
+    vals = evaluate(conj, m2)
+    assert all(vals[c] for c in conj), "merged model violates the conjunction"
+    assert m2.scalars[s.raw] == DEAD
 
 
 def test_overflow_predicates():
