@@ -44,4 +44,7 @@ class SolverStatsInfo(ExecutionInfo):
             "solver_time_s": round(stats.solver_time, 3),
             "probe_hits": stats.probe_hits,
             "cdcl_calls": stats.cdcl_calls,
+            # completeness boundary: prune decisions taken on UNKNOWN —
+            # nonzero means recall may have been lost to solver budgets
+            "unknown_as_unsat": stats.unknown_as_unsat,
         }

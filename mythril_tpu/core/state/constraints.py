@@ -10,7 +10,13 @@ from __future__ import annotations
 from typing import Iterable, List, Optional
 
 from mythril_tpu.smt import Bool, symbol_factory
-from mythril_tpu.smt.solver import ProbeConfig, SAT, solve_conjunction
+from mythril_tpu.smt.solver import (
+    ProbeConfig,
+    SAT,
+    UNKNOWN,
+    SolverStatistics,
+    solve_conjunction,
+)
 
 
 class Constraints(list):
@@ -26,8 +32,16 @@ class Constraints(list):
     def is_possible(self) -> bool:
         """Quick satisfiability probe used for successor pruning."""
         status, _ = solve_conjunction(
-            self.get_all_raw(), ProbeConfig(max_rounds=2, candidates_per_round=24, timeout_ms=2000)
+            self.get_all_raw(),
+            ProbeConfig(
+                max_rounds=2,
+                candidates_per_round=24,
+                timeout_ms=2000,
+                prune_critical=True,
+            ),
         )
+        if status == UNKNOWN:
+            SolverStatistics().unknown_as_unsat += 1
         return status == SAT
 
     def get_all_constraints(self) -> "Constraints":

@@ -42,6 +42,9 @@ class AnalyzerArgs:
     custom_modules_directory: str = ""
     checkpoint_file: Optional[str] = None
     resume_from: Optional[str] = None
+    probe_backend: str = "auto"
+    frontier: bool = False
+    frontier_width: int = 64
 
 
 class MythrilAnalyzer:
@@ -79,6 +82,9 @@ class MythrilAnalyzer:
         args.enable_iprof = cmd_args.enable_iprof
         args.checkpoint_path = getattr(cmd_args, "checkpoint_file", None)
         args.resume_from = getattr(cmd_args, "resume_from", None)
+        args.probe_backend = getattr(cmd_args, "probe_backend", "auto")
+        args.frontier = getattr(cmd_args, "frontier", False)
+        args.frontier_width = getattr(cmd_args, "frontier_width", 64)
 
     def _sym_exec(self, contract, run_analysis_modules: bool = True) -> SymExecWrapper:
         from mythril_tpu.support.loader import DynLoader

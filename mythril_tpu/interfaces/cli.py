@@ -113,6 +113,26 @@ def _add_analysis_options(parser) -> None:
         "--resume-from",
         help="resume an interrupted analysis from a frontier checkpoint file",
     )
+    group.add_argument(
+        "--probe-backend",
+        choices=("auto", "host", "jax", "cdcl"),
+        default="auto",
+        help="constraint-probe backend: auto (latency-aware hybrid), host "
+        "(CPU big-int), jax (force device), cdcl (forced exact — recall "
+        "differential testing)",
+    )
+    group.add_argument(
+        "--frontier",
+        action="store_true",
+        help="run message-call transactions on the batched device-resident "
+        "frontier interpreter (TPU fast path; host engine handles the rest)",
+    )
+    group.add_argument(
+        "--frontier-width",
+        type=int,
+        default=64,
+        help="device frontier batch width (paths held on device)",
+    )
 
 
 def _add_output_options(parser) -> None:
@@ -274,6 +294,9 @@ def _build_analyzer(parsed, query_signature: bool = False):
         custom_modules_directory=parsed.custom_modules_directory,
         checkpoint_file=getattr(parsed, "checkpoint_file", None),
         resume_from=getattr(parsed, "resume_from", None),
+        probe_backend=getattr(parsed, "probe_backend", "auto"),
+        frontier=getattr(parsed, "frontier", False),
+        frontier_width=getattr(parsed, "frontier_width", 64),
     )
     analyzer = MythrilAnalyzer(
         disassembler, cmd_args, strategy=parsed.strategy, address=address

@@ -59,13 +59,18 @@ class SolverStatistics:
             cls._instance.solver_time = 0.0
             cls._instance.probe_hits = 0
             cls._instance.cdcl_calls = 0
+            # completeness boundary: prune decisions taken on an UNKNOWN
+            # verdict (probe exhausted AND no exact CDCL answer) — every one
+            # is a potential recall loss, so runs should see this at 0
+            cls._instance.unknown_as_unsat = 0
         return cls._instance
 
     def __repr__(self):
         return (
             f"Solver statistics: query count: {self.query_count}, "
             f"solver time: {self.solver_time:.3f}, probe hits: {self.probe_hits}, "
-            f"cdcl calls: {self.cdcl_calls}"
+            f"cdcl calls: {self.cdcl_calls}, "
+            f"unknown treated as unsat: {self.unknown_as_unsat}"
         )
 
 
@@ -493,11 +498,17 @@ class ProbeConfig:
         candidates_per_round: int = 48,
         timeout_ms: int = 10_000,
         rng_seed: int = 0x5EED,
+        prune_critical: bool = False,
     ):
         self.max_rounds = max_rounds
         self.candidates_per_round = candidates_per_round
         self.timeout_ms = timeout_ms
         self.rng_seed = rng_seed
+        # prune-critical queries (is_possible, frontier/batch pruning) kill
+        # paths on UNSAT: the exact CDCL tier is guaranteed a time slice even
+        # when the probe burned the whole deadline, so an UNKNOWN-driven
+        # prune only happens when the exact tier genuinely ran out of road
+        self.prune_critical = prune_critical
 
 
 class CandidateGenerator:
@@ -761,7 +772,8 @@ def check_satisfiable_batch(
     Returns one bool per input set (True = keep the state).
     """
     config = config or ProbeConfig(
-        max_rounds=2, candidates_per_round=24, timeout_ms=2000
+        max_rounds=2, candidates_per_round=24, timeout_ms=2000,
+        prune_critical=True,
     )
     results: List[Optional[bool]] = [None] * len(constraint_sets)
     pending: List[Tuple[int, List[Term], frozenset]] = []
@@ -794,6 +806,8 @@ def check_satisfiable_batch(
     for i, conj, _key in pending:
         if results[i] is None:
             status, _ = solve_conjunction(conj, config)
+            if status == UNKNOWN:
+                SolverStatistics().unknown_as_unsat += 1
             results[i] = status == SAT
     return [bool(r) for r in results]
 
@@ -913,6 +927,7 @@ def solve_conjunction(
                 candidates_per_round=config.candidates_per_round,
                 timeout_ms=remaining_ms,
                 rng_seed=config.rng_seed,
+                prune_critical=config.prune_critical,
             )
             status, asg = solve_conjunction(
                 bucket, sub_config, extra_seeds=extra_seeds, use_cache=use_cache
@@ -948,6 +963,32 @@ def solve_conjunction(
             return SAT, merged
         log.warning("independence-split merge produced an invalid model; "
                     "falling back to the joint probe")
+
+    # forced-exact mode (recall differential testing, CLI
+    # ``--probe-backend cdcl``): skip the heuristic probe entirely; only
+    # exact verdicts come back
+    if getattr(global_args, "probe_backend", "auto") == "cdcl":
+        result: Tuple[str, Optional[Assignment]] = (UNKNOWN, None)
+        try:
+            from mythril_tpu.native import bitblast
+
+            if bitblast.available():
+                stats.cdcl_calls += 1
+                status, asg = bitblast.solve(
+                    conjuncts, max(1.0, t0 + config.timeout_ms / 1000.0 - time.time())
+                )
+                if status == SAT and asg is not None:
+                    vals = evaluate(conjuncts, asg)
+                    if all(vals[c] for c in conjuncts):
+                        _model_cache.remember(cache_key, SAT, asg)
+                        result = (SAT, asg)
+                elif status == UNSAT:
+                    _model_cache.remember(cache_key, UNSAT, None)
+                    result = (UNSAT, None)
+        except ImportError:
+            pass
+        stats.solver_time += time.time() - t0
+        return result
 
     gen = CandidateGenerator(conjuncts, config)
     scalar_vars = gen.scalar_vars
@@ -1063,12 +1104,12 @@ def solve_conjunction(
         if bitblast.available():
             stats.cdcl_calls += 1
             budget = deadline - time.time()
-            if compiled is not None:
+            if compiled is not None or config.prune_critical:
                 # device-path queries may have burned the deadline on an XLA
-                # compile (first bucket in a cold process); that warm-up cost
-                # is not this query's fault — guarantee the exact tier a
-                # minimal slice instead of silently disabling it with a
-                # nonpositive timeout.  Host-only queries keep strict
+                # compile (first bucket in a cold process), and prune-critical
+                # queries kill paths on this verdict — guarantee the exact
+                # tier a minimal slice instead of silently disabling it with
+                # a nonpositive timeout.  Other host-only queries keep strict
                 # wall-clock discipline (mutation pruner's 500ms etc.).
                 budget = max(1.0, budget)
             status, asg = bitblast.solve(conjuncts, budget)
@@ -1166,12 +1207,16 @@ class Optimize(Solver):
         """Tighten one objective to its proven optimum (or best effort)."""
         width = obj.width
         top = (1 << width) - 1
-        cfg_step = ProbeConfig(
-            max_rounds=self.config.max_rounds,
-            candidates_per_round=self.config.candidates_per_round,
-            timeout_ms=max(1, self.config.timeout_ms // 4),
-            rng_seed=self.config.rng_seed,
-        )
+        def cfg_step() -> ProbeConfig:
+            # clamp each step to the remaining overall budget so check()
+            # cannot overrun its single deadline by a step's full slice
+            remaining_ms = max(1, int((deadline - time.time()) * 1000))
+            return ProbeConfig(
+                max_rounds=self.config.max_rounds,
+                candidates_per_round=self.config.candidates_per_round,
+                timeout_ms=min(max(1, self.config.timeout_ms // 4), remaining_ms),
+                rng_seed=self.config.rng_seed,
+            )
 
         def value(a) -> int:
             return evaluate([obj], a)[obj]
@@ -1181,14 +1226,14 @@ class Optimize(Solver):
         target = 0 if want_min else top
         if best != target and time.time() < deadline:
             status, a2 = solve_conjunction(
-                conj + [terms.eq(obj, terms.const(target, width))], cfg_step
+                conj + [terms.eq(obj, terms.const(target, width))], cfg_step()
             )
             if status == SAT and a2 is not None:
                 return a2, True
         steps = 0
 
         def ask(bound):
-            return solve_conjunction(conj + [bound], cfg_step)
+            return solve_conjunction(conj + [bound], cfg_step())
 
         if want_min:
             lo, hi = 0, best

@@ -34,7 +34,7 @@ class Args:
     # probe solver tuning
     probe_candidates: int = 48
     probe_rounds: int = 4
-    probe_backend: str = "auto"  # auto | host | jax
+    probe_backend: str = "auto"  # auto | host | jax | cdcl (forced exact)
     keccak_backend: str = "auto"  # auto | jax | pallas (pallas on TPU when auto)
     # auto-backend break-even: dispatch to device when DAG-size x candidates
     # exceeds this (host evaluation below it is faster than one round trip)

@@ -1,0 +1,77 @@
+"""Differential recall: host probe vs production hybrid vs forced-exact CDCL.
+
+VERDICT.md round-1 weak spot #2: the probe treats "no model found in budget"
+as unsat, which can silently prune feasible paths.  This suite measures that
+completeness boundary: the same contract corpus analyzed under three solver
+configurations must produce identical issue sets, and the
+``unknown_as_unsat`` counter (SolverStatistics) must stay at zero — i.e.
+every prune decision was backed by an exact verdict or a concrete model.
+"""
+
+import pytest
+
+from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.smt.solver import SolverStatistics, clear_model_cache
+from mythril_tpu.support.model import _get_model_cached
+from mythril_tpu.support.support_args import args as global_args
+
+# dispatcher prelude: selector(kill()=0x41c0e1b5) -> JUMPDEST at 0x14=20
+DISPATCH = "60003560e01c6341c0e1b5146014576000" + "6000fd" + "5b"
+
+# the corpus: 12 small contracts spanning the detector surface
+CORPUS = {
+    "selfdestruct": DISPATCH + "33ff",
+    "invalid": DISPATCH + "fe",
+    "tx_origin": DISPATCH + "323314601b5700" "5b00",
+    "overflow_sstore": DISPATCH + "600435" "6001" "01" "6000" "55" "00",
+    "timestamp": DISPATCH + "426064" "11" "601c57" "00" "5b00",
+    "clean_store": "602a60005500",
+    "ether_send": DISPATCH + "6000" "6000" "6000" "6000" "6064" "33" "61ffff" "f1" "00",
+    "double_send": DISPATCH
+    + ("6000" "6000" "6000" "6000" "6000" "33" "61ffff" "f1" "50") * 2
+    + "00",
+    "gated_kill": DISPATCH + "600054" "6001" "14" "601f" "57" "6000" "6000" "fd" "5b" "33ff",
+    "callvalue_branch": DISPATCH + "34" "6019" "57" "00" "5b" "33ff",
+    "underflow_sub": DISPATCH + "600435" "6001" "90" "03" "6000" "55" "00",
+    "caller_check": DISPATCH + "3373aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa14601f5760006000fd5b33ff",
+}
+
+
+def _analyze(code_hex: str, backend: str):
+    reset_callback_modules()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        m.cache.clear()
+    clear_model_cache()
+    _get_model_cached.cache_clear()
+    old = global_args.probe_backend
+    global_args.probe_backend = backend
+    try:
+        sym = SymExecWrapper(
+            bytes.fromhex(code_hex),
+            address=0x0901D12E,
+            strategy="dfs",
+            transaction_count=2,
+            execution_timeout=120,
+        )
+        issues = fire_lasers(sym)
+    finally:
+        global_args.probe_backend = old
+    return sorted((i.swc_id, i.address, i.title) for i in issues)
+
+
+@pytest.mark.parametrize("name", sorted(CORPUS))
+def test_recall_matches_across_solver_modes(name):
+    code = CORPUS[name]
+    stats = SolverStatistics()
+    stats.unknown_as_unsat = 0
+    host = _analyze(code, "host")
+    assert stats.unknown_as_unsat == 0, (
+        f"{name}: host probe pruned on UNKNOWN {stats.unknown_as_unsat} times"
+    )
+    cdcl = _analyze(code, "cdcl")
+    assert host == cdcl, f"{name}: host probe recall differs from exact CDCL"
+    auto = _analyze(code, "auto")
+    assert host == auto, f"{name}: production hybrid recall differs from host"
