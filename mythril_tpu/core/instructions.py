@@ -69,6 +69,7 @@ from mythril_tpu.smt import (
     ZeroExt,
     symbol_factory,
 )
+from mythril_tpu.support.support_args import args
 
 log = logging.getLogger(__name__)
 
@@ -671,12 +672,22 @@ class Instruction:
 
     @StateTransition()
     def coinbase_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("coinbase", 256))
+        env = global_state.environment
+        global_state.mstate.stack.append(
+            env.coinbase
+            if env.coinbase is not None
+            else global_state.new_bitvec("coinbase", 256)
+        )
         return [global_state]
 
     @StateTransition()
     def timestamp_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(symbol_factory.BitVecSym("timestamp", 256))
+        env = global_state.environment
+        global_state.mstate.stack.append(
+            env.timestamp
+            if env.timestamp is not None
+            else symbol_factory.BitVecSym("timestamp", 256)
+        )
         return [global_state]
 
     @StateTransition()
@@ -686,15 +697,21 @@ class Instruction:
 
     @StateTransition()
     def difficulty_(self, global_state: GlobalState) -> List[GlobalState]:
+        env = global_state.environment
         global_state.mstate.stack.append(
-            global_state.new_bitvec("block_difficulty", 256)
+            env.difficulty
+            if env.difficulty is not None
+            else global_state.new_bitvec("block_difficulty", 256)
         )
         return [global_state]
 
     @StateTransition()
     def gaslimit_(self, global_state: GlobalState) -> List[GlobalState]:
+        env = global_state.environment
         global_state.mstate.stack.append(
-            symbol_factory.BitVecVal(global_state.mstate.gas_limit, 256)
+            env.block_gaslimit
+            if env.block_gaslimit is not None
+            else symbol_factory.BitVecVal(global_state.mstate.gas_limit, 256)
         )
         return [global_state]
 
@@ -836,6 +853,20 @@ class Instruction:
 
     @StateTransition()
     def gas_(self, global_state: GlobalState) -> List[GlobalState]:
+        mstate = global_state.mstate
+        if args.concrete_gas:
+            # deterministic (concolic/conformance) replay: GAS pushes the
+            # remaining gas AFTER this instruction's own cost of 2, from the
+            # exact lower-bound accounting (min tracks real cost for every
+            # concretely-replayed op; reference skiplists these fixtures).
+            # Symbolic analysis keeps the fresh symbol below so gas never
+            # over-concretizes paths.
+            global_state.mstate.stack.append(
+                symbol_factory.BitVecVal(
+                    max(0, mstate.gas_limit - mstate.min_gas_used - 2), 256
+                )
+            )
+            return [global_state]
         global_state.mstate.stack.append(global_state.new_bitvec("gas", 256))
         return [global_state]
 

@@ -37,6 +37,13 @@ class Environment:
         # fresh per-environment symbols (reference environment.py:47-48)
         self.block_number = symbol_factory.BitVecSym("block_number", 256)
         self.chainid = symbol_factory.BitVecSym("chain_id", 256)
+        # optional CONCRETE block-env overrides (None -> fresh symbols at the
+        # opcode): set by the conformance/concolic drivers replaying fixtures
+        # with known block parameters (VMTests ``env`` section)
+        self.timestamp: Optional[BitVec] = None
+        self.coinbase: Optional[BitVec] = None
+        self.difficulty: Optional[BitVec] = None
+        self.block_gaslimit: Optional[BitVec] = None
 
     def __copy__(self) -> "Environment":
         out = Environment.__new__(Environment)

@@ -28,8 +28,13 @@ def execute_message_call(
     gas_price: int,
     value: int,
     track_gas: bool = False,
+    block_env: Optional[dict] = None,
 ):
-    """Replay one concrete message call (reference :75-130)."""
+    """Replay one concrete message call (reference :75-130).
+
+    ``block_env`` maps Environment attribute names (block_number, timestamp,
+    coinbase, difficulty, block_gaslimit) to concrete BitVecs so fixtures
+    with known block parameters replay exactly."""
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
     result = []
@@ -46,6 +51,7 @@ def execute_message_call(
             gas_price=_bv(gas_price),
             call_value=_bv(value),
             static=False,
+            block_env=block_env,
         )
         _setup(laser_evm, transaction)
         result = laser_evm.exec(track_gas=track_gas)

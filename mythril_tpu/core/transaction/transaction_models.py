@@ -87,6 +87,7 @@ class BaseTransaction:
         init_call_data: bool = True,
         static: bool = False,
         base_fee=None,
+        block_env: Optional[dict] = None,
     ):
         self.world_state = world_state
         self.id = identifier or tx_id_manager.get_next_tx_id()
@@ -117,6 +118,13 @@ class BaseTransaction:
         )
         self.static = static
         self.return_data = None
+        # optional concrete block parameters (Environment attribute -> BitVec)
+        # applied to every Environment this tx spawns; used by fixture replay
+        self.block_env = block_env
+
+    def _apply_block_env(self, environment) -> None:
+        for attr, value in (self.block_env or {}).items():
+            setattr(environment, attr, value)
 
     def initial_global_state_from_environment(self, environment, active_function):
         """Seed a GlobalState for this tx + the sender-balance constraint."""
@@ -162,6 +170,7 @@ class MessageCallTransaction(BaseTransaction):
             basefee=self.base_fee,
             static=self.static,
         )
+        self._apply_block_env(environment)
         return super().initial_global_state_from_environment(
             environment, active_function="fallback"
         )
@@ -202,6 +211,7 @@ class ContractCreationTransaction(BaseTransaction):
             code=self.code,
             basefee=self.base_fee,
         )
+        self._apply_block_env(environment)
         return super().initial_global_state_from_environment(
             environment, active_function="constructor"
         )

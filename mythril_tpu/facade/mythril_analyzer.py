@@ -83,6 +83,16 @@ class MythrilAnalyzer:
         args.checkpoint_path = getattr(cmd_args, "checkpoint_file", None)
         args.resume_from = getattr(cmd_args, "resume_from", None)
         args.probe_backend = getattr(cmd_args, "probe_backend", "auto")
+        if args.probe_backend == "cdcl":
+            # forced-exact mode without the native solver would answer every
+            # query UNKNOWN and silently prune the whole state space
+            from mythril_tpu.native import bitblast
+
+            if not bitblast.available():
+                raise RuntimeError(
+                    "--probe-backend cdcl requires the native CDCL solver "
+                    "(mythril_tpu/native); it is not available in this build"
+                )
         args.frontier = getattr(cmd_args, "frontier", False)
         args.frontier_width = getattr(cmd_args, "frontier_width", 64)
 

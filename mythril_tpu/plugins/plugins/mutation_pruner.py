@@ -14,7 +14,13 @@ from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
 from mythril_tpu.plugins.plugin_annotations import MutationAnnotation
 from mythril_tpu.plugins.signals import PluginSkipWorldState
 from mythril_tpu.smt import UGT, symbol_factory
-from mythril_tpu.smt.solver import ProbeConfig, SAT, solve_conjunction
+from mythril_tpu.smt.solver import (
+    ProbeConfig,
+    SAT,
+    UNKNOWN,
+    SolverStatistics,
+    solve_conjunction,
+)
 
 
 class MutationPruner(LaserPlugin):
@@ -43,9 +49,16 @@ class MutationPruner(LaserPlugin):
             status, _ = solve_conjunction(
                 global_state.world_state.constraints.get_all_raw()
                 + [UGT(value, symbol_factory.BitVecVal(0, 256)).raw],
-                ProbeConfig(max_rounds=1, candidates_per_round=16, timeout_ms=500),
+                ProbeConfig(
+                    max_rounds=1,
+                    candidates_per_round=16,
+                    timeout_ms=500,
+                    prune_critical=True,
+                ),
             )
             if status != SAT:
+                if status == UNKNOWN:
+                    SolverStatistics().unknown_as_unsat += 1
                 raise PluginSkipWorldState
 
         symbolic_vm.register_laser_hooks("add_world_state", world_state_filter_hook)

@@ -42,6 +42,9 @@ class Args:
     # frontier checkpointing
     checkpoint_path: Optional[str] = None
     resume_from: Optional[str] = None
+    # deterministic replay only: GAS pushes the exact remaining gas instead
+    # of a fresh symbol (conformance/concolic drivers; never symbolic runs)
+    concrete_gas: bool = False
     # batched device-resident frontier interpreter (SURVEY.md §7.1)
     frontier: bool = False  # run message-call txs on the device frontier
     frontier_width: int = 64  # batch width B (paths held on device)
