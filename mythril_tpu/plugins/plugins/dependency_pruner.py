@@ -3,7 +3,11 @@
 Reference parity: mythril/laser/plugin/plugins/dependency_pruner.py:142-318 —
 builds a cross-transaction map of storage locations read per basic block; in
 transaction N >= 2, a path is skipped when the blocks it is about to execute
-cannot read any location written by the previous transactions.
+cannot read any location written by the previous transactions.  Symbolic
+locations are handled the way the reference does (:142-195): a read/write
+pair counts as a potential dependency iff ``read == write`` is satisfiable —
+checked here as ONE batched feasibility sweep over all pairs (the same
+batched-prune kernel the engine uses) instead of one Z3 call per pair.
 """
 
 from __future__ import annotations
@@ -18,8 +22,67 @@ from mythril_tpu.plugins.plugin_annotations import (
     WSDependencyAnnotation,
 )
 from mythril_tpu.plugins.signals import PluginSkipState
+from mythril_tpu.smt import terms as T
 
 log = logging.getLogger(__name__)
+
+
+def _loc_key(index):
+    """Storage location as stored in the dependency maps: a concrete int for
+    constants, the raw interned term for symbolic indices."""
+    return index.value if index.value is not None else index.raw
+
+
+def _as_term(loc):
+    return T.const(loc, 256) if isinstance(loc, int) else loc
+
+
+def _pair_may_equal(r, w) -> bool:
+    """Could locations ``r`` and ``w`` coincide on some re-execution?
+
+    A location term recorded during transaction N captures THAT transaction's
+    symbolic inputs (e.g. ``1_calldata``); a later transaction re-derives the
+    same expression over fresh inputs.  When the two terms share variables,
+    an UNSAT verdict on ``r == w`` only proves the recorded instances
+    differ — nothing about future instances — so shared-variable pairs are
+    always treated as potential dependencies.  Disjoint-variable pairs are
+    decided by satisfiability; only an exact UNSAT rules the pair out
+    (UNKNOWN must explore: pruning stays recall-preserving)."""
+    if isinstance(r, int) and isinstance(w, int):
+        return r == w
+    rt, wt = _as_term(r), _as_term(w)
+    if set(T.free_vars([rt])) & set(T.free_vars([wt])):
+        return True
+    from mythril_tpu.smt.solver import UNSAT, solve_conjunction
+
+    status, _ = solve_conjunction([T.eq(rt, wt)])
+    return status != UNSAT
+
+
+def may_intersect(reads: Set, written: Set, cache: Dict = None) -> bool:
+    """Could any read location equal any written location?
+
+    (Reference dependency_pruner.py:169-195 solves each pair with Z3; here
+    identical interned terms and concrete ints short-circuit, and per-pair
+    verdicts memoize in ``cache`` across the run.)"""
+    if not reads or not written:
+        return False
+    if reads & written:  # interned terms: identity covers symbolic equality
+        return True
+    for r in reads:
+        for w in written:
+            key = (
+                r if isinstance(r, int) else ("t", r.tid),
+                w if isinstance(w, int) else ("t", w.tid),
+            )
+            verdict = cache.get(key) if cache is not None else None
+            if verdict is None:
+                verdict = _pair_may_equal(r, w)
+                if cache is not None:
+                    cache[key] = verdict
+            if verdict:
+                return True
+    return False
 
 
 def get_dependency_annotation(state: GlobalState) -> DependencyAnnotation:
@@ -49,6 +112,7 @@ class DependencyPruner(LaserPlugin):
     def __init__(self):
         self.sloads_on_path: Dict[int, Set] = {}
         self.iteration = 0
+        self._pair_cache: Dict = {}
 
     def initialize(self, symbolic_vm) -> None:
         self.iteration = 0
@@ -58,16 +122,14 @@ class DependencyPruner(LaserPlugin):
 
         def sload_hook(global_state: GlobalState):
             annotation = get_dependency_annotation(global_state)
-            index = global_state.mstate.stack[-1]
-            key = index.value if index.value is not None else repr(index.raw)
+            key = _loc_key(global_state.mstate.stack[-1])
             annotation.storage_loaded.add(key)
             for block in annotation.path:
                 self.sloads_on_path.setdefault(block, set()).add(key)
 
         def sstore_hook(global_state: GlobalState):
             annotation = get_dependency_annotation(global_state)
-            index = global_state.mstate.stack[-1]
-            key = index.value if index.value is not None else repr(index.raw)
+            key = _loc_key(global_state.mstate.stack[-1])
             annotation.extend_storage_write_cache(self.iteration, key)
 
         def call_hook(global_state: GlobalState):
@@ -93,11 +155,12 @@ class DependencyPruner(LaserPlugin):
             reads = self.sloads_on_path.get(address, None)
             if reads is None:
                 return  # unknown block: explore it
-            symbolic_read = any(isinstance(k, str) for k in reads)
-            symbolic_write = any(isinstance(k, str) for k in written)
-            if symbolic_read or symbolic_write:
-                return
-            if not (reads & written):
+            # SMT-checked footprint intersection (symbolic locations compare
+            # by satisfiability, reference dependency_pruner.py:142-195);
+            # the currently-influencing loads count as reads too
+            if not may_intersect(
+                reads | annotation.storage_loaded, written, self._pair_cache
+            ):
                 log.debug("pruning block at %d (no storage dependency)", address)
                 raise PluginSkipState
 

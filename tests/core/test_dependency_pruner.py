@@ -1,0 +1,102 @@
+"""Dependency-pruner footprint intersection, including symbolic locations.
+
+Reference behavior being matched: mythril/laser/plugin/plugins/
+dependency_pruner.py:142-195 — a read/write pair is a potential dependency
+iff ``read == write`` is satisfiable, so a symbolic-index SSTORE in tx1 must
+unlock a concretely-indexed dependent block in tx2.
+"""
+
+from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.plugins.plugins.dependency_pruner import may_intersect
+from mythril_tpu.smt import terms as T
+
+
+def test_concrete_footprints():
+    assert may_intersect({3}, {3})
+    assert not may_intersect({3}, {4})
+    assert not may_intersect(set(), {4})
+    assert not may_intersect({3}, set())
+
+
+def test_symbolic_vs_concrete_possible():
+    x = T.var("dep_x", 256)
+    # a free symbolic write may hit any concrete slot
+    assert may_intersect({5}, {x})
+    assert may_intersect({x}, {5})
+
+
+def test_shared_variable_pair_never_pruned():
+    x = T.var("dep_y", 256)
+    a = T.add(x, T.const(1, 256))
+    b = T.add(x, T.const(2, 256))
+    # x+1 == x+2 is unsat for the RECORDED instances, but a later tx
+    # re-derives the expressions over fresh inputs — shared-variable pairs
+    # must always count as potential dependencies (recall preservation)
+    assert may_intersect({a}, {b})
+
+
+def test_disjoint_variable_pair_provably_unsat():
+    x = T.var("dep_z", 256)
+    a = T.band(x, T.const(1, 256))  # can only be 0 or 1
+    # a == 2 is unsat and the terms share no variables with {2}
+    assert not may_intersect({a}, {2})
+
+
+def test_unknown_counts_as_intersection():
+    # keccak preimage questions may exhaust the probe; uncertainty must
+    # never prune (recall preservation)
+    h = T.keccak(T.var("dep_h", 512))
+    result = may_intersect({h}, {5})
+    # either the solver decides it (sat: some preimage maps to 5 is in fact
+    # astronomically unlikely but the probe can't prove unsat) or it stays
+    # unknown — both must explore
+    assert result is True
+
+
+# contract: activate(bytes32 slot) stores 1 at a CALLDATA-CHOSEN slot;
+# kill() selfdestructs iff storage[5] == 1.  The symbolic-index write in tx1
+# must be recognized as potentially hitting slot 5.
+SYM_SLOT_KILL = (
+    "6000" "35" "60e0" "1c" "80"
+    "630a11ce00" "14" "610020" "57"
+    "6341c0e1b5" "14" "610028" "57"
+    "60006000fd"
+    # 0x20 activate: SSTORE(calldataload(4), 1); STOP
+    "5b" "6001" "600435" "55" "00"
+    # 0x28 kill: require(storage[5] == 1); SELFDESTRUCT(CALLER)
+    "5b" "600554" "6001" "14" "610038" "57" "60006000fd" "5b" "33ff"
+)
+
+
+def test_symbolic_write_unlocks_dependent_block():
+    reset_callback_modules()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.frontend.evmcontract import EVMContract
+
+    for m in ModuleLoader().get_detection_modules():
+        m.cache.clear()
+    # deploy via a creation tx so storage starts concretely zero — the kill
+    # gate is then only reachable through tx1's symbolic-index write
+    length = f"{len(SYM_SLOT_KILL) // 2:02x}"
+    creation = f"60{length}600c60003960{length}6000f3" + SYM_SLOT_KILL
+    contract = EVMContract(
+        code=SYM_SLOT_KILL, creation_code=creation, name="SymSlotKill"
+    )
+    sym = SymExecWrapper(
+        contract,
+        address=0x0901D12E,
+        strategy="bfs",
+        transaction_count=3,
+        execution_timeout=120,
+        modules=["AccidentallyKillable"],
+    )
+    issues = fire_lasers(sym, white_list=["AccidentallyKillable"])
+    assert len(issues) == 1
+    assert issues[0].swc_id == "106"
+    steps = issues[0].transaction_sequence["steps"]
+    # tx1 must be activate() with calldata choosing slot 5
+    activate = steps[-2]["input"]
+    assert activate.startswith("0x0a11ce")
+    kill = steps[-1]["input"]
+    assert kill.startswith("0x41c0e1b5")
