@@ -61,9 +61,12 @@ class EthJsonRpc:
     ) -> str:
         return self._call("eth_getStorageAt", [address, hex(position), default_block])
 
-    def eth_getBalance(self, address: str, default_block: str = "latest") -> int:
-        result = self._call("eth_getBalance", [address, default_block])
+    def _call_int(self, method: str, params=None) -> int:
+        result = self._call(method, params)
         return int(result, 16) if result else 0
+
+    def eth_getBalance(self, address: str, default_block: str = "latest") -> int:
+        return self._call_int("eth_getBalance", [address, default_block])
 
     def eth_getTransactionByHash(self, tx_hash: str):
         return self._call("eth_getTransactionByHash", [tx_hash])
@@ -73,3 +76,20 @@ class EthJsonRpc:
 
     def eth_blockNumber(self) -> int:
         return int(self._call("eth_blockNumber"), 16)
+
+    def eth_coinbase(self) -> str:
+        return self._call("eth_coinbase")
+
+    def eth_getBlockByNumber(self, block="latest", tx_objects: bool = True):
+        if isinstance(block, int):
+            block = hex(block)
+        return self._call("eth_getBlockByNumber", [block, tx_objects])
+
+    def eth_getTransactionCount(self, address: str, default_block: str = "latest") -> int:
+        return self._call_int("eth_getTransactionCount", [address, default_block])
+
+    def eth_call(self, to: str, data: str, default_block: str = "latest") -> str:
+        return self._call("eth_call", [{"to": to, "data": data}, default_block])
+
+    def close(self) -> None:
+        """API parity with the reference client; urllib holds no connection."""

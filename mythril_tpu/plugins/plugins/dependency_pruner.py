@@ -37,51 +37,71 @@ def _as_term(loc):
     return T.const(loc, 256) if isinstance(loc, int) else loc
 
 
-def _pair_may_equal(r, w) -> bool:
-    """Could locations ``r`` and ``w`` coincide on some re-execution?
-
-    A location term recorded during transaction N captures THAT transaction's
-    symbolic inputs (e.g. ``1_calldata``); a later transaction re-derives the
-    same expression over fresh inputs.  When the two terms share variables,
-    an UNSAT verdict on ``r == w`` only proves the recorded instances
-    differ — nothing about future instances — so shared-variable pairs are
-    always treated as potential dependencies.  Disjoint-variable pairs are
-    decided by satisfiability; only an exact UNSAT rules the pair out
-    (UNKNOWN must explore: pruning stays recall-preserving)."""
-    if isinstance(r, int) and isinstance(w, int):
-        return r == w
-    rt, wt = _as_term(r), _as_term(w)
-    if set(T.free_vars([rt])) & set(T.free_vars([wt])):
-        return True
-    from mythril_tpu.smt.solver import UNSAT, solve_conjunction
-
-    status, _ = solve_conjunction([T.eq(rt, wt)])
-    return status != UNSAT
+def _key_of(loc):
+    return (0, loc) if isinstance(loc, int) else (1, loc.tid)
 
 
 def may_intersect(reads: Set, written: Set, cache: Dict = None) -> bool:
     """Could any read location equal any written location?
 
-    (Reference dependency_pruner.py:169-195 solves each pair with Z3; here
-    identical interned terms and concrete ints short-circuit, and per-pair
-    verdicts memoize in ``cache`` across the run.)"""
+    Fast paths: identical interned terms / equal ints.  A location term
+    recorded during transaction N captures THAT transaction's symbolic
+    inputs (e.g. ``1_calldata``); a later transaction re-derives the same
+    expression over fresh inputs, so when two terms SHARE variables an
+    UNSAT on ``r == w`` proves nothing about future instances — such pairs
+    always count as potential dependencies.  Variable-disjoint pairs are
+    decided by satisfiability of ``r == w``: one batched sweep first
+    (reference dependency_pruner.py:169-195 solves each pair with Z3), then
+    an exact-UNSAT confirmation for the survivors, because the batch treats
+    UNKNOWN as unsat and pruning must explore on uncertainty.  Verdicts
+    memoize in ``cache`` (symmetric keys) across the run."""
     if not reads or not written:
         return False
     if reads & written:  # interned terms: identity covers symbolic equality
         return True
+
+    undecided = []  # (key, eq term)
     for r in reads:
         for w in written:
-            key = (
-                r if isinstance(r, int) else ("t", r.tid),
-                w if isinstance(w, int) else ("t", w.tid),
-            )
+            key = tuple(sorted((_key_of(r), _key_of(w))))
             verdict = cache.get(key) if cache is not None else None
-            if verdict is None:
-                verdict = _pair_may_equal(r, w)
-                if cache is not None:
-                    cache[key] = verdict
-            if verdict:
+            if verdict is True:
                 return True
+            if verdict is False:
+                continue
+            if isinstance(r, int) and isinstance(w, int):
+                if cache is not None:
+                    cache[key] = r == w
+                if r == w:
+                    return True
+                continue
+            rt, wt = _as_term(r), _as_term(w)
+            if set(T.free_vars([rt])) & set(T.free_vars([wt])):
+                if cache is not None:
+                    cache[key] = True
+                return True
+            undecided.append((key, T.eq(rt, wt)))
+    if not undecided:
+        return False
+
+    from mythril_tpu.smt.solver import UNSAT, check_satisfiable_batch, solve_conjunction
+
+    flags = check_satisfiable_batch([[eq] for _k, eq in undecided])
+    hit = False
+    for (key, eq), sat in zip(undecided, flags):
+        if sat:
+            if cache is not None:
+                cache[key] = True
+            hit = True
+    if hit:
+        return True
+    for key, eq in undecided:
+        status, _ = solve_conjunction([eq])
+        if status != UNSAT:
+            # uncertainty: explore (do not cache — a later budget may decide)
+            return True
+        if cache is not None:
+            cache[key] = False
     return False
 
 
@@ -167,6 +187,12 @@ class DependencyPruner(LaserPlugin):
         def add_world_state_hook(global_state: GlobalState):
             annotation = get_dependency_annotation(global_state)
             ws_annotation = get_ws_dependency_annotation(global_state)
+            # reset per-tx tracking; only storage_written carries over to the
+            # next transaction (reference dependency_pruner.py:331-336) — an
+            # uncleared storage_loaded would make every later footprint check
+            # intersect and silently disable the pruner
+            annotation.path = [0]
+            annotation.storage_loaded = set()
             ws_annotation.annotations_stack.append(annotation)
 
         symbolic_vm.register_laser_hooks("start_sym_trans", start_sym_trans_hook)

@@ -14,6 +14,13 @@ from mythril_tpu.support.support_args import args as global_args
 
 def analyze(code_hex: str, tx_count=1, modules=None):
     reset_callback_modules()
+    # the (pc, bytecode-hash) issue cache persists across analyses in one
+    # process (reference base.py:70-95); other suites analyze the same
+    # fixtures, so clear it for order-independence
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        m.cache.clear()
     sym = SymExecWrapper(
         bytes.fromhex(code_hex),
         address=0x0901D12E,
