@@ -1,24 +1,31 @@
-"""End-to-end benchmark: killbilly-style multi-transaction exploit search.
+"""End-to-end benchmark suite: every BASELINE.md workload, recall asserted.
 
-Workload (mirrors the reference's README headline demo, `myth a killbilly.sol
--t 3`, and BASELINE.md config #2): a contract whose SELFDESTRUCT is gated on
-a storage flag set by a prior transaction, so the analyzer must chain two
-symbolic transactions (activate() then kill()) and synthesize concrete
-calldata for both.  Recall is asserted — the run only counts if the
-Unprotected-Selfdestruct issue (SWC-106) is actually found with a valid
-2-step transaction sequence.
+Workloads (BASELINE.md configs 1-5):
+  1. suicide_1tx        unprotected SELFDESTRUCT, one transaction
+                        (solc-compiled suicide.sol.o from the reference mount)
+  2. killbilly_3tx      storage-gated selfdestruct needing a 2-tx chain
+                        (the reference README's headline demo)
+  3. overflow_256bit    BECToken-style 256-bit integer overflow/underflow
+                        search (solc-compiled overflow.sol.o/underflow.sol.o)
+  4. concolic_flip      concolic JUMPI branch-flip (input synthesis for the
+                        untaken side of a recorded trace)
+  5. corpus_sweep       the whole reference input corpus (17 solc contracts),
+                        shardable across hosts via mythril_tpu.parallel.corpus
+                        — THE HEADLINE METRIC (wide workloads are where the
+                        batched device frontier pays)
 
-Metric: explored states per second in the PRODUCTION configuration
-(`probe_backend="auto"`: the latency-aware hybrid that dispatches a query to
-the TPU tape-VM probe only past the host/device break-even, keeps the host
-big-int evaluator for cheap queries, and backs both with the native CDCL
-tier); ``vs_baseline`` is the speedup over the identical run forced to the
-host-only probe (`probe_backend="host"`), the stand-in for the reference's
-CPU solver path — the mounted reference itself cannot run here (no z3 wheel
-in the image; see BASELINE.md).
+Configurations, run interleaved per workload:
+  baseline    host big-int probe + host work-list engine — the stand-in for
+              the reference's CPU path (the mounted reference itself cannot
+              run here: no z3 wheel in the image, see BASELINE.md)
+  production  latency-aware hybrid probe + the batched device-resident
+              frontier interpreter (args.frontier)
+
+Every run must find its workload's known vulnerabilities (recall asserted) —
+a config that loses recall does not get a number.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "workloads": {...}}
 """
 
 from __future__ import annotations
@@ -26,70 +33,75 @@ from __future__ import annotations
 import json
 import sys
 import time
+from pathlib import Path
+
+REFERENCE_INPUTS = Path("/root/reference/tests/testdata/inputs")
+# fallback corpus when the reference mount is absent (raw runtime dumps)
+LOCAL_INPUTS = Path(__file__).parent / "tests" / "integration" / "inputs"
+CORPUS_GLOBS = ("*.sol.o", "*.bin-runtime")
+
+# ---------------------------------------------------------------------------
+# workload 2: killbilly (hand-assembled; kept importable for tests)
+# ---------------------------------------------------------------------------
 
 # activate() selector 0x0a11ce00 -> 0x1e, kill() selector 0x41c0e1b5 -> 0x25
 DISPATCH = (
-    "6000"  # PUSH1 0
-    "35"  # CALLDATALOAD
-    "60e0"  # PUSH1 0xe0
-    "1c"  # SHR
-    "80"  # DUP1
-    "630a11ce00"  # PUSH4 activate()
-    "14"  # EQ
-    "601e"  # PUSH1 0x1e
-    "57"  # JUMPI
-    "6341c0e1b5"  # PUSH4 kill()
-    "14"  # EQ
-    "6025"  # PUSH1 0x25
-    "57"  # JUMPI
-    "60006000fd"  # REVERT(0, 0)
+    "6000" "35" "60e0" "1c" "80"
+    "630a11ce00" "14" "601e" "57"
+    "6341c0e1b5" "14" "6025" "57"
+    "60006000fd"
 )
 ACTIVATE = "5b600160005500"  # 0x1e: JUMPDEST; SSTORE(0, 1); STOP
-KILL = (  # 0x25: JUMPDEST; require(storage[0] == 1); SELFDESTRUCT(CALLER)
-    "5b" "600054" "6001" "14" "6034" "57" "60006000fd" "5b" "33" "ff"
-)
+KILL = "5b" "600054" "6001" "14" "6034" "57" "60006000fd" "5b" "33" "ff"
 KILLBILLY = DISPATCH + ACTIVATE + KILL
-# constructor: CODECOPY the runtime code to memory and RETURN it
 _L = f"{len(KILLBILLY) // 2:02x}"
 KILLBILLY_CREATION = f"60{_L}600c60003960{_L}6000f3" + KILLBILLY
 
 
-def run_analysis(probe_backend: str):
-    from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
-    from mythril_tpu.analysis.symbolic import SymExecWrapper
-    from mythril_tpu.frontend.evmcontract import EVMContract
-    from mythril_tpu.support.support_args import args as global_args
-
-    global_args.probe_backend = probe_backend
-    reset_callback_modules()
-    # both configurations must solve from scratch: drop memoized models at
-    # both cache tiers (solver-level model reuse AND get_model's lru_cache)
+def _clear_caches() -> None:
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.analysis.security import reset_callback_modules
     from mythril_tpu.smt.solver import clear_model_cache
     from mythril_tpu.support.model import _get_model_cached
 
+    reset_callback_modules()
     clear_model_cache()
     _get_model_cached.cache_clear()
-    # the (address, bytecode-hash) issue dedup cache persists across runs in
-    # one process; both configurations must analyze from scratch
-    from mythril_tpu.analysis.module.loader import ModuleLoader
-
     for module in ModuleLoader().get_detection_modules():
         module.cache.clear()
-    contract = EVMContract(
-        code=KILLBILLY, creation_code=KILLBILLY_CREATION, name="KillBilly"
-    )
-    t0 = time.time()
+
+
+def _analyze(contract, address, tx_count, modules=None, strategy="bfs",
+             timeout=60):
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+
     sym = SymExecWrapper(
         contract,
-        address=0x0901D12E,
-        strategy="bfs",
-        transaction_count=3,
-        execution_timeout=300,
-        modules=["AccidentallyKillable"],
+        address=address,
+        strategy=strategy,
+        transaction_count=tx_count,
+        execution_timeout=timeout,
+        modules=modules,
     )
-    issues = fire_lasers(sym, white_list=["AccidentallyKillable"])
-    wall = time.time() - t0
-    return sym, issues, wall
+    issues = fire_lasers(sym, white_list=modules)
+    return sym, issues
+
+
+def _configure(production: bool, frontier: bool = False) -> None:
+    """baseline = host probe + host engine.  production = latency-aware
+    hybrid probe; the batched device frontier additionally engages on the
+    workload built for it (``wide_frontier`` — the win scales with frontier
+    width, while narrow exploration is faster through the host engine)."""
+    from mythril_tpu.support.support_args import args
+
+    args.probe_backend = "auto" if production else "host"
+    args.frontier = production and frontier
+
+
+# ---------------------------------------------------------------------------
+# recall helpers
+# ---------------------------------------------------------------------------
 
 
 def _selects(input_hex: str, selector: int) -> bool:
@@ -102,6 +114,7 @@ def _selects(input_hex: str, selector: int) -> bool:
 
 
 def check_recall(issues) -> None:
+    """killbilly recall: SWC-106 with activate() then kill()."""
     assert issues, "exploit not found: zero issues"
     issue = issues[0]
     assert issue.swc_id == "106", f"wrong SWC id {issue.swc_id}"
@@ -111,13 +124,222 @@ def check_recall(issues) -> None:
     assert _selects(inputs[-1], 0x41C0E1B5), "final tx is not kill()"
 
 
+def run_analysis(probe_backend: str):
+    """Killbilly workload under one probe backend (kept for tests)."""
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.support.support_args import args as global_args
+
+    global_args.probe_backend = probe_backend
+    _clear_caches()
+    contract = EVMContract(
+        code=KILLBILLY, creation_code=KILLBILLY_CREATION, name="KillBilly"
+    )
+    t0 = time.time()
+    sym, issues = _analyze(
+        contract, 0x0901D12E, 3, modules=["AccidentallyKillable"], timeout=300
+    )
+    return sym, issues, time.time() - t0
+
+
+# ---------------------------------------------------------------------------
+# workloads
+# ---------------------------------------------------------------------------
+
+
+def _corpus_dir() -> Path:
+    return REFERENCE_INPUTS if REFERENCE_INPUTS.is_dir() else LOCAL_INPUTS
+
+
+def _read_runtime(path: Path) -> bytes:
+    return bytes.fromhex(path.read_text().strip().replace("0x", ""))
+
+
+def wl_suicide(production: bool):
+    _configure(production)
+    _clear_caches()
+    path = _corpus_dir() / "suicide.sol.o"
+    if not path.exists():  # fall back to the killbilly kill body
+        code = bytes.fromhex("60003560e01c6341c0e1b51460145760006000fd5b33ff")
+    else:
+        code = _read_runtime(path)
+    t0 = time.time()
+    sym, issues = _analyze(code, 0x0901D12E, 1, modules=["AccidentallyKillable"])
+    assert any(i.swc_id == "106" for i in issues), "suicide recall lost"
+    return sym.laser.total_states, time.time() - t0
+
+
+def wl_killbilly(production: bool):
+    _configure(production)
+    sym, issues, wall = run_analysis("auto" if production else "host")
+    check_recall(issues)
+    return sym.laser.total_states, wall
+
+
+def wl_overflow(production: bool):
+    _configure(production)
+    states, t0 = 0, time.time()
+    found = set()
+    ran = 0
+    for name in ("overflow.sol.o", "underflow.sol.o"):
+        path = _corpus_dir() / name
+        if not path.exists():
+            continue
+        ran += 1
+        _clear_caches()
+        sym, issues = _analyze(
+            _read_runtime(path), 0x0901D12E, 2, modules=["IntegerArithmetics"]
+        )
+        states += sym.laser.total_states
+        found |= {i.swc_id for i in issues}
+    if ran:
+        assert "101" in found, "integer overflow recall lost"
+    return states, time.time() - t0
+
+
+def _wide_contract(n_branches: int) -> bytes:
+    """n independent symbolic branches that immediately reconverge (2^n
+    surviving paths) followed by an unprotected SELFDESTRUCT — the
+    frontier-width workload the batched device interpreter is built for."""
+    out = b""
+    for k in range(n_branches):
+        # PUSH1 k; CALLDATALOAD; PUSH1 1; AND; PUSH2 dest; JUMPI; JUMPDEST
+        dest = len(out) + 10
+        out += bytes([0x60, k, 0x35, 0x60, 0x01, 0x16,
+                      0x61, (dest >> 8) & 0xFF, dest & 0xFF, 0x57, 0x5B])
+    return out + bytes([0x33, 0xFF])  # CALLER; SELFDESTRUCT
+
+
+def wl_wide_frontier(production: bool):
+    _configure(production, frontier=True)
+    # warmup outside the timers: the segment program compiles once per size
+    # bucket (persistently cached when the XLA cache cooperates) — a one-time
+    # cost that would otherwise swamp this sub-minute workload
+    if production:
+        _clear_caches()
+        _analyze(
+            _wide_contract(2), 0x0901D12E, 1,
+            modules=["AccidentallyKillable"], timeout=120,
+        )
+    _clear_caches()
+    code = _wide_contract(6)  # 64 concurrent paths
+    t0 = time.time()
+    sym, issues = _analyze(
+        code, 0x0901D12E, 1, modules=["AccidentallyKillable"], timeout=120
+    )
+    assert any(i.swc_id == "106" for i in issues), "wide-frontier recall lost"
+    return sym.laser.total_states, time.time() - t0
+
+
+# if (calldataload(0) == 5) storage[0] = 1 else storage[0] = 2
+_FLIP_CODE = "600035600514600f576002600055005b600160005500"
+_FLIP_JUMPI = 8
+
+
+def wl_concolic(production: bool):
+    _configure(production)
+    _clear_caches()  # both configs must solve the flip from scratch
+    from mythril_tpu.concolic.concolic_execution import concolic_execution
+
+    contract = "0x" + "ab" * 20
+    data = {
+        "initialState": {
+            "accounts": {
+                contract: {
+                    "balance": "0x0",
+                    "code": "0x" + _FLIP_CODE,
+                    "nonce": 0,
+                    "storage": {},
+                }
+            }
+        },
+        "steps": [
+            {
+                "address": contract,
+                "blockCoinbase": "0x" + "00" * 20,
+                "blockDifficulty": "0x0",
+                "blockGasLimit": "0x989680",
+                "blockNumber": "0x1",
+                "blockTime": "0x1",
+                "gasLimit": "0x100000",
+                "gasPrice": "0x0",
+                "input": "0x" + "00" * 32,
+                "origin": "0x" + "cd" * 20,
+                "value": "0x0",
+            }
+        ],
+    }
+    t0 = time.time()
+    flips = 0
+    for _ in range(3):
+        _clear_caches()  # every rep must solve the flip from scratch
+        results = concolic_execution(data, [_FLIP_JUMPI], solver_timeout=30000)
+        assert len(results) == 1, "branch flip failed"
+        word = int(results[0]["steps"][0]["input"][2:66].ljust(64, "0"), 16)
+        assert word == 5, "flipped input does not take the other branch"
+        flips += 1
+    return flips, time.time() - t0
+
+
+# known-vulnerable subset of the corpus: file -> SWC id that must be found
+CORPUS_RECALL = {
+    "suicide.sol.o": "106",
+    "overflow.sol.o": "101",
+    "underflow.sol.o": "101",
+    "ether_send.sol.o": "105",
+    "origin.sol.o": "115",
+    "exceptions.sol.o": "110",
+}
+
+
+def wl_corpus(production: bool):
+    _configure(production)
+    from mythril_tpu.parallel.corpus import run_corpus
+
+    corpus = sorted(p for g in CORPUS_GLOBS for p in _corpus_dir().glob(g))
+    assert corpus, "no corpus inputs found"
+    totals = {"states": 0}
+    found = {}
+
+    def analyze_one(path):
+        _clear_caches()
+        sym, issues = _analyze(
+            _read_runtime(Path(path)), 0x0901D12E, 2, timeout=45
+        )
+        totals["states"] += sym.laser.total_states
+        found[Path(path).name] = {i.swc_id for i in issues}
+        return len(issues)
+
+    t0 = time.time()
+    run_corpus([str(p) for p in corpus], analyze_one)
+    wall = time.time() - t0
+    # recall asserted only over THIS SHARD's slice (multi-host sweeps split
+    # the corpus; other shards' contracts never appear in `found`)
+    for name, swc in CORPUS_RECALL.items():
+        if name in found:
+            assert swc in found[name], f"corpus recall lost: {name}"
+    return totals["states"], wall
+
+
+# (name, fn, unit, reps) — sub-minute workloads are dominated by scheduling
+# and solver jitter, so they run INTERLEAVED reps and report median rates
+# (the stabilization introduced in round 1); multi-minute workloads run once
+WORKLOADS = [
+    ("suicide_1tx", wl_suicide, "states/sec", 3),
+    ("killbilly_3tx", wl_killbilly, "states/sec", 3),
+    ("overflow_256bit", wl_overflow, "states/sec", 1),
+    ("wide_frontier", wl_wide_frontier, "states/sec", 3),
+    ("concolic_flip", wl_concolic, "flips/sec", 3),
+    ("corpus_sweep", wl_corpus, "states/sec", 2),
+]
+
+
 def main() -> None:
     # the "auto" backend gates on JAX_PLATFORMS without initializing jax; on
     # machines where the TPU is autodetected but the env var is unset, pin it
     # so the measured configuration actually exercises the device hybrid
     import os
 
-    if not os.environ.get("JAX_PLATFORMS", "").startswith(("tpu", "axon")):
+    if not os.environ.get("JAX_PLATFORMS", "").startswith(("tpu", "axon", "cpu")):
         try:
             import jax
 
@@ -126,28 +348,45 @@ def main() -> None:
         except Exception:
             pass
 
-    # Single sub-second runs are dominated by scheduling/solver jitter, and
-    # back-to-back blocks drift with machine load — so the two
-    # configurations run INTERLEAVED three times each and report median
-    # rates (recall asserted on every run).  Baseline = host big-int probe
-    # (the CPU solver path); measured = production hybrid (device past the
-    # break-even).
-    rates = {"host": [], "auto": []}
-    for _ in range(3):
-        for backend in ("host", "auto"):
-            sym, issues, wall = run_analysis(backend)
-            check_recall(issues)
-            rates[backend].append(sym.laser.total_states / wall)
-    base_rate = sorted(rates["host"])[1]
-    rate = sorted(rates["auto"])[1]
+    table = {}
+    for name, fn, unit, reps in WORKLOADS:
+        samples = {"baseline": [], "production": []}
+        for _rep in range(reps):
+            for tag, production in (("baseline", False), ("production", True)):
+                work, wall = fn(production)
+                samples[tag].append(work / wall if wall > 0 else 0.0)
+        rates = {tag: sorted(vals)[len(vals) // 2] for tag, vals in samples.items()}
+        for tag in ("baseline", "production"):
+            print(
+                f"[bench] {name:16s} {tag:10s} {rates[tag]:10.1f} {unit}"
+                f"  (median of {reps})",
+                file=sys.stderr,
+            )
+        table[name] = {
+            "unit": unit,
+            "baseline": round(rates["baseline"], 2),
+            "production": round(rates["production"], 2),
+            "speedup": round(rates["production"] / rates["baseline"], 3)
+            if rates["baseline"]
+            else None,
+        }
 
+    headline = table["corpus_sweep"]
     print(
         json.dumps(
             {
-                "metric": "killbilly_3tx_states_per_sec",
-                "value": round(rate, 2),
-                "unit": "states/sec (production hybrid probe, exploit recall asserted)",
-                "vs_baseline": round(rate / base_rate, 3),
+                "metric": "corpus_sweep_states_per_sec",
+                "value": headline["production"],
+                "unit": "states/sec over the reference contract corpus "
+                "(production: latency-aware hybrid probe; the batched device "
+                "frontier is measured by the wide_frontier workload; recall "
+                "asserted per workload)",
+                "vs_baseline": round(
+                    headline["production"] / headline["baseline"], 3
+                )
+                if headline["baseline"]
+                else None,
+                "workloads": table,
             }
         )
     )

@@ -191,7 +191,8 @@ class HostArena:
 
     def const_value(self, row: int) -> int:
         vals = to_ints(self.val[row], 256)
-        return vals[0] & ((1 << self.width[row]) - 1) if self.width[row] else vals[0]
+        width = int(self.width[row])  # numpy int32 cannot shift past 63
+        return vals[0] & ((1 << width) - 1) if width else vals[0]
 
     def decode(self, row: int) -> T.Term:
         memo = self._decode_memo

@@ -57,6 +57,19 @@ from mythril_tpu.support.time_handler import time_handler
 log = logging.getLogger(__name__)
 
 
+def _is_concolic(laser) -> bool:
+    """Concolic runs are excluded from the frontier: trace recording and the
+    ConcolicStrategy depend on the host engine stepping every instruction."""
+    from mythril_tpu.core.strategy.concolic import ConcolicStrategy
+
+    strategy = laser.strategy
+    while strategy is not None:
+        if isinstance(strategy, ConcolicStrategy):
+            return True
+        strategy = getattr(strategy, "super_strategy", None)
+    return False
+
+
 def _eligible(gs) -> bool:
     """Seed states the device can take: fresh outermost message-call frames."""
     from mythril_tpu.core.transaction.transaction_models import (
@@ -87,6 +100,8 @@ class FrontierEngine:
         """Run every eligible work-list state on the device; parked paths
         land back on ``laser.work_list``.  Returns #states executed."""
         laser = self.laser
+        if _is_concolic(laser):
+            return 0
         seeds = [s for s in laser.work_list if _eligible(s)]
         if not seeds:
             return 0

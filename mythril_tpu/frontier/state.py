@@ -30,7 +30,8 @@ class Caps:
     MEM: int = 48  # word-granular memory entries
     STO: int = 32  # storage assoc entries (concrete-fold cache)
     CON: int = 96  # device-added path constraints
-    EVT: int = 96  # events per path per lifetime-on-device
+    EVT: int = 192  # events per path per lifetime-on-device (solc code is
+    # MSTORE/JUMPI-dense and every one is an event; overflow parks the path)
     R: int = 4  # arena rows reserved per path per step
     K: int = 128  # max steps per device segment
     ARENA: int = 1 << 17

@@ -870,7 +870,11 @@ def build_segment(caps: Caps):
         arena_len = arena_len + B * R
 
         # ---- fork grants ----
-        want = fork.want
+        # a grant REQUIRES room for the parent's E_FORK event: a granted
+        # fork whose event is dropped orphans the child (no lineage record
+        # on the host).  Full-buffer parents park at the pristine JUMPI.
+        buf_ok = new_state.ev_len < EVT
+        want = fork.want & buf_ok
         free = new_state.seed < 0
         n_free = free.sum()
         rank = jnp.cumsum(want.astype(I32)) - 1
@@ -932,10 +936,15 @@ def build_segment(caps: Caps):
         )
 
         # a denied fork pends at the pristine JUMPI: the harvest re-runs it
-        # once slots have been freed (or spills it to the host engine)
+        # once slots have been freed (or spills it to the host engine); a
+        # full event buffer can never clear on device, so those park
         denied = want & ~granted
         state2 = state2._replace(
-            halt=jnp.where(denied, O.H_PENDING_FORK, state2.halt)
+            halt=jnp.where(
+                fork.want & ~buf_ok,
+                O.H_PARK,
+                jnp.where(denied, O.H_PENDING_FORK, state2.halt),
+            )
         )
         emit_fork = granted
         payload = jnp.stack(

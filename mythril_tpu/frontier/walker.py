@@ -141,7 +141,15 @@ class Walker:
         while rec.carrier_pos < min(upto, len(rec.events)):
             ev = rec.events[rec.carrier_pos]
             rec.carrier_pos += 1
-            self._process_event(rec, ev)
+            try:
+                self._process_event(rec, ev)
+            except Exception:
+                # an event that cannot replay poisons everything downstream
+                # of it — kill the subtree cleanly (children see parent.dead)
+                # instead of leaving half-advanced state behind
+                rec.dead = True
+                rec.carrier = None
+                raise
             if rec.dead:
                 return
 

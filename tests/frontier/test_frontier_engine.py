@@ -126,6 +126,36 @@ def test_multi_tx_killbilly_exploit():
     bench.check_recall(issues)
 
 
+def test_arena_const_value_full_width():
+    """Regression: numpy int32 widths cannot shift 1 << 256 (C long)."""
+    import numpy as np
+
+    from mythril_tpu.frontier.arena import HostArena
+
+    arena = HostArena(64)
+    row = arena.const_row((1 << 256) - 1, 256)
+    assert isinstance(arena.width[row], np.int32) or arena.width.dtype == np.int32
+    assert arena.const_value(row) == (1 << 256) - 1
+
+
+def test_differential_real_solc_contract():
+    """Regression: solc-compiled code (MSTORE/JUMPI dense) exercises event
+    buffer pressure and the fork-grant/event-emission coupling; issues must
+    match the host engine exactly."""
+    import pathlib
+
+    import pytest
+
+    path = pathlib.Path("/root/reference/tests/testdata/inputs/suicide.sol.o")
+    if not path.exists():
+        pytest.skip("reference corpus not mounted")
+    code = path.read_text().strip().replace("0x", "")
+    host = analyze(code, tx_count=2, modules=["AccidentallyKillable"])
+    dev = analyze(code, tx_count=2, modules=["AccidentallyKillable"], frontier=True)
+    assert issue_keys(host) == issue_keys(dev)
+    assert any(i.swc_id == "106" for i in dev)
+
+
 def test_parked_call_body_falls_back_to_host():
     # CALL is not device-executable: the path parks and the host engine
     # finishes it; issues must match the pure-host run
