@@ -230,8 +230,10 @@ class FrontierEngine:
             *[jax.device_put(a) for a in arena.device_arrays()]
         )
         arena_len = arena.length
+        visited = jax.device_put(np.zeros(instr_cap, bool))
         executed = 0
         deadline = t_start + (laser.execution_timeout or args.execution_timeout)
+        narrow_harvests = 0
 
         while True:
             if time.time() > deadline or time_handler.time_remaining() <= 0:
@@ -239,8 +241,8 @@ class FrontierEngine:
                 self._park_all(st, records, walker)
                 break
 
-            out_state, dev_arena, out_len, n_exec = segment(
-                st, dev_arena, arena_len, code_dev, cfg
+            out_state, dev_arena, out_len, n_exec, visited = segment(
+                st, dev_arena, arena_len, visited, code_dev, cfg
             )
             # pull state to host mirrors (writable: harvest mutates slots)
             st = FrontierState(*[np.array(x) for x in out_state])
@@ -266,9 +268,37 @@ class FrontierEngine:
                 log.warning("frontier: arena nearly full; parking live paths")
                 self._park_all(st, records, walker)
                 break
+            # adaptive bail-out: the device pays off only on wide frontiers
+            # (the per-segment dispatch amortizes over live paths); a run
+            # that stays narrow hands its paths to the host engine, which
+            # steps small work lists faster than segment round trips
+            if live < caps.MIN_LIVE:
+                narrow_harvests += 1
+                if narrow_harvests >= caps.NARROW_BAIL:
+                    log.info(
+                        "frontier: only %d live paths after %d segments; "
+                        "host engine takes over", live, narrow_harvests,
+                    )
+                    self._park_all(st, records, walker)
+                    break
+            else:
+                narrow_harvests = 0
 
+        self._merge_coverage(np.asarray(visited), tables, code)
         laser.total_states += executed
         return executed
+
+    def _merge_coverage(self, visited: np.ndarray, tables, code) -> None:
+        """Device-executed instructions into the coverage plugin's bitmap
+        (the walker only replays hook events, so plugin-side coverage alone
+        would underreport frontier runs)."""
+        cov = getattr(self.laser, "coverage_plugin", None)
+        bytecode = getattr(code, "bytecode", None)
+        if cov is None or not bytecode:
+            return
+        cov.record_visited(
+            bytecode.hex(), tables.n, np.nonzero(visited[: tables.n])[0]
+        )
 
     # ------------------------------------------------------------------
 

@@ -35,6 +35,11 @@ class Caps:
     R: int = 4  # arena rows reserved per path per step
     K: int = 128  # max steps per device segment
     ARENA: int = 1 << 17
+    # adaptive bail-out: if fewer than MIN_LIVE paths stay live for
+    # NARROW_BAIL consecutive harvests, park everything to the host engine
+    # (device segments only pay off when the batch is wide)
+    MIN_LIVE: int = 8
+    NARROW_BAIL: int = 3
 
 
 class FrontierState(NamedTuple):

@@ -849,10 +849,15 @@ def build_segment(caps: Caps):
     B = caps.B
 
     def batch_step(carry):
-        state, arena, arena_len, t, n_exec, code, cfg = carry
+        state, arena, arena_len, t, n_exec, visited, code, cfg = carry
         gmin_t, gmax_t = code.gmin, code.gmax
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         n_exec = n_exec + running.sum().astype(I32)
+        # coverage: mark every live path's pc (dropped index for idle slots)
+        pc_or_oob = jnp.where(
+            running, jnp.clip(state.pc, 0, visited.shape[0] - 1), visited.shape[0]
+        )
+        visited = visited.at[pc_or_oob].set(True, mode="drop")
         ids = arena_len + jnp.arange(B * R, dtype=I32).reshape(B, R)
         new_state, rows, fork = vstep(state, ids, arena, code, cfg)
 
@@ -979,23 +984,22 @@ def build_segment(caps: Caps):
             ),
         )
 
-        return (state2, arena, arena_len, t + 1, n_exec, code, cfg)
+        return (state2, arena, arena_len, t + 1, n_exec, visited, code, cfg)
 
     def cond(carry):
-        state, _, arena_len, t, _n, _code, _cfg = carry
+        state, _, arena_len, t, _n, _v, _code, _cfg = carry
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         room = arena_len + B * R < caps.ARENA
         return (t < caps.K) & running.any() & room
 
     @jax.jit
     def segment(state: FrontierState, arena: ArenaDev, arena_len,
-                code: CodeDev, cfg: CfgScalars):
+                visited, code: CodeDev, cfg: CfgScalars):
         carry = (state, arena, jnp.asarray(arena_len, I32),
-                 jnp.asarray(0, I32), jnp.asarray(0, I32), code, cfg)
-        state, arena, arena_len, t, n_exec, _code, _cfg = jax.lax.while_loop(
-            cond, batch_step, carry
-        )
-        return state, arena, arena_len, n_exec
+                 jnp.asarray(0, I32), jnp.asarray(0, I32), visited, code, cfg)
+        (state, arena, arena_len, t, n_exec, visited, _code,
+         _cfg) = jax.lax.while_loop(cond, batch_step, carry)
+        return state, arena, arena_len, n_exec, visited
 
     return segment
 

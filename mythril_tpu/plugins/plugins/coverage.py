@@ -26,6 +26,9 @@ class InstructionCoverage(LaserPlugin):
     def initialize(self, symbolic_vm) -> None:
         self.coverage = {}
         self.tx_id = 0
+        # expose the instance: the device frontier merges its visited-pc
+        # bitmap here (it executes instructions without execute_state hooks)
+        symbolic_vm.coverage_plugin = self
 
         def execute_state_hook(global_state: GlobalState):
             code = global_state.environment.code.bytecode.hex()
@@ -52,6 +55,18 @@ class InstructionCoverage(LaserPlugin):
         symbolic_vm.register_laser_hooks("execute_state", execute_state_hook)
         symbolic_vm.register_laser_hooks("stop_sym_exec", stop_sym_exec_hook)
         symbolic_vm.register_laser_hooks("start_sym_trans", start_sym_trans_hook)
+
+    def record_visited(self, code_hex: str, total: int, indices) -> None:
+        """Merge externally-observed instruction indices (the device frontier
+        executes without per-instruction hooks).  Device execution is
+        speculative — forks later proven UNSAT still mark their pcs — so
+        frontier coverage may read slightly above strict sat-reachable
+        coverage, matching its states-executed accounting."""
+        entry = self.coverage.setdefault(code_hex, (total, [False] * max(total, 1)))
+        seen = entry[1]
+        for i in indices:
+            if 0 <= int(i) < len(seen):
+                seen[int(i)] = True
 
     def get_coverage(self) -> Dict[str, float]:
         return {
