@@ -57,11 +57,11 @@ SKIP = {
     "log1MemExp",
     "loop_stacklimit_1020",
     "loop_stacklimit_1021",
-    "DynamicJumpPathologicalTest0",
-    "DynamicJumpJD_DependsOnJumps1",
+    # OOG-at-exact-SSTORE-cost cases: need the full refund ledger
+    # (15000-per-clear, capped at half) to place the OOG point; the
+    # reference also shelves these ("tests_to_resolve", evm_test.py:53)
     "jumpTo1InstructionafterJump",
     "sstore_load_2",
-    "jumpi_at_the_end",
 }
 
 
