@@ -374,6 +374,16 @@ def build_segment(caps: Caps):
             idx = jnp.argmax(hit)
             return any_hit, st.mem_val[idx]
 
+        def mem_overlap_miss(addr):
+            """True when a live entry overlaps [addr, addr+32) but is not an
+            exact hit: the 32-byte window would straddle a stored word, which
+            the entry model cannot compose — the path must park.  (Stores
+            keep live entries mutually disjoint, see h_mstore.)"""
+            live = jnp.arange(MEM) < st.mem_len
+            near = (jnp.abs(st.mem_addr - addr) < 32) & live
+            exact = (st.mem_addr == addr) & live
+            return (near & ~exact).any()
+
         def mem_gas(st2, addr, size):
             new_size = jnp.maximum(st2.mem_size, ((addr + size + 31) // 32) * 32)
             cost = _memgas(new_size) - _memgas(st2.mem_size)
@@ -391,18 +401,18 @@ def build_segment(caps: Caps):
             length = stack_after_pop(1)
             stack, length, ok = push1(st2.stack, length, row)
             out = base_out(st2._replace(stack=stack, stack_len=length), res=row)
-            good = ok_addr & ok
+            good = ok_addr & ok & ~mem_overlap_miss(addr)
             return jax.tree.map(lambda a, b: jnp.where(good, a, b), out, halted(O.H_PARK))
 
         def h_mstore(_):
             ok_addr, addr = conc_addr(pops[0])
             val_row = pops[1]
-            # exact hit -> overwrite; overlap with a different entry -> park
+            # exact hit -> overwrite; straddling a different entry -> park
+            # (keeps live entries mutually disjoint, the invariant the
+            # read-side straddle detection relies on)
             live = jnp.arange(MEM) < st.mem_len
             exact = (st.mem_addr == addr) & live
-            overlap = (
-                (jnp.abs(st.mem_addr - addr) < 32) & live & ~exact
-            ).any()
+            overlap = mem_overlap_miss(addr)
             any_exact = exact.any()
             idx = jnp.where(any_exact, jnp.argmax(exact), st.mem_len)
             ok_cap = idx < MEM
@@ -429,6 +439,10 @@ def build_segment(caps: Caps):
             for w in range(4):
                 hit, vr = mem_lookup(off + 32 * w)
                 w_rows.append(jnp.where(hit, vr, row_zero))
+                # a straddling entry in a word we hash makes the gather wrong
+                good = good & jnp.where(
+                    w < words, ~mem_overlap_miss(off + 32 * w), True
+                )
             # build concat chain: data = w0 for words==1,
             # concat(w0,w1) etc.  rows: up to 3 concats (ids 0..2) + keccak id3
             rows = rows0

@@ -156,6 +156,36 @@ def test_differential_real_solc_contract():
     assert any(i.swc_id == "106" for i in dev)
 
 
+def test_mload_straddling_stored_word_parks():
+    """Soundness regression: MLOAD at 16 over a word stored at 0 must not
+    read zero on the device (exact-address miss); the path parks and the
+    host engine computes the straddled composite, keeping the feasible
+    selfdestruct branch alive."""
+    # mstore(0, calldataload(0)); jumpi(0x22, mload(16)); stop; jumpdest caller selfdestruct
+    body = "600035" "600052" "601051" "602257" "00" "5b33ff"
+    host = analyze(DISPATCH + body, modules=["AccidentallyKillable"])
+    dev = analyze(DISPATCH + body, modules=["AccidentallyKillable"], frontier=True)
+    assert issue_keys(host) == issue_keys(dev)
+    assert len(dev) == 1 and dev[0].swc_id == "106"
+
+
+def test_sha3_straddling_stored_word_parks():
+    """Same straddle hazard through the SHA3 word gather.
+
+    The branch guard is ``sha3(16, 32) != keccak(0^32)``: a device that
+    wrongly hashes the exact-miss zero word folds the guard to false and
+    never reaches the selfdestruct, while the straddled composite (host,
+    or a parked path) is satisfiable with nonzero calldata."""
+    k0 = "290decd9548b62a8d60345a988386fc84ba6bc95484008f6362f93160ef3e563"
+    # mstore(0, calldataload(0)); h = sha3(16, 32);
+    # jumpi(0x47, iszero(eq(h, K0)) == 0 ? ... ) -> iszero(eq) as guard
+    body = "600035" + "600052" + "6020601020" + "7f" + k0 + "14" + "15" + "604757" + "00" + "5b33ff"
+    host = analyze(DISPATCH + body, modules=["AccidentallyKillable"])
+    dev = analyze(DISPATCH + body, modules=["AccidentallyKillable"], frontier=True)
+    assert issue_keys(host) == issue_keys(dev)
+    assert len(dev) == 1 and dev[0].swc_id == "106"
+
+
 def test_parked_call_body_falls_back_to_host():
     # CALL is not device-executable: the path parks and the host engine
     # finishes it; issues must match the pure-host run
