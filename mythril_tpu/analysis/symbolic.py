@@ -118,6 +118,14 @@ class SymExecWrapper:
         plugin_loader.load(CallDepthLimitBuilder())
         if args.enable_iprof:
             plugin_loader.load(InstructionProfilerBuilder())
+        self._benchmark_plugin = None
+        if args.benchmark_path:
+            # instantiated directly (not via the loader) so the series can be
+            # written out after execution (reference benchmark.py:19-94)
+            from mythril_tpu.plugins.plugins.benchmark import BenchmarkPlugin
+
+            self._benchmark_plugin = BenchmarkPlugin()
+            self._benchmark_plugin.initialize(self.laser)
         plugin_loader.add_args("call-depth-limit", call_depth_limit=args.call_depth_limit)
         if not disable_dependency_pruning:
             plugin_loader.load(DependencyPrunerBuilder())
@@ -170,6 +178,12 @@ class SymExecWrapper:
             acct.code = contract.disassembly
             acct.contract_name = getattr(contract, "name", "Unknown")
             self.laser.sym_exec(world_state=world_state, target_address=address)
+
+        if self._benchmark_plugin is not None:
+            try:
+                self._benchmark_plugin.write_to_file(args.benchmark_path)
+            except OSError as e:
+                log.warning("could not write benchmark series: %s", e)
 
         if not requires_statespace:
             return

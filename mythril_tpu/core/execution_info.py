@@ -48,3 +48,13 @@ class SolverStatsInfo(ExecutionInfo):
             # nonzero means recall may have been lost to solver budgets
             "unknown_as_unsat": stats.unknown_as_unsat,
         }
+
+
+class FrontierStatsInfo(ExecutionInfo):
+    """Where device-resident execution stopped and why (parks by opcode
+    prioritize the next device handlers; see frontier/stats.py)."""
+
+    def as_dict(self) -> Dict:
+        from mythril_tpu.frontier.stats import FrontierStatistics
+
+        return {"frontier": FrontierStatistics().as_dict()}

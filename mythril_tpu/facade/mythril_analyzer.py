@@ -38,6 +38,7 @@ class AnalyzerArgs:
     parallel_solving: bool = False
     solver_log: Optional[str] = None
     enable_iprof: bool = False
+    benchmark_path: Optional[str] = None
     enable_coverage_strategy: bool = False
     custom_modules_directory: str = ""
     checkpoint_file: Optional[str] = None
@@ -80,6 +81,7 @@ class MythrilAnalyzer:
         args.parallel_solving = cmd_args.parallel_solving
         args.solver_log = cmd_args.solver_log
         args.enable_iprof = cmd_args.enable_iprof
+        args.benchmark_path = getattr(cmd_args, "benchmark_path", None)
         args.checkpoint_path = getattr(cmd_args, "checkpoint_file", None)
         args.resume_from = getattr(cmd_args, "resume_from", None)
         args.probe_backend = getattr(cmd_args, "probe_backend", "auto")
@@ -149,10 +151,13 @@ class MythrilAnalyzer:
                 issues = fire_lasers(sym, modules or self.cmd_args.modules)
                 from mythril_tpu.core.execution_info import (
                     EngineStatsInfo,
+                    FrontierStatsInfo,
                     SolverStatsInfo,
                 )
 
                 execution_info = [EngineStatsInfo(sym.laser), SolverStatsInfo()]
+                if args.frontier:
+                    execution_info.append(FrontierStatsInfo())
             except KeyboardInterrupt:
                 log.critical("keyboard interrupt: saving partial results")
                 issues = retrieve_callback_issues(modules or self.cmd_args.modules)

@@ -49,6 +49,7 @@ from mythril_tpu.frontier.code import (
 )
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
+from mythril_tpu.frontier.stats import FrontierStatistics
 from mythril_tpu.frontier.step import ArenaDev, CfgScalars, CodeDev, cached_segment
 from mythril_tpu.frontier.walker import Walker
 from mythril_tpu.support.support_args import args
@@ -238,7 +239,7 @@ class FrontierEngine:
         while True:
             if time.time() > deadline or time_handler.time_remaining() <= 0:
                 log.info("frontier: execution timeout; parking live paths")
-                self._park_all(st, records, walker)
+                self._park_all(st, records, walker, reason="timeout")
                 break
 
             out_state, dev_arena, out_len, n_exec, visited = segment(
@@ -250,6 +251,7 @@ class FrontierEngine:
             arena.pull_from_device(dev_arena, arena_len_new)
             arena_len = arena_len_new
             executed += int(n_exec)
+            FrontierStatistics().device_instructions += int(n_exec)
 
             self._harvest(st, records, walker, ev_seen)
 
@@ -266,7 +268,7 @@ class FrontierEngine:
                 break
             if arena_len + caps.B * caps.R * 2 >= caps.ARENA:
                 log.warning("frontier: arena nearly full; parking live paths")
-                self._park_all(st, records, walker)
+                self._park_all(st, records, walker, reason="arena-full")
                 break
             # adaptive bail-out: the device pays off only on wide frontiers
             # (the per-segment dispatch amortizes over live paths); a run
@@ -279,7 +281,7 @@ class FrontierEngine:
                         "frontier: only %d live paths after %d segments; "
                         "host engine takes over", live, narrow_harvests,
                     )
-                    self._park_all(st, records, walker)
+                    self._park_all(st, records, walker, reason="narrow-bail")
                     break
             else:
                 narrow_harvests = 0
@@ -361,8 +363,15 @@ class FrontierEngine:
                     continue
                 # batch saturated: spill to the host engine
             rec.final = snapshot_slot(st, slot)
+            stats = FrontierStatistics()
+            stats.device_paths += 1
             if halt == O.H_PENDING_FORK:
                 rec.final["halt"] = O.H_PARK
+                stats.record_bulk_park("batch-full")
+            elif halt == O.H_PARK:
+                pc = int(rec.final["pc"])
+                names = walker.tables.opcode_names
+                stats.record_park(names[pc] if pc < len(names) else "?")
             try:
                 walker.finish(rec)
             except Exception as e:  # pragma: no cover - diagnostics
@@ -408,8 +417,10 @@ class FrontierEngine:
                 clear_slot(st, slot)
                 ev_seen[slot] = 0
 
-    def _park_all(self, st: FrontierState, records, walker: Walker) -> None:
+    def _park_all(self, st: FrontierState, records, walker: Walker,
+                  reason: str = "bulk") -> None:
         """Timeout/overflow: hand every live path back to the host engine."""
+        stats = FrontierStatistics()
         for slot in range(self.caps.B):
             rec = records[slot]
             if rec is None:
@@ -419,6 +430,8 @@ class FrontierEngine:
             rec.final = snapshot_slot(st, slot)
             if rec.final["halt"] == O.H_PENDING_FORK:
                 rec.final["halt"] = O.H_PARK
+            stats.device_paths += 1
+            stats.record_bulk_park(reason)
             try:
                 walker.finish(rec)
             except Exception as e:  # pragma: no cover

@@ -1,0 +1,42 @@
+"""Process-wide frontier telemetry: where device execution stops and why.
+
+The frontier is a fast path that degrades to the host engine by *parking*
+paths (engine.py); which opcodes force the parks is exactly the data that
+prioritizes widening device coverage, and how much of a run stayed
+device-resident is the number that explains the measured speedup.  Counters
+land in the report meta next to the solver statistics (reference parity:
+engine telemetry via ExecutionInfo, mythril/analysis/report.py:319-320).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from mythril_tpu.support.support_utils import Singleton
+
+
+class FrontierStatistics(metaclass=Singleton):
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.device_instructions = 0  # instructions executed on device
+        self.device_paths = 0  # paths that ran (fully or partly) on device
+        self.parks_by_opcode = Counter()  # opcode name -> paths parked on it
+        self.parks_by_reason = Counter()  # timeout/arena/narrow/batch-full
+
+    def record_park(self, opcode: str) -> None:
+        self.parks_by_opcode[opcode] += 1
+        self.parks_by_reason["opcode"] += 1
+
+    def record_bulk_park(self, reason: str, n: int = 1) -> None:
+        if n:
+            self.parks_by_reason[reason] += n
+
+    def as_dict(self) -> dict:
+        return {
+            "device_instructions": self.device_instructions,
+            "device_paths": self.device_paths,
+            "parks_by_opcode": dict(self.parks_by_opcode.most_common()),
+            "parks_by_reason": dict(self.parks_by_reason.most_common()),
+        }

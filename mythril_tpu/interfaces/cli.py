@@ -97,6 +97,12 @@ def _add_analysis_options(parser) -> None:
     )
     group.add_argument("--enable-iprof", action="store_true", help="instruction profiler")
     group.add_argument(
+        "--benchmark",
+        metavar="FILE",
+        help="record instructions-over-time and write the series to FILE "
+        "(JSON) and FILE.svg (chart) after the run",
+    )
+    group.add_argument(
         "--no-onchain-data", action="store_true", help="do not fetch on-chain data via RPC"
     )
     group.add_argument(
@@ -290,6 +296,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         parallel_solving=parsed.parallel_solving,
         solver_log=parsed.solver_log,
         enable_iprof=parsed.enable_iprof,
+        benchmark_path=getattr(parsed, "benchmark", None),
         enable_coverage_strategy=parsed.enable_coverage_strategy,
         custom_modules_directory=parsed.custom_modules_directory,
         checkpoint_file=getattr(parsed, "checkpoint_file", None),

@@ -1,7 +1,10 @@
 """Benchmark plugin: coverage-over-time + executed-instruction counts.
 
-Reference parity: mythril/laser/plugin/plugins/benchmark.py:19-94 (matplotlib
-rendering replaced by a JSON dump — no display in this environment).
+Reference parity: mythril/laser/plugin/plugins/benchmark.py:19-94.  The
+reference renders a matplotlib png at shutdown; this environment is headless
+and matplotlib-free, so the series is persisted as JSON plus a
+dependency-free SVG line chart (single series: executed instructions over
+wall time).
 """
 
 from __future__ import annotations
@@ -14,6 +17,80 @@ from typing import List, Tuple
 from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
 
 log = logging.getLogger(__name__)
+
+# chart tokens (light surface), single categorical series
+_SURFACE = "#fcfcfb"
+_INK = "#0b0b0b"
+_INK_MUTED = "#52514e"
+_SERIES = "#2a78d6"
+_GRID = "#e8e7e4"
+
+
+def render_series_svg(
+    points: List[Tuple[float, int]],
+    title: str,
+    y_label: str = "executed instructions",
+    width: int = 640,
+    height: int = 360,
+) -> str:
+    """A minimal single-series line chart as standalone SVG markup.
+
+    One series needs no legend (the title names it); the line is 2px, the
+    grid recessive, text in ink tokens rather than the series color.
+    """
+    ml, mr, mt, mb = 56, 16, 40, 36  # margins: left/right/top/bottom
+    pw, ph = width - ml - mr, height - mt - mb
+    xs = [p[0] for p in points] or [0.0]
+    ys = [p[1] for p in points] or [0]
+    x_max = max(xs) or 1.0
+    y_max = max(ys) or 1
+
+    def px(x: float) -> float:
+        return ml + (x / x_max) * pw
+
+    def py(y: float) -> float:
+        return mt + ph - (y / y_max) * ph
+
+    # ~4 horizontal gridlines at round y values
+    step = max(1, y_max // 4)
+    grid, labels = [], []
+    y = step
+    while y <= y_max:
+        gy = py(y)
+        grid.append(
+            f'<line x1="{ml}" y1="{gy:.1f}" x2="{ml + pw}" y2="{gy:.1f}" '
+            f'stroke="{_GRID}" stroke-width="1"/>'
+        )
+        labels.append(
+            f'<text x="{ml - 6}" y="{gy + 4:.1f}" text-anchor="end" '
+            f'font-size="11" fill="{_INK_MUTED}">{y}</text>'
+        )
+        y += step
+    path = " ".join(
+        f"{'M' if i == 0 else 'L'}{px(x):.1f},{py(v):.1f}"
+        for i, (x, v) in enumerate(points or [(0.0, 0)])
+    )
+    last_x, last_y = points[-1] if points else (0.0, 0)
+    font = "font-family='system-ui, sans-serif'"
+    return (
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+        f'height="{height}" viewBox="0 0 {width} {height}">'
+        f'<rect width="{width}" height="{height}" fill="{_SURFACE}"/>'
+        f'<text x="{ml}" y="22" font-size="14" {font} fill="{_INK}">{title}</text>'
+        + "".join(grid)
+        + "".join(labels)
+        + f'<line x1="{ml}" y1="{mt + ph}" x2="{ml + pw}" y2="{mt + ph}" '
+        f'stroke="{_INK_MUTED}" stroke-width="1"/>'
+        f'<path d="{path}" fill="none" stroke="{_SERIES}" stroke-width="2" '
+        f'stroke-linejoin="round"><title>{y_label}</title></path>'
+        f'<circle cx="{px(last_x):.1f}" cy="{py(last_y):.1f}" r="3" '
+        f'fill="{_SERIES}"/>'
+        f'<text x="{ml}" y="{height - 8}" font-size="11" {font} '
+        f'fill="{_INK_MUTED}">0</text>'
+        f'<text x="{ml + pw}" y="{height - 8}" text-anchor="end" font-size="11" '
+        f'{font} fill="{_INK_MUTED}">{x_max:.1f}s</text>'
+        "</svg>"
+    )
 
 
 class BenchmarkPlugin(LaserPlugin):
@@ -46,6 +123,8 @@ class BenchmarkPlugin(LaserPlugin):
         symbolic_vm.register_laser_hooks("stop_sym_exec", stop_hook)
 
     def write_to_file(self, path: str) -> None:
+        """Persist the series as JSON and an SVG chart at ``path``(.svg) —
+        the role of the reference's matplotlib png."""
         with open(path, "w") as f:
             json.dump(
                 {
@@ -54,6 +133,14 @@ class BenchmarkPlugin(LaserPlugin):
                     "series": self.points[:10000],
                 },
                 f,
+            )
+        svg_path = path + ".svg" if not path.endswith(".svg") else path
+        with open(svg_path, "w") as f:
+            f.write(
+                render_series_svg(
+                    self.points[:10000],
+                    title=f"{self.name}: instructions over time",
+                )
             )
 
 

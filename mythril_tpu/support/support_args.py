@@ -31,6 +31,8 @@ class Args:
     use_integer_module: bool = True
     use_attack_as_target: bool = False
     enable_iprof: bool = False
+    # write the benchmark plugin's series (JSON + SVG chart) to this path
+    benchmark_path: Optional[str] = None
     # probe solver tuning
     probe_candidates: int = 48
     probe_rounds: int = 4

@@ -63,3 +63,39 @@ def test_execution_info_in_report_meta():
     meta = json.loads(report.as_swc_standard_format())[0]["meta"]
     assert "mythril_execution_info" in meta
     assert "solver_query_count" in meta["mythril_execution_info"]
+
+
+def test_benchmark_plugin_writes_series_and_svg(tmp_path):
+    """The benchmark plugin persists its instructions-over-time series as
+    JSON plus an SVG chart (the role of the reference's matplotlib png,
+    reference benchmark.py:19-94)."""
+    import json
+
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.support.support_args import args
+
+    out = tmp_path / "bench.json"
+    args.benchmark_path = str(out)
+    try:
+        SymExecWrapper(
+            bytes.fromhex("602a60005500"),  # sstore(0, 42); stop
+            address=0x0901D12E,
+            strategy="dfs",
+            transaction_count=1,
+            execution_timeout=30,
+        )
+    finally:
+        args.benchmark_path = None
+    data = json.loads(out.read_text())
+    assert data["executed_instructions"] > 0
+    assert len(data["series"]) == data["executed_instructions"]
+    svg = (tmp_path / "bench.json.svg").read_text()
+    assert svg.startswith("<svg") and svg.rstrip().endswith("</svg>")
+    assert "instructions over time" in svg
+
+
+def test_render_series_svg_empty_series():
+    from mythril_tpu.plugins.plugins.benchmark import render_series_svg
+
+    svg = render_series_svg([], title="empty")
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
