@@ -145,7 +145,11 @@ class MythrilAnalyzer:
         all_issues: List[Issue] = []
         exceptions = []
         execution_info = []
-        for contract in self.contracts:
+        benchmark_base = args.benchmark_path
+        for n_contract, contract in enumerate(self.contracts):
+            if benchmark_base and len(self.contracts) > 1:
+                # one series file per contract instead of silent overwrites
+                args.benchmark_path = f"{benchmark_base}.{n_contract}"
             try:
                 sym = self._sym_exec(contract)
                 issues = fire_lasers(sym, modules or self.cmd_args.modules)
@@ -173,6 +177,7 @@ class MythrilAnalyzer:
                 issue.resolve_function_name(sigdb)
             log.info("solver statistics: %s", stats)
             all_issues += issues
+        args.benchmark_path = benchmark_base
 
         source_data = self.contracts
         report = Report(

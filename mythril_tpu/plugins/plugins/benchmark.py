@@ -13,6 +13,7 @@ import json
 import logging
 import time
 from typing import List, Tuple
+from xml.sax.saxutils import escape
 
 from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
 
@@ -40,6 +41,7 @@ def render_series_svg(
     """
     ml, mr, mt, mb = 56, 16, 40, 36  # margins: left/right/top/bottom
     pw, ph = width - ml - mr, height - mt - mb
+    title, y_label = escape(title), escape(y_label)
     xs = [p[0] for p in points] or [0.0]
     ys = [p[1] for p in points] or [0]
     x_max = max(xs) or 1.0
@@ -100,9 +102,16 @@ class BenchmarkPlugin(LaserPlugin):
         self.end: float = 0.0
         self.points: List[Tuple[float, int]] = []
         self.name = name
+        self._device_insns_at_start = 0
 
     def initialize(self, symbolic_vm) -> None:
         self.begin = time.time()
+        # the series tracks host-stepped instructions (execute_state hooks);
+        # device-frontier segments bypass those hooks, so their instruction
+        # total is reported separately from FrontierStatistics
+        from mythril_tpu.frontier.stats import FrontierStatistics
+
+        self._device_insns_at_start = FrontierStatistics().device_instructions
 
         def execute_state_hook(_):
             self.nr_of_executed_insns += 1
@@ -123,23 +132,37 @@ class BenchmarkPlugin(LaserPlugin):
         symbolic_vm.register_laser_hooks("stop_sym_exec", stop_hook)
 
     def write_to_file(self, path: str) -> None:
-        """Persist the series as JSON and an SVG chart at ``path``(.svg) —
-        the role of the reference's matplotlib png."""
+        """Persist the series as JSON at ``path`` and an SVG chart at
+        ``path + ".svg"`` — the role of the reference's matplotlib png.
+
+        Long runs are downsampled to <=10000 points spanning the WHOLE run
+        (stride recorded in the JSON), never truncated."""
+        from mythril_tpu.frontier.stats import FrontierStatistics
+
+        stride = max(1, -(-len(self.points) // 10000))  # ceil div
+        series = self.points[::stride]
+        if series and self.points[-1] != series[-1]:
+            series.append(self.points[-1])
+        device_insns = (
+            FrontierStatistics().device_instructions - self._device_insns_at_start
+        )
         with open(path, "w") as f:
             json.dump(
                 {
                     "executed_instructions": self.nr_of_executed_insns,
+                    # instructions executed by device-frontier segments (not
+                    # in the host hook series; 0 unless --frontier)
+                    "device_instructions": device_insns,
                     "duration": self.end - self.begin,
-                    "series": self.points[:10000],
+                    "series_stride": stride,
+                    "series": series,
                 },
                 f,
             )
-        svg_path = path + ".svg" if not path.endswith(".svg") else path
-        with open(svg_path, "w") as f:
+        with open(path + ".svg", "w") as f:
             f.write(
                 render_series_svg(
-                    self.points[:10000],
-                    title=f"{self.name}: instructions over time",
+                    series, title=f"{self.name}: instructions over time"
                 )
             )
 

@@ -99,3 +99,33 @@ def test_render_series_svg_empty_series():
 
     svg = render_series_svg([], title="empty")
     assert svg.startswith("<svg") and svg.endswith("</svg>")
+
+
+def test_render_series_svg_escapes_markup():
+    import xml.etree.ElementTree as ET
+
+    from mythril_tpu.plugins.plugins.benchmark import render_series_svg
+
+    svg = render_series_svg([(0.5, 1)], title="a<b & c>d")
+    ET.fromstring(svg)  # must stay well-formed XML
+
+
+def test_benchmark_long_series_downsampled_not_truncated(tmp_path):
+    """>10k points: the persisted series spans the WHOLE run at a stride,
+    so the chart's time axis reflects the true duration."""
+    import json
+
+    from mythril_tpu.plugins.plugins.benchmark import BenchmarkPlugin
+
+    plugin = BenchmarkPlugin()
+    plugin.begin, plugin.end = 0.0, 25.0
+    plugin.nr_of_executed_insns = 25_000
+    plugin.points = [(i / 1000.0, i + 1) for i in range(25_000)]
+    out = tmp_path / "long.json"
+    plugin.write_to_file(str(out))
+    data = json.loads(out.read_text())
+    assert data["executed_instructions"] == 25_000
+    assert data["series_stride"] == 3
+    assert len(data["series"]) <= 10_001
+    assert data["series"][-1] == [24.999, 25_000]  # last point kept
+    assert "24" in (tmp_path / "long.json.svg").read_text()  # x axis ~25s
