@@ -140,12 +140,29 @@ class MythrilAnalyzer:
         return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
 
     def fire_lasers(self, modules: Optional[List[str]] = None) -> Report:
-        stats = SolverStatistics()
-        stats.enabled = True
+        SolverStatistics().enabled = True
+        benchmark_base = args.benchmark_path
+        try:
+            all_issues, exceptions, execution_info = self._fire_lasers_loop(
+                modules, benchmark_base
+            )
+        finally:
+            args.benchmark_path = benchmark_base
+
+        source_data = self.contracts
+        report = Report(
+            contracts=source_data,
+            exceptions=exceptions,
+            execution_info=execution_info,
+        )
+        for issue in all_issues:
+            report.append_issue(issue)
+        return report
+
+    def _fire_lasers_loop(self, modules, benchmark_base):
         all_issues: List[Issue] = []
         exceptions = []
         execution_info = []
-        benchmark_base = args.benchmark_path
         for n_contract, contract in enumerate(self.contracts):
             if benchmark_base and len(self.contracts) > 1:
                 # one series file per contract instead of silent overwrites
@@ -175,16 +192,6 @@ class MythrilAnalyzer:
             for issue in issues:
                 issue.add_code_info(contract)
                 issue.resolve_function_name(sigdb)
-            log.info("solver statistics: %s", stats)
+            log.info("solver statistics: %s", SolverStatistics())
             all_issues += issues
-        args.benchmark_path = benchmark_base
-
-        source_data = self.contracts
-        report = Report(
-            contracts=source_data,
-            exceptions=exceptions,
-            execution_info=execution_info,
-        )
-        for issue in all_issues:
-            report.append_issue(issue)
-        return report
+        return all_issues, exceptions, execution_info

@@ -58,17 +58,44 @@ from mythril_tpu.support.time_handler import time_handler
 log = logging.getLogger(__name__)
 
 
+def _strategy_chain(laser):
+    """The active strategy and every strategy it wraps (extensions nest via
+    ``super_strategy``), outermost first."""
+    strategy = laser.strategy
+    while strategy is not None:
+        yield strategy
+        strategy = getattr(strategy, "super_strategy", None)
+
+
 def _is_concolic(laser) -> bool:
     """Concolic runs are excluded from the frontier: trace recording and the
     ConcolicStrategy depend on the host engine stepping every instruction."""
     from mythril_tpu.core.strategy.concolic import ConcolicStrategy
 
-    strategy = laser.strategy
-    while strategy is not None:
-        if isinstance(strategy, ConcolicStrategy):
-            return True
-        strategy = getattr(strategy, "super_strategy", None)
-    return False
+    return any(isinstance(s, ConcolicStrategy) for s in _strategy_chain(laser))
+
+
+def _sel_mode(laser) -> int:
+    """Map the active host search strategy onto the device fork-grant
+    priority (step.SEL_*) — the batched form of the strategy's ordering
+    (SURVEY.md §7.2 item 5).  Strategies with host-only scores (beam's
+    annotation importance, random) keep slot order; their ordering applies
+    when parked/spilled paths re-enter the host work list."""
+    from mythril_tpu.core.strategy.basic import (
+        BreadthFirstSearchStrategy,
+        DepthFirstSearchStrategy,
+    )
+    from mythril_tpu.frontier import step as step_mod
+    from mythril_tpu.plugins.plugins.coverage import CoverageStrategy
+
+    for strategy in _strategy_chain(laser):
+        if isinstance(strategy, CoverageStrategy):
+            return step_mod.SEL_COVERAGE
+        if isinstance(strategy, DepthFirstSearchStrategy):
+            return step_mod.SEL_DEEP
+        if isinstance(strategy, BreadthFirstSearchStrategy):
+            return step_mod.SEL_SHALLOW
+    return step_mod.SEL_NONE
 
 
 def _eligible(gs) -> bool:
@@ -203,6 +230,7 @@ class FrontierEngine:
             loop_bound=np.int32(args.loop_bound or 0),
             row_zero=np.int32(row_zero),
             row_one=np.int32(row_one),
+            sel_mode=np.int32(_sel_mode(laser)),
         )
 
         # seed contexts (also fills the arena with env rows)

@@ -100,6 +100,17 @@ class CfgScalars(NamedTuple):
     loop_bound: jnp.ndarray  # 0 disables the bound
     row_zero: jnp.ndarray  # arena row of const 0
     row_one: jnp.ndarray  # arena row of const 1
+    # fork-grant priority under slot scarcity (SEL_*): the batched form of
+    # the host search strategies (SURVEY.md §7.2 item 5) — with free slots
+    # every fork is granted and the mode is irrelevant
+    sel_mode: jnp.ndarray
+
+
+# fork-grant selection modes (cfg.sel_mode)
+SEL_NONE = 0  # slot order (no strategy preference)
+SEL_DEEP = 1  # deepest parents first (depth-first flavor)
+SEL_SHALLOW = 2  # shallowest parents first (breadth-first flavor)
+SEL_COVERAGE = 3  # forks targeting not-yet-visited code first
 
 
 def build_segment(caps: Caps):
@@ -896,7 +907,27 @@ def build_segment(caps: Caps):
         want = fork.want & buf_ok
         free = new_state.seed < 0
         n_free = free.sum()
-        rank = jnp.cumsum(want.astype(I32)) - 1
+        # strategy-scored grants (the batched form of the host search
+        # strategies; only matters when forks outnumber free slots): rank
+        # wanters by descending score — argsort is stable, so SEL_NONE
+        # (score 0) degenerates to the legacy slot order
+        target_pc = jnp.clip(fork.target, 0, visited.shape[0] - 1)
+        uncovered = ~visited[target_pc]
+        sel = cfg.sel_mode
+        score = jnp.where(
+            sel == SEL_DEEP, state.depth,
+            jnp.where(
+                sel == SEL_SHALLOW, -state.depth,
+                jnp.where(
+                    sel == SEL_COVERAGE,
+                    uncovered.astype(I32) * (1 << 20) + state.depth,
+                    0,
+                ),
+            ),
+        )
+        sort_key = jnp.where(want, -score, jnp.iinfo(jnp.int32).max)
+        order = jnp.argsort(sort_key)
+        rank = jnp.zeros(B, I32).at[order].set(jnp.arange(B, dtype=I32))
         granted = want & (rank < n_free)
         free_list = jnp.argsort(~free)  # free slots first, ascending
         child_slot = jnp.where(

@@ -1,0 +1,155 @@
+"""Strategy-scored fork grants: the batched form of the host search
+strategies (SURVEY.md §7.2 item 5).
+
+With more JUMPI forks requested than free batch slots, the segment grants
+by the configured selection mode — deepest-first (DFS flavor),
+shallowest-first (BFS flavor), uncovered-target-first (coverage) — instead
+of arbitrary slot order.  Denied parents pend pristine (H_PENDING_FORK), so
+no path is lost either way; the mode only decides WHO gets the scarce slot.
+"""
+
+from collections import namedtuple
+
+import jax
+import numpy as np
+import pytest
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier import step as step_mod
+from mythril_tpu.frontier.arena import HostArena
+from mythril_tpu.frontier.code import CodeTables
+from mythril_tpu.frontier.state import Caps, empty_state
+from mythril_tpu.frontier.step import ArenaDev, CfgScalars, CodeDev, cached_segment
+from mythril_tpu.smt import terms as T
+
+Ins = namedtuple("Ins", "opcode address arg_int")
+
+# one JUMPI; fall-through STOP; valid JUMPDEST target; STOP
+PROGRAM = [
+    Ins("JUMPI", 0, None),
+    Ins("STOP", 1, None),
+    Ins("JUMPDEST", 2, None),
+    Ins("STOP", 3, None),
+]
+
+CAPS = Caps(B=4, K=1)
+DEPTHS = (5, 9, 1)  # slots 0..2; slot 3 free
+
+
+def _run_one_step(sel_mode: int):
+    arena = HostArena(CAPS.ARENA)
+    row_zero = arena.const_row(0, 256)
+    row_one = arena.const_row(1, 256)
+    dest_row = arena.const_row(2, 256)  # byte address of the JUMPDEST
+    cond_rows = [arena.var_row(T.var(f"c{i}", 256)) for i in range(3)]
+
+    tables = CodeTables(PROGRAM, arena)
+    instr_cap, addr_cap, loops_cap = tables.size_bucket()
+    segment = cached_segment(CAPS, instr_cap, addr_cap, loops_cap)
+    code_dev = CodeDev(*[jax.device_put(a) for a in tables.padded_device_tables()])
+    cfg = CfgScalars(
+        max_depth=np.int32(128),
+        loop_bound=np.int32(0),
+        row_zero=np.int32(row_zero),
+        row_one=np.int32(row_one),
+        sel_mode=np.int32(sel_mode),
+    )
+
+    st = empty_state(CAPS, loops_cap)
+    for slot, depth in enumerate(DEPTHS):
+        st.seed[slot] = 0
+        st.halt[slot] = O.H_RUNNING
+        st.pc[slot] = 0
+        # stack top (popped first) is the jump dest, then the condition word
+        st.stack[slot, 0] = cond_rows[slot]
+        st.stack[slot, 1] = dest_row
+        st.stack_len[slot] = 2
+        st.depth[slot] = depth
+
+    dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
+    visited = jax.device_put(np.zeros(instr_cap, bool))
+    out_state, _arena, _alen, n_exec, _visited = segment(
+        st, dev_arena, arena.length, visited, code_dev, cfg
+    )
+    assert int(n_exec) == 3
+    return np.array(out_state.halt), np.array(out_state.seed)
+
+
+@pytest.mark.parametrize(
+    "sel_mode,winner",
+    [
+        (step_mod.SEL_NONE, 0),  # slot order
+        (step_mod.SEL_DEEP, 1),  # depth 9
+        (step_mod.SEL_SHALLOW, 2),  # depth 1
+    ],
+)
+def test_scarce_fork_grant_follows_selection_mode(sel_mode, winner):
+    halt, seed = _run_one_step(sel_mode)
+    # exactly one fork granted into the single free slot (3)
+    assert seed[3] == 0 and halt[3] == O.H_RUNNING
+    for slot in range(3):
+        if slot == winner:
+            # granted parent took the fall-through and keeps running
+            assert halt[slot] == O.H_RUNNING
+        else:
+            # denied parents pend pristine for the next segment/harvest
+            assert halt[slot] == O.H_PENDING_FORK
+
+
+def test_coverage_mode_prefers_uncovered_target():
+    """SEL_COVERAGE grants the fork whose taken branch lands on code no
+    path has visited yet, even when a rival parent is deeper."""
+    program = [
+        Ins("JUMPI", 0, None),
+        Ins("STOP", 1, None),
+        Ins("JUMPDEST", 2, None),
+        Ins("STOP", 3, None),
+        Ins("JUMPDEST", 4, None),
+        Ins("STOP", 5, None),
+    ]
+    arena = HostArena(CAPS.ARENA)
+    row_zero = arena.const_row(0, 256)
+    row_one = arena.const_row(1, 256)
+    dest_covered = arena.const_row(2, 256)
+    dest_fresh = arena.const_row(4, 256)
+    cond_rows = [arena.var_row(T.var(f"k{i}", 256)) for i in range(2)]
+
+    tables = CodeTables(program, arena)
+    instr_cap, addr_cap, loops_cap = tables.size_bucket()
+    segment = cached_segment(CAPS, instr_cap, addr_cap, loops_cap)
+    code_dev = CodeDev(*[jax.device_put(a) for a in tables.padded_device_tables()])
+    cfg = CfgScalars(
+        max_depth=np.int32(128),
+        loop_bound=np.int32(0),
+        row_zero=np.int32(row_zero),
+        row_one=np.int32(row_one),
+        sel_mode=np.int32(step_mod.SEL_COVERAGE),
+    )
+
+    st = empty_state(CAPS, loops_cap)
+    # slot 0: deeper, but targets already-covered code; slot 1: shallow,
+    # targets fresh code; slots 2-3: one occupied non-forking, one free
+    for slot, (dest, depth) in enumerate(
+        [(dest_covered, 20), (dest_fresh, 2)]
+    ):
+        st.seed[slot] = 0
+        st.halt[slot] = O.H_RUNNING
+        st.pc[slot] = 0
+        st.stack[slot, 0] = cond_rows[slot]
+        st.stack[slot, 1] = dest
+        st.stack_len[slot] = 2
+        st.depth[slot] = depth
+    st.seed[2] = 0
+    st.halt[2] = O.H_RUNNING
+    st.pc[2] = 1  # sits at STOP; occupies the slot this step
+
+    visited = np.zeros(instr_cap, bool)
+    visited[2] = True  # the covered JUMPDEST
+    dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
+    out_state, _arena, _alen, _n, _v = segment(
+        st, dev_arena, arena.length, visited, code_dev, cfg
+    )
+    halt = np.array(out_state.halt)
+    assert halt[1] == O.H_RUNNING  # fresh-target parent granted
+    assert halt[0] == O.H_PENDING_FORK  # covered-target parent denied
+    assert np.array(out_state.seed)[3] == 0  # child landed in the free slot
