@@ -210,22 +210,31 @@ def _wide_contract(n_branches: int) -> bytes:
 
 
 def wl_wide_frontier(production: bool):
+    """1024 concurrent paths, the batched device interpreter's home turf:
+    the whole state space executes as ONE device segment at width 1024."""
+    from mythril_tpu.support.support_args import args
+
     _configure(production, frontier=True)
-    # warmup outside the timers: the segment program compiles once per size
-    # bucket (persistently cached when the XLA cache cooperates) — a one-time
-    # cost that would otherwise swamp this sub-minute workload
+    old_width = args.frontier_width
     if production:
+        args.frontier_width = 1024
+        # warmup outside the timers: the segment program compiles once per
+        # (caps, size bucket) (persistently cached when the XLA cache
+        # cooperates) — a one-time cost that would swamp this workload
         _clear_caches()
         _analyze(
             _wide_contract(2), 0x0901D12E, 1,
-            modules=["AccidentallyKillable"], timeout=120,
+            modules=["AccidentallyKillable"], timeout=300,
         )
-    _clear_caches()
-    code = _wide_contract(6)  # 64 concurrent paths
-    t0 = time.time()
-    sym, issues = _analyze(
-        code, 0x0901D12E, 1, modules=["AccidentallyKillable"], timeout=120
-    )
+    try:
+        _clear_caches()
+        code = _wide_contract(10)  # 1024 concurrent paths
+        t0 = time.time()
+        sym, issues = _analyze(
+            code, 0x0901D12E, 1, modules=["AccidentallyKillable"], timeout=300
+        )
+    finally:
+        args.frontier_width = old_width
     assert any(i.swc_id == "106" for i in issues), "wide-frontier recall lost"
     return sym.laser.total_states, time.time() - t0
 
@@ -327,7 +336,7 @@ WORKLOADS = [
     ("suicide_1tx", wl_suicide, "states/sec", 3),
     ("killbilly_3tx", wl_killbilly, "states/sec", 3),
     ("overflow_256bit", wl_overflow, "states/sec", 1),
-    ("wide_frontier", wl_wide_frontier, "states/sec", 3),
+    ("wide_frontier", wl_wide_frontier, "states/sec", 2),
     ("concolic_flip", wl_concolic, "flips/sec", 3),
     ("corpus_sweep", wl_corpus, "states/sec", 2),
 ]

@@ -270,6 +270,8 @@ class FrontierEngine:
                 self._park_all(st, records, walker, reason="timeout")
                 break
 
+            stats = FrontierStatistics()
+            t_seg = time.time()
             out_state, dev_arena, out_len, n_exec, visited = segment(
                 st, dev_arena, arena_len, visited, code_dev, cfg
             )
@@ -279,9 +281,13 @@ class FrontierEngine:
             arena.pull_from_device(dev_arena, arena_len_new)
             arena_len = arena_len_new
             executed += int(n_exec)
-            FrontierStatistics().device_instructions += int(n_exec)
+            stats.device_instructions += int(n_exec)
+            stats.segments += 1
+            stats.segment_s += time.time() - t_seg
 
+            t_har = time.time()
             self._harvest(st, records, walker, ev_seen)
+            stats.harvest_s += time.time() - t_har
 
             # refill free slots with queued seeds
             for slot in range(caps.B):
@@ -294,7 +300,7 @@ class FrontierEngine:
             live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
             if live == 0 and not seed_queue:
                 break
-            if arena_len + caps.B * caps.R * 2 >= caps.ARENA:
+            if arena_len + max(live, 1) * caps.R * 2 >= caps.ARENA:
                 log.warning("frontier: arena nearly full; parking live paths")
                 self._park_all(st, records, walker, reason="arena-full")
                 break
@@ -373,6 +379,14 @@ class FrontierEngine:
         if not args.sparse_pruning:
             self._prune_running(st, records, walker, ev_seen)
 
+        # 2c. batch the mutation-pruner's tx-end queries: walker replay fires
+        # add_world_state once per terminal path, and each unmutated path
+        # asks the solver "can callvalue exceed 0 on this path?" — solved
+        # one at a time that is the harvest hot spot (profiled at ~80% of
+        # wide-frontier wall time).  One batched probe here warms the solver
+        # memo so the per-path hook hits cache.
+        self._prefetch_mutation_checks(st, records, walker)
+
         # 3. finish halted paths (terminals park/replay through the walker)
         for slot in range(caps.B):
             rec = records[slot]
@@ -407,6 +421,100 @@ class FrontierEngine:
             records[slot] = None
             clear_slot(st, slot)
             ev_seen[slot] = 0
+
+    def _lineage_constraint_rows(self, rec) -> List[int]:
+        """Arena rows of the branch conditions appended along this path
+        (parent prefixes up to each fork, then the record's own stream).
+        Event decoding is shared with the walker (walker.fork_branch_row)."""
+        from mythril_tpu.frontier.walker import fork_branch_row
+
+        rows: List[int] = []
+        chain = []
+        node, upto = rec, len(rec.events)
+        while node is not None:
+            chain.append((node, upto))
+            upto = node.fork_event_idx
+            node = node.parent
+        for level, (node, limit) in enumerate(reversed(chain)):
+            for k in range(limit):
+                ev = node.events[k]
+                if int(ev[O.EV_KIND]) != O.E_FORK:
+                    continue
+                # this path continued past the event (fell through a granted
+                # fork, or took the single decided branch)
+                row = fork_branch_row(ev, taken=False)
+                if row >= 0:
+                    rows.append(row)
+            # entering the next level means this node granted a fork the
+            # child took: the child's side appended the taken condition
+            if node is not rec:
+                child = chain[len(chain) - 2 - level][0]
+                row = fork_branch_row(
+                    node.events[child.fork_event_idx], taken=True
+                )
+                if row >= 0:
+                    rows.append(row)
+        return rows
+
+    def _lineage_mutated(self, rec, walker: Walker) -> bool:
+        from mythril_tpu.plugins.plugins.mutation_pruner import MUTATOR_OPCODES
+
+        mutators = frozenset(MUTATOR_OPCODES)
+        names = walker.tables.opcode_names
+        node, upto = rec, len(rec.events)
+        while node is not None:
+            for k in range(upto):
+                ev = node.events[k]
+                if int(ev[O.EV_KIND]) != O.E_HOOK:
+                    continue
+                pc = int(ev[O.EV_PC])
+                if pc < len(names) and names[pc] in mutators:
+                    return True
+            upto = node.fork_event_idx
+            node = node.parent
+        return False
+
+    def _prefetch_mutation_checks(self, st: FrontierState, records,
+                                  walker: Walker) -> None:
+        from mythril_tpu.smt import UGT, symbol_factory
+        from mythril_tpu.smt.solver import ProbeConfig, check_satisfiable_batch
+
+        terminal = (O.H_STOP, O.H_RETURN, O.H_SELFDESTRUCT)
+        queries, seen = [], set()
+        for slot in range(self.caps.B):
+            rec = records[slot]
+            if rec is None or int(st.halt[slot]) not in terminal:
+                continue
+            if self._lineage_mutated(rec, walker):
+                continue
+            seed = walker.seeds[rec.seed_idx]
+            value = seed.current_transaction.call_value
+            try:
+                raws = list(seed.world_state.constraints.get_all_raw())
+                raws += [
+                    walker.decode_wrapped(r).raw
+                    for r in self._lineage_constraint_rows(rec)
+                ]
+            except Exception as e:
+                log.debug("mutation prefetch decode failed: %s", e)
+                continue
+            raws.append(
+                UGT(value, symbol_factory.BitVecVal(0, 256)).raw
+            )
+            key = frozenset(t.tid for t in raws)
+            if key not in seen:
+                seen.add(key)
+                queries.append(raws)
+        if len(queries) >= 2:
+            # the hook's exact budget (imported, so they cannot diverge); the
+            # call's side effect is the solver memo the hook will hit
+            from mythril_tpu.plugins.plugins.mutation_pruner import (
+                MUTATION_PROBE_CONFIG,
+            )
+
+            check_satisfiable_batch(
+                queries, ProbeConfig(**MUTATION_PROBE_CONFIG)
+            )
 
     def _prune_running(self, st: FrontierState, records, walker: Walker,
                        ev_seen: np.ndarray) -> None:

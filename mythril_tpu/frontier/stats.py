@@ -24,6 +24,9 @@ class FrontierStatistics(metaclass=Singleton):
         self.device_paths = 0  # paths that ran (fully or partly) on device
         self.parks_by_opcode = Counter()  # opcode name -> paths parked on it
         self.parks_by_reason = Counter()  # timeout/arena/narrow/batch-full
+        self.segments = 0  # device segment dispatches
+        self.segment_s = 0.0  # wall time in segment dispatch + state pull
+        self.harvest_s = 0.0  # wall time in host-side harvest
 
     def record_park(self, opcode: str) -> None:
         self.parks_by_opcode[opcode] += 1
@@ -37,6 +40,9 @@ class FrontierStatistics(metaclass=Singleton):
         return {
             "device_instructions": self.device_instructions,
             "device_paths": self.device_paths,
+            "segments": self.segments,
+            "segment_s": round(self.segment_s, 3),
+            "harvest_s": round(self.harvest_s, 3),
             "parks_by_opcode": dict(self.parks_by_opcode.most_common()),
             "parks_by_reason": dict(self.parks_by_reason.most_common()),
         }

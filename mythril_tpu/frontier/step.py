@@ -147,6 +147,40 @@ def build_segment(caps: Caps):
 
         underflow = st.stack_len < arity
 
+        # ------------------------------------------------------------------
+        # Hoisted arena/table reads.  CRITICAL for memory: under vmap, the
+        # lax.switch batching rule materializes a [B, ...] broadcast of every
+        # UNBATCHED array its branches touch, per branch — closing over the
+        # arena ([ARENA, 16]) inside handlers costs B x ARENA x 16 x 4 bytes
+        # x n_branches of HBM at compile time (observed 16 GB at B=256).
+        # Handlers below must therefore only consume these per-path gathers.
+        # ------------------------------------------------------------------
+
+        def aisc(r):
+            return jnp.where(r >= 0, arena.isconst[jnp.clip(r, 0, None)], False)
+
+        def aval(r):
+            return arena.val[jnp.clip(r, 0, None)]
+
+        pop_c = jnp.stack([aisc(pops[j]) for j in range(7)])  # [7] bool
+        pop_v = jnp.stack([aval(pops[j]) for j in range(7)])  # [7, 16] u32
+
+        def conc_from(c, v):
+            """(is_small_concrete, byte_address) from a popped operand."""
+            small = c & (jnp.max(v[2:]) == 0) & (v[1] < 16)  # < 2^20
+            return small, (v[0] | (v[1] << 16)).astype(I32)
+
+        ok_addr0, addr0 = conc_from(pop_c[0], pop_v[0])
+        ok_addr1, addr1 = conc_from(pop_c[1], pop_v[1])
+
+        def valid_dest(addr):
+            a = jnp.clip(addr, 0, jumpmap_t.shape[0] - 1)
+            idx = jumpmap_t[a]
+            return (addr < jumpmap_t.shape[0]) & (idx >= 0), idx
+
+        valid0, jidx0 = valid_dest(addr0)
+        lid_pc = loopid_t[pc]
+
         rows0 = NewRows(
             op=jnp.zeros(R, I32),
             a=jnp.full(R, -1, I32),
@@ -166,12 +200,6 @@ def build_segment(caps: Caps):
         )
 
         # tiny helpers over the per-path slice -------------------------------
-        def aisc(r):
-            return jnp.where(r >= 0, arena.isconst[jnp.clip(r, 0, None)], False)
-
-        def aval(r):
-            return arena.val[jnp.clip(r, 0, None)]
-
         def set_row(rows, k, op, a=-1, b=-1, c=-1, width=256, val=None, isconst=False):
             rows = rows._replace(
                 op=rows.op.at[k].set(op),
@@ -278,8 +306,9 @@ def build_segment(caps: Caps):
             foldable = jnp.asarray(False)
             for opc in _BIN_FOLDS:
                 foldable = foldable | (code == opc)
-            both_const = aisc(left) & aisc(right) & foldable
-            lv, rv = aval(left), aval(right)
+            both_const = pop_c[0] & pop_c[1] & foldable
+            lv = jnp.where(swap, pop_v[1], pop_v[0])
+            rv = jnp.where(swap, pop_v[0], pop_v[1])
             folded = jnp.zeros((16,), jnp.uint32)
             for opc, fn in _BIN_FOLDS.items():
                 folded = jnp.where(code == opc, fn(lv, rv), folded)
@@ -293,8 +322,8 @@ def build_segment(caps: Caps):
 
         def h_cmp(_):
             p0, p1 = pops[0], pops[1]
-            both_const = aisc(p0) & aisc(p1)
-            lv, rv = aval(p0), aval(p1)
+            both_const = pop_c[0] & pop_c[1]
+            lv, rv = pop_v[0], pop_v[1]
             t = jnp.asarray(False)
             for opc, fn in (
                 (O.A_ULT, lambda: bv.ult(lv, rv)),
@@ -317,8 +346,8 @@ def build_segment(caps: Caps):
 
         def h_iszero(_):
             p0 = pops[0]
-            is_c = aisc(p0)
-            z = bv.is_zero(aval(p0))
+            is_c = pop_c[0]
+            z = bv.is_zero(pop_v[0])
             const_row = jnp.where(z, row_one, row_zero)
             rows_s = set_row(rows0, 0, O.A_EQZ, a=p0, width=0)
             rows_s = set_row(rows_s, 1, O.A_ITEW, a=ids[0], b=row_one, c=row_zero)
@@ -329,8 +358,8 @@ def build_segment(caps: Caps):
 
         def h_not(_):
             p0 = pops[0]
-            is_c = aisc(p0)
-            rows_c = set_row(rows0, 0, O.A_CONST, val=bv.not_(aval(p0), 256), isconst=True)
+            is_c = pop_c[0]
+            rows_c = set_row(rows0, 0, O.A_CONST, val=bv.not_(pop_v[0], 256), isconst=True)
             rows_s = set_row(rows0, 0, O.A_NOT, a=p0)
             rows = jax.tree.map(lambda a, b: jnp.where(is_c, a, b), rows_c, rows_s)
             out, ok = pushed(rows, ids[0])
@@ -373,12 +402,6 @@ def build_segment(caps: Caps):
 
         # ---- memory ----
 
-        def conc_addr(r):
-            """(is_small_concrete, addr) for a row as a byte address."""
-            v = aval(r)
-            small = aisc(r) & (jnp.max(v[2:]) == 0) & (v[1] < 16)  # < 2^20
-            return small, (v[0] | (v[1] << 16)).astype(I32)
-
         def mem_lookup(addr):
             hit = (st.mem_addr == addr) & (jnp.arange(MEM) < st.mem_len)
             any_hit = jnp.any(hit)
@@ -405,7 +428,7 @@ def build_segment(caps: Caps):
             )
 
         def h_mload(_):
-            ok_addr, addr = conc_addr(pops[0])
+            ok_addr, addr = ok_addr0, addr0
             any_hit, val_row = mem_lookup(addr)
             row = jnp.where(any_hit, val_row, row_zero)
             st2 = mem_gas(st._replace(), addr, 32)
@@ -416,7 +439,7 @@ def build_segment(caps: Caps):
             return jax.tree.map(lambda a, b: jnp.where(good, a, b), out, halted(O.H_PARK))
 
         def h_mstore(_):
-            ok_addr, addr = conc_addr(pops[0])
+            ok_addr, addr = ok_addr0, addr0
             val_row = pops[1]
             # exact hit -> overwrite; straddling a different entry -> park
             # (keeps live entries mutually disjoint, the invariant the
@@ -441,8 +464,8 @@ def build_segment(caps: Caps):
             return jax.tree.map(lambda a, b: jnp.where(good, a, b), out, halted(O.H_PARK))
 
         def h_sha3(_):
-            ok_off, off = conc_addr(pops[0])
-            ok_len, ln = conc_addr(pops[1])
+            ok_off, off = ok_addr0, addr0
+            ok_len, ln = ok_addr1, addr1
             words = (ln + 31) // 32
             good = ok_off & ok_len & (ln > 0) & (ln % 32 == 0) & (words <= 4)
             # gather word rows off, off+32, ...
@@ -539,15 +562,9 @@ def build_segment(caps: Caps):
 
         # ---- control flow ----
 
-        def valid_dest(addr):
-            a = jnp.clip(addr, 0, jumpmap_t.shape[0] - 1)
-            idx = jumpmap_t[a]
-            return (addr < jumpmap_t.shape[0]) & (idx >= 0), idx
-
         def h_jump(_):
-            ok_addr, addr = conc_addr(pops[0])
-            valid, idx = valid_dest(addr)
-            good = ok_addr & valid
+            valid, idx = valid0, jidx0
+            good = ok_addr0 & valid
             st2 = st._replace(
                 pc=idx,
                 depth=st.depth + 1,
@@ -559,11 +576,10 @@ def build_segment(caps: Caps):
 
         def h_jumpi(_):
             dest_row, word_row = pops[0], pops[1]
-            word_const = aisc(word_row)
-            truth = ~bv.is_zero(aval(word_row))
-            ok_dest, addr = conc_addr(dest_row)
-            valid, idx = valid_dest(addr)
-            can_take = ok_dest & valid
+            word_const = pop_c[1]
+            truth = ~bv.is_zero(pop_v[1])
+            valid, idx = valid0, jidx0
+            can_take = ok_addr0 & valid
 
             # constraint rows (allocated regardless; decode folds constants):
             # cond = (word != 0); ncond = Not(cond)   [host jumpi_ parity]
@@ -638,7 +654,7 @@ def build_segment(caps: Caps):
             )
 
         def h_jumpdest(_):
-            lid = loopid_t[pc]
+            lid = lid_pc
             tracked = lid >= 0  # ids beyond the loops cap are unbounded
             slot = jnp.clip(lid, 0, None)
             count = st.loops[slot] + 1
@@ -671,12 +687,12 @@ def build_segment(caps: Caps):
 
         def h_signextend(_):
             b_row, x_row = pops[0], pops[1]
-            b_c, x_c = aisc(b_row), aisc(x_row)
-            bval = aval(b_row)
+            b_c, x_c = pop_c[0], pop_c[1]
+            bval = pop_v[0]
             b_small = (jnp.max(bval[1:]) == 0) & (bval[0] < 31)
             # fold: both concrete
             bits = (8 * (bval[0] + 1)).astype(I32)
-            x = aval(x_row)
+            x = pop_v[1]
             mask_c = bv.shl(
                 bv.from_ints(1, 256), jnp.full((16,), 0, jnp.uint32).at[0].set(
                     bits.astype(jnp.uint32)), 256,
@@ -701,13 +717,13 @@ def build_segment(caps: Caps):
 
         def h_byte(_):
             i_row, w_row = pops[0], pops[1]
-            both = aisc(i_row) & aisc(w_row)
-            iv = aval(i_row)
+            both = pop_c[0] & pop_c[1]
+            iv = pop_v[0]
             small = (jnp.max(iv[1:]) == 0) & (iv[0] < 32)
             # byte index from the big end: byte i = bits [8*(31-i), +8)
             lo_bit = (8 * (31 - jnp.clip(iv[0], 0, 31))).astype(jnp.uint32)
             shifted = bv.lshr(
-                aval(w_row), jnp.zeros((16,), jnp.uint32).at[0].set(lo_bit), 256
+                pop_v[1], jnp.zeros((16,), jnp.uint32).at[0].set(lo_bit), 256
             )
             folded = jnp.zeros((16,), jnp.uint32).at[0].set(shifted[0] & 0xFF)
             folded = jnp.where(small, folded, jnp.zeros((16,), jnp.uint32))
@@ -877,27 +893,40 @@ def build_segment(caps: Caps):
         state, arena, arena_len, t, n_exec, visited, code, cfg = carry
         gmin_t, gmax_t = code.gmin, code.gmax
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
-        n_exec = n_exec + running.sum().astype(I32)
+        n_live = running.sum().astype(I32)
+        n_exec = n_exec + n_live
         # coverage: mark every live path's pc (dropped index for idle slots)
         pc_or_oob = jnp.where(
             running, jnp.clip(state.pc, 0, visited.shape[0] - 1), visited.shape[0]
         )
         visited = visited.at[pc_or_oob].set(True, mode="drop")
-        ids = arena_len + jnp.arange(B * R, dtype=I32).reshape(B, R)
+        # arena rows are reserved for LIVE paths only (prefix-sum block
+        # assignment): a wide batch with few live paths must not burn B*R
+        # rows per step — that exhausts the arena in ARENA/(B*R) steps.
+        # Dead slots get out-of-range ids; their scatters drop.
+        live_rank = jnp.cumsum(running.astype(I32)) - 1
+        bases = arena_len + live_rank * R
+        ids = jnp.where(
+            running[:, None],
+            bases[:, None] + jnp.arange(R, dtype=I32)[None, :],
+            caps.ARENA,
+        )
         new_state, rows, fork = vstep(state, ids, arena, code, cfg)
 
-        # arena scatter (rows are disjoint fresh slots)
+        # arena scatter (rows are disjoint fresh slots; dead slots drop)
         flat_ids = ids.reshape(-1)
         arena = ArenaDev(
-            op=arena.op.at[flat_ids].set(rows.op.reshape(-1)),
-            a=arena.a.at[flat_ids].set(rows.a.reshape(-1)),
-            b=arena.b.at[flat_ids].set(rows.b.reshape(-1)),
-            c=arena.c.at[flat_ids].set(rows.c.reshape(-1)),
-            width=arena.width.at[flat_ids].set(rows.width.reshape(-1)),
-            val=arena.val.at[flat_ids].set(rows.val.reshape(-1, 16)),
-            isconst=arena.isconst.at[flat_ids].set(rows.isconst.reshape(-1)),
+            op=arena.op.at[flat_ids].set(rows.op.reshape(-1), mode="drop"),
+            a=arena.a.at[flat_ids].set(rows.a.reshape(-1), mode="drop"),
+            b=arena.b.at[flat_ids].set(rows.b.reshape(-1), mode="drop"),
+            c=arena.c.at[flat_ids].set(rows.c.reshape(-1), mode="drop"),
+            width=arena.width.at[flat_ids].set(rows.width.reshape(-1), mode="drop"),
+            val=arena.val.at[flat_ids].set(rows.val.reshape(-1, 16), mode="drop"),
+            isconst=arena.isconst.at[flat_ids].set(
+                rows.isconst.reshape(-1), mode="drop"
+            ),
         )
-        arena_len = arena_len + B * R
+        arena_len = arena_len + n_live * R
 
         # ---- fork grants ----
         # a grant REQUIRES room for the parent's E_FORK event: a granted
@@ -1034,7 +1063,7 @@ def build_segment(caps: Caps):
     def cond(carry):
         state, _, arena_len, t, _n, _v, _code, _cfg = carry
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
-        room = arena_len + B * R < caps.ARENA
+        room = arena_len + running.sum() * R < caps.ARENA
         return (t < caps.K) & running.any() & room
 
     @jax.jit

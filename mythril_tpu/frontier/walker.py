@@ -39,6 +39,24 @@ from mythril_tpu.plugins.signals import PluginSkipState
 log = logging.getLogger(__name__)
 
 
+def fork_branch_row(ev: np.ndarray, taken: bool) -> int:
+    """Arena row of the constraint an E_FORK event appends, or -1.
+
+    THE authoritative decoding of the fork payload (written by
+    step.py's jumpi handler / batch phase): for a single decided branch
+    (extra == -1) the appended condition sits at EV_OP0+2; for a granted
+    fork the taken child appends EV_OP0+2 (cond) and the falling-through
+    parent EV_OP0+3 (Not cond).  Used by the event replay below and by the
+    engine's lineage reconstruction (engine._lineage_constraint_rows).
+    """
+    extra = int(ev[O.EV_EXTRA])
+    if extra == -3:
+        return -1  # taken branch with invalid dest: path died, no constraint
+    if extra == -1 or taken:
+        return int(ev[O.EV_OP0 + 2])
+    return int(ev[O.EV_OP0 + 3])
+
+
 class Walker:
     def __init__(self, laser, arena: HostArena, tables, seeds: List):
         self.laser = laser
@@ -222,7 +240,7 @@ class Walker:
                 rec.carrier = None
                 return
             if extra == -1:  # single-branch decision (concrete or fall-only)
-                cons_row = int(ev[O.EV_OP0 + 2])
+                cons_row = fork_branch_row(ev, taken=True)
                 condition = None
                 if cons_row >= 0:
                     condition = self.decode_wrapped(cons_row)
@@ -233,8 +251,8 @@ class Walker:
                 return
             # granted fork: extra = child slot; child record was linked at
             # harvest via children_by_event
-            cond_row = int(ev[O.EV_OP0 + 2])
-            ncond_row = int(ev[O.EV_OP0 + 3])
+            cond_row = fork_branch_row(ev, taken=True)
+            ncond_row = fork_branch_row(ev, taken=False)
             child = rec.children_by_event.get(rec.carrier_pos - 1)
             if child is not None and not child.dead:
                 child_carrier = _copy.copy(carrier)

@@ -23,20 +23,24 @@ from mythril_tpu.smt.solver import (
 )
 
 
+# opcodes whose execution marks the state as mutating; the frontier engine's
+# batched prefetch (frontier/engine.py) must classify paths identically
+MUTATOR_OPCODES = ("SSTORE", "CALL", "STATICCALL", "CREATE", "CREATE2")
+
+# the per-query probe budget for the "can callvalue exceed 0" check; shared
+# with the frontier prefetch so its warmed memo entries match the hook's
+MUTATION_PROBE_CONFIG = dict(
+    max_rounds=1, candidates_per_round=16, timeout_ms=500, prune_critical=True
+)
+
+
 class MutationPruner(LaserPlugin):
     def initialize(self, symbolic_vm) -> None:
         def mutator_hook(global_state: GlobalState):
             global_state.annotate(MutationAnnotation())
 
         symbolic_vm.register_hooks(
-            "pre",
-            {
-                "SSTORE": [mutator_hook],
-                "CALL": [mutator_hook],
-                "STATICCALL": [mutator_hook],
-                "CREATE": [mutator_hook],
-                "CREATE2": [mutator_hook],
-            },
+            "pre", {op: [mutator_hook] for op in MUTATOR_OPCODES}
         )
 
         def world_state_filter_hook(global_state: GlobalState):
@@ -49,12 +53,7 @@ class MutationPruner(LaserPlugin):
             status, _ = solve_conjunction(
                 global_state.world_state.constraints.get_all_raw()
                 + [UGT(value, symbol_factory.BitVecVal(0, 256)).raw],
-                ProbeConfig(
-                    max_rounds=1,
-                    candidates_per_round=16,
-                    timeout_ms=500,
-                    prune_critical=True,
-                ),
+                ProbeConfig(**MUTATION_PROBE_CONFIG),
             )
             if status != SAT:
                 if status == UNKNOWN:

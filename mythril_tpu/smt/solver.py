@@ -151,6 +151,15 @@ class _Seeder:
         # symbolic-symbolic equalities (e.g. caller == sload(owner_slot)):
         # resolved at assignment-build time by copying the evaluated side
         self.link_pairs: List[Tuple[Term, Term]] = []
+        # symbolic-symbolic unsigned orderings (lo, hi, bump): lo + bump
+        # must not exceed hi (e.g. callvalue <= balances[sender], the
+        # balance-transfer constraint every message call carries); repaired
+        # at build time by raising hi (preferred) or lowering lo
+        self.order_pairs: List[Tuple[Term, Term, int]] = []
+        # disequalities (a, b) wanted different (JUMPI taken branches are
+        # Not(cond == 0)); repaired at build time by flipping the low bit
+        # of one side through the invertible-op machinery
+        self.neq_pairs: List[Tuple[Term, Term]] = []
         self.or_groups: List[List[Term]] = []
         self._overlay_cache: Dict[tuple, "_Seeder"] = {}
         self._collect_groups = collect_groups
@@ -184,6 +193,8 @@ class _Seeder:
         clone.array_hints = dict(self.array_hints)
         clone.weak_vals = dict(self.weak_vals)
         clone.link_pairs = list(self.link_pairs)
+        clone.order_pairs = list(self.order_pairs)
+        clone.neq_pairs = list(self.neq_pairs)
         clone.const_pool = self.const_pool
         clone.or_groups = []
         clone._collect_groups = False
@@ -246,21 +257,31 @@ class _Seeder:
                 self._propagate_bool(c, False)
                 return
             return
-        if t.op == "eq" and want:
+        if t.op == "eq":
             a, b = t.args
-            if terms.is_bv_sort(a.sort):
+            if not terms.is_bv_sort(a.sort):
+                return
+            if want:
                 if a.is_const:
                     self._propagate_value(b, a.value)
                 elif b.is_const:
                     self._propagate_value(a, b.value)
                 else:
                     self.link_pairs.append((a, b))
+            elif not (a.is_const and b.is_const):
+                self.neq_pairs.append((a, b))
             return
         # Inequalities: lower bounds push the variable just past the bound;
         # upper bounds hint zero (weak hints max-combine, so lower bounds win
         # over the zero default and minimization-style caps stay harmless).
         if t.op in ("ult", "ule", "slt", "sle"):
             a, b = t.args
+            if not want and t.op in ("ult", "ule"):
+                # Not(a < b) == b <= a; Not(a <= b) == b < a
+                bump = 1 if t.op == "ule" else 0
+                if not (a.is_const and b.is_const):
+                    self.order_pairs.append((b, a, bump))
+                return
             if want and a.is_const and not b.is_const:
                 # strict bounds need bound+1; non-strict are satisfied at the
                 # bound itself (and must not wrap for an all-ones bound)
@@ -268,6 +289,9 @@ class _Seeder:
                 self._propagate_value(b, mask(a.value + bump, b.width), weak=True)
             elif want and not a.is_const:
                 self._propagate_value(a, 0, weak=True)
+                if t.op in ("ult", "ule") and not b.is_const:
+                    # both sides symbolic: repairable ordering at build time
+                    self.order_pairs.append((a, b, 1 if t.op == "ult" else 0))
 
     def _propagate_value(self, t: Term, value: int, weak: bool = False):
         """Push ``t == value`` down into leaves where ops are invertible."""
@@ -296,8 +320,35 @@ class _Seeder:
             base = arr
             while base.op == "store":
                 base = base.args[0]
-            if base.op == "array_var" and idx.is_const and claim == full:
+            if base.op == "array_var" and idx.is_const:
+                # partial claims (e.g. a bit test through a mask) still make
+                # a useful hint: unclaimed bits default to zero
                 self.array_hints.setdefault((base, idx.value), value)
+            return
+        if t.op == "ite":
+            # steer toward the then-branch (calldata/memory models guard
+            # every byte with a bounds check, ite(i < size, select, 0))
+            c, a, b = t.args
+            self._propagate_bool(c, True)
+            self._propagate_bits(a, value, claim, weak)
+            return
+        if t.op == "bvand":
+            a, b = t.args
+            for cst, other in ((a, b), (b, a)):
+                if cst.is_const:
+                    if value & ~cst.aux:
+                        return  # needs a 1 where the mask forces 0
+                    self._propagate_bits(other, value, claim & cst.aux, weak)
+                    return
+            return
+        if t.op == "bvor":
+            a, b = t.args
+            for cst, other in ((a, b), (b, a)):
+                if cst.is_const:
+                    if (value ^ cst.aux) & cst.aux & claim:
+                        return  # needs a 0 where the mask forces 1
+                    self._propagate_bits(other, value, claim & ~cst.aux, weak)
+                    return
             return
         if t.op == "concat":
             hi, lo = t.args
@@ -320,22 +371,6 @@ class _Seeder:
             inner = t.args[0]
             iw = (1 << inner.width) - 1
             self._propagate_bits(inner, value & iw, claim & iw, weak)
-            return
-        if t.op == "bvand":
-            a, b = t.args
-            for c, x in ((a, b), (b, a)):
-                if c.is_const:
-                    # bits where the const is 1 pass through; where it is 0 the
-                    # result bit says nothing about x
-                    self._propagate_bits(x, value, claim & c.value, weak)
-                    return
-            return
-        if t.op == "bvor":
-            a, b = t.args
-            for c, x in ((a, b), (b, a)):
-                if c.is_const:
-                    self._propagate_bits(x, value, claim & ~c.value, weak)
-                    return
             return
         if t.op == "bvxor":
             a, b = t.args
@@ -567,7 +602,39 @@ class CandidateGenerator:
             }
             asg.arrays[av] = ArrayValue(backing, default=0)
         self._apply_links(s, asg)
+        self._apply_neq_pairs(s, asg)
+        self._apply_order_pairs(s, asg)
         return asg
+
+    def _apply_neq_pairs(self, s, asg: Assignment) -> None:
+        """Repair violated disequalities by flipping the low bit of one side
+        through the invertible-op machinery (a != b is almost always a taken
+        JUMPI branch, Not(cond == 0))."""
+        for a, b in s.neq_pairs:
+            try:
+                vals = evaluate([a, b], asg)
+            except NotImplementedError:
+                continue
+            if vals[a] != vals[b]:
+                continue
+            target = b if a.is_const else a
+            self._force_value(target, mask(vals[target] ^ 1, target.width), asg)
+
+    @staticmethod
+    def _force_value(expr, desired: int, asg: Assignment) -> None:
+        """Best-effort: drive ``expr`` toward ``desired`` by writing the
+        scalar/array leaves the invertible-op propagation reaches."""
+        tmp = _Seeder((), collect_groups=False)  # empty: a bare collector
+        tmp._propagate_value(expr, desired)
+        for v, hint in tmp.scalar_hints.items():
+            if hint.known:
+                asg.scalars[v] = hint.complete(asg.scalars.get(v, 0) or 0)
+        for (arr, idx), val in tmp.array_hints.items():
+            asg.arrays.setdefault(arr, ArrayValue()).backing[idx] = val
+        for v, bound in tmp.weak_vals.items():
+            cur = asg.scalars.get(v, 0)
+            if isinstance(cur, int) and cur < bound:
+                asg.scalars[v] = bound
 
     @staticmethod
     def _link_target(t):
@@ -577,6 +644,52 @@ class CandidateGenerator:
         if t.op == "select" and t.args[0].op == "array_var" and t.args[1].is_const:
             return ("sel", t.args[0], t.args[1].value)
         return None
+
+    @staticmethod
+    def _dyn_target(t):
+        """Like _link_target but also accepts a select whose key is any
+        evaluable term (resolved against the assignment at write time) —
+        e.g. ``balances[sender]`` with a symbolic sender."""
+        info = CandidateGenerator._link_target(t)
+        if info is not None:
+            return info
+        if t.op == "select" and t.args[0].op == "array_var":
+            return ("dynsel", t.args[0], t.args[1])
+        return None
+
+    def _apply_order_pairs(self, s, asg: Assignment) -> None:
+        """Repair violated symbolic orderings (lo + bump <= hi) by raising
+        the upper side — writing through a var or an array cell whose key
+        evaluates under the assignment — else lowering the lower side."""
+        for lo, hi, bump in s.order_pairs:
+            try:
+                vals = evaluate([lo, hi], asg)
+                lo_v, hi_v = vals[lo], vals[hi]
+            except NotImplementedError:
+                continue
+            if lo_v + bump <= hi_v:
+                continue
+            hi_max = (1 << hi.width) - 1
+            target = self._dyn_target(hi)
+            if target is not None and lo_v + bump <= hi_max:
+                self._dyn_write(target, lo_v + bump, asg)
+                continue
+            target = self._dyn_target(lo)
+            if target is not None and hi_v >= bump:
+                self._dyn_write(target, hi_v - bump, asg)
+
+    @staticmethod
+    def _dyn_write(info, value: int, asg: Assignment) -> None:
+        if info[0] == "var":
+            asg.scalars[info[1]] = value
+        elif info[0] == "sel":
+            asg.arrays.setdefault(info[1], ArrayValue()).backing[info[2]] = value
+        else:  # dynsel: resolve the key against the current assignment
+            try:
+                key_v = evaluate([info[2]], asg)[info[2]]
+            except NotImplementedError:
+                return
+            asg.arrays.setdefault(info[1], ArrayValue()).backing[key_v] = value
 
     def _apply_links(self, s, asg: Assignment) -> None:
         """Copy evaluated values across symbolic equalities (two passes).

