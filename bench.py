@@ -308,12 +308,15 @@ def wl_corpus(production: bool):
     assert corpus, "no corpus inputs found"
     totals = {"states": 0}
     found = {}
+    walls = {}
 
     def analyze_one(path):
         _clear_caches()
+        t0 = time.time()
         sym, issues = _analyze(
-            _read_runtime(Path(path)), 0x0901D12E, 2, timeout=45
+            _read_runtime(Path(path)), 0x0901D12E, 2, timeout=60
         )
+        walls[Path(path).name] = time.time() - t0
         totals["states"] += sym.laser.total_states
         found[Path(path).name] = {i.swc_id for i in issues}
         return len(issues)
@@ -323,9 +326,14 @@ def wl_corpus(production: bool):
     wall = time.time() - t0
     # recall asserted only over THIS SHARD's slice (multi-host sweeps split
     # the corpus; other shards' contracts never appear in `found`)
+    tag = "production" if production else "baseline"
     for name, swc in CORPUS_RECALL.items():
         if name in found:
-            assert swc in found[name], f"corpus recall lost: {name}"
+            assert swc in found[name], (
+                f"corpus recall lost ({tag}): {name} found={found[name]} "
+                f"wall={walls.get(name, -1):.1f}s "
+                f"(all walls: { {k: round(v, 1) for k, v in walls.items()} })"
+            )
     return totals["states"], wall
 
 

@@ -60,6 +60,12 @@ def concolic_execution(
     flipped branch."""
     from mythril_tpu.support.support_args import args
 
+    old_timeout = args.solver_timeout
     args.solver_timeout = solver_timeout
-    init_state, trace = concrete_execution(concrete_data)
-    return flip_branches(init_state, concrete_data, jump_addresses, trace)
+    try:
+        init_state, trace = concrete_execution(concrete_data)
+        return flip_branches(init_state, concrete_data, jump_addresses, trace)
+    finally:
+        # a leaked per-query budget silently reshapes every later analysis
+        # in the process (it feeds the engine's prune/confirm deadlines)
+        args.solver_timeout = old_timeout

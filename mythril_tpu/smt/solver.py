@@ -609,12 +609,16 @@ class CandidateGenerator:
     def _apply_neq_pairs(self, s, asg: Assignment) -> None:
         """Repair violated disequalities by flipping the low bit of one side
         through the invertible-op machinery (a != b is almost always a taken
-        JUMPI branch, Not(cond == 0))."""
+        JUMPI branch, Not(cond == 0)).  All sides evaluate in ONE DAG walk —
+        per-pair walks dominated candidate-build time on wide frontiers."""
+        if not s.neq_pairs:
+            return
+        sides = [t for pair in s.neq_pairs for t in pair]
+        try:
+            vals = evaluate(sides, asg)
+        except NotImplementedError:
+            return
         for a, b in s.neq_pairs:
-            try:
-                vals = evaluate([a, b], asg)
-            except NotImplementedError:
-                continue
             if vals[a] != vals[b]:
                 continue
             target = b if a.is_const else a
@@ -661,12 +665,15 @@ class CandidateGenerator:
         """Repair violated symbolic orderings (lo + bump <= hi) by raising
         the upper side — writing through a var or an array cell whose key
         evaluates under the assignment — else lowering the lower side."""
+        if not s.order_pairs:
+            return
+        sides = [t for lo, hi, _ in s.order_pairs for t in (lo, hi)]
+        try:
+            vals = evaluate(sides, asg)
+        except NotImplementedError:
+            return
         for lo, hi, bump in s.order_pairs:
-            try:
-                vals = evaluate([lo, hi], asg)
-                lo_v, hi_v = vals[lo], vals[hi]
-            except NotImplementedError:
-                continue
+            lo_v, hi_v = vals[lo], vals[hi]
             if lo_v + bump <= hi_v:
                 continue
             hi_max = (1 << hi.width) - 1
@@ -776,8 +783,16 @@ def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
     — each bucket is a smaller probe/CDCL instance, and per-bucket memoization
     means an engine query that extends one bucket leaves every other bucket's
     cached verdict intact.  Deterministic: buckets ordered by first conjunct.
+
+    Memoized per conjunct set: a wide frontier poses hundreds of sibling
+    queries per harvest and the union-find over the shared DAG was measured
+    at ~20% of their solve time.
     """
     conjuncts = list(conjuncts)
+    memo_key = frozenset(t.tid for t in conjuncts)
+    hit = _split_cache.get(memo_key)
+    if hit is not None:
+        return hit
     # union-find over CONJUNCT indices
     parent = list(range(len(conjuncts)))
 
@@ -801,6 +816,7 @@ def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
     has_var: Dict[int, bool] = {}
     for t in dag:
         if t.op == "apply":
+            _split_remember(memo_key, [conjuncts])
             return [conjuncts]
         has_var[t.tid] = t.op in ("var", "array_var") or any(
             has_var[a.tid] for a in t.args
@@ -832,11 +848,22 @@ def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
             buckets[key] = []
             order.append(key)
         buckets[key].append(c)
-    return [buckets[k] for k in order]
+    result = [buckets[k] for k in order]
+    _split_remember(memo_key, result)
+    return result
+
+
+_split_cache: Dict[frozenset, List[List[Term]]] = {}
+
+
+def _split_remember(key: frozenset, result: List[List[Term]]) -> None:
+    if len(_split_cache) >= 4096:
+        _split_cache.clear()
+    _split_cache[key] = result
 
 
 def _fast_path(
-    conjuncts: Sequence[Term], use_cache: bool = True
+    conjuncts: Sequence[Term], use_cache: bool = True, replay: bool = True
 ) -> Tuple[Optional[Tuple[str, Optional["Assignment"]]], List[Term], frozenset]:
     """Cheap solving tiers shared by single-query and batched entry points.
 
@@ -856,7 +883,11 @@ def _fast_path(
         hit = _model_cache.results.get(key)
         if hit is not None:
             return hit, conj, key
-        for asg in reversed(_model_cache.models):
+    if use_cache and replay:
+        # replay only the freshest models: each miss costs a full DAG
+        # evaluation, and hits overwhelmingly come from the last few
+        # (sibling queries extend the immediately preceding one)
+        for asg in reversed(_model_cache.models[-_REPLAY_DEPTH:]):
             try:
                 vals = evaluate(conj, asg)
             except Exception:
@@ -892,11 +923,39 @@ def check_satisfiable_batch(
     pending: List[Tuple[int, List[Term], frozenset]] = []
 
     for i, cs in enumerate(constraint_sets):
-        resolved, conj, key = _fast_path(cs)
+        # per-set model replay is deferred: it is batched below over the
+        # UNION of pending conjuncts (sibling sets share their whole path
+        # prefix, so N separate replays re-walk the same DAG N times)
+        resolved, conj, key = _fast_path(cs, replay=False)
         if resolved is not None:
             results[i] = resolved[0] == SAT
         else:
             pending.append((i, conj, key))
+
+    if pending and _model_cache.models:
+        union: List[Term] = []
+        seen_tids: set = set()
+        for _i, conj, _k in pending:
+            for c in conj:
+                if c.tid not in seen_tids:
+                    seen_tids.add(c.tid)
+                    union.append(c)
+        for asg in reversed(_model_cache.models[-_REPLAY_DEPTH:]):
+            try:
+                vals = evaluate(union, asg)
+            except Exception:
+                continue
+            still = []
+            for i, conj, key in pending:
+                if all(vals[c] for c in conj):
+                    SolverStatistics().probe_hits += 1
+                    _model_cache.remember(key, SAT, asg)
+                    results[i] = True
+                else:
+                    still.append((i, conj, key))
+            pending = still
+            if not pending:
+                break
 
     # The merged-dispatch path pays off only when it amortizes over enough
     # sets: a 2-sibling JUMPI fork is cheaper through the per-set stack
@@ -918,7 +977,8 @@ def check_satisfiable_batch(
 
     for i, conj, _key in pending:
         if results[i] is None:
-            status, _ = solve_conjunction(conj, config)
+            # replay already happened batched above; don't repeat per set
+            status, _ = solve_conjunction(conj, config, replay=False)
             if status == UNKNOWN:
                 SolverStatistics().unknown_as_unsat += 1
             results[i] = status == SAT
@@ -966,6 +1026,11 @@ def _batch_probe_device(pending, results, config) -> None:
                 break
 
 
+# how many recent models the cheap tiers replay per query (each miss costs
+# a full DAG evaluation); _ModelCache retention matches this bound
+_REPLAY_DEPTH = 6
+
+
 class _ModelCache:
     """Incremental-solving stand-in: recently found models, tried first.
 
@@ -978,7 +1043,7 @@ class _ModelCache:
     same world state are free.
     """
 
-    def __init__(self, max_models: int = 12, max_results: int = 4096):
+    def __init__(self, max_models: int = _REPLAY_DEPTH, max_results: int = 4096):
         self.models: List[Assignment] = []
         self.results: Dict[frozenset, Tuple[str, Optional[Assignment]]] = {}
         self.max_models = max_models
@@ -1000,6 +1065,9 @@ _model_cache = _ModelCache()
 def clear_model_cache() -> None:
     _model_cache.models.clear()
     _model_cache.results.clear()
+    # the split memo holds Term DAGs: clear with the other solver caches so
+    # cold-cache measurements stay cold and dropped terms can be collected
+    _split_cache.clear()
 
 
 def solve_conjunction(
@@ -1007,6 +1075,7 @@ def solve_conjunction(
     config: Optional[ProbeConfig] = None,
     extra_seeds: Optional[Sequence[Assignment]] = None,
     use_cache: bool = True,
+    replay: bool = True,
 ) -> Tuple[str, Optional[Assignment]]:
     """Core entry: find a model of And(conjuncts) or report unsat/unknown.
 
@@ -1021,7 +1090,7 @@ def solve_conjunction(
     t0 = time.time()
 
     # tiers 0 + memo + 0.5 (shared with check_satisfiable_batch)
-    resolved, conjuncts, cache_key = _fast_path(conjuncts, use_cache)
+    resolved, conjuncts, cache_key = _fast_path(conjuncts, use_cache, replay)
     if resolved is not None:
         return resolved
 
@@ -1117,7 +1186,6 @@ def solve_conjunction(
     if extra_seeds:
         candidates.extend(extra_seeds)
     total = config.max_rounds * config.candidates_per_round
-    candidates.extend(gen.generate(total, deadline))
 
     # Device batching only when the deadline still has room: a cache-miss
     # compile is the dominant cost, and a blown solver_timeout breaks the
@@ -1125,10 +1193,13 @@ def solve_conjunction(
     compiled = (
         _try_compile_device(conjuncts)
         if _device_backend_requested()
-        and _device_worthwhile(conjuncts, len(candidates))
+        and _device_worthwhile(conjuncts, total + len(candidates))
         and time.time() < deadline
         else None
     )
+    if compiled is not None:
+        # the batched dispatch needs the whole pool upfront
+        candidates.extend(gen.generate(total, deadline))
 
     best_asg, best_score = None, -1
     if compiled is not None:
@@ -1161,7 +1232,19 @@ def solve_conjunction(
                 b = int(_np.argmax(scores))
                 best_score, best_asg = int(scores[b]), candidates[b]
     if compiled is None:
-        for asg in candidates:
+        # host path: STREAM candidates — directed builds (hint + repair
+        # passes) are expensive, and on well-hinted queries the first one
+        # already satisfies; building the whole pool upfront wastes
+        # (total - 1) builds per query across a wide frontier
+        def streamed():
+            yield from candidates
+            remaining = total - max(0, len(candidates) - len(extra_seeds or ()))
+            for _ in range(max(0, remaining)):
+                if time.time() > deadline:
+                    return
+                yield gen.generate(1)[0]
+
+        for asg in streamed():
             try:
                 vals = evaluate(conjuncts, asg)
             except NotImplementedError:

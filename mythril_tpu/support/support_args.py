@@ -39,8 +39,11 @@ class Args:
     probe_backend: str = "auto"  # auto | host | jax | cdcl (forced exact)
     keccak_backend: str = "auto"  # auto | jax | pallas (pallas on TPU when auto)
     # auto-backend break-even: dispatch to device when DAG-size x candidates
-    # exceeds this (host evaluation below it is faster than one round trip)
-    device_probe_threshold: int = 150_000
+    # exceeds this (host evaluation below it is faster than one round trip).
+    # Re-measured after the round-2 probe speedups (~4x faster host tiers):
+    # on the tunneled chip per-query dispatch only pays past ~600k; the
+    # device's real wins are frontier segments and merged batch dispatches
+    device_probe_threshold: int = 600_000
     # frontier checkpointing
     checkpoint_path: Optional[str] = None
     resume_from: Optional[str] = None
