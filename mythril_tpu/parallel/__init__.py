@@ -13,6 +13,7 @@ from mythril_tpu.parallel.mesh import (
     CAND_AXIS,
     PATH_AXIS,
     make_frontier_mesh,
+    shard_frontier_inputs,
     shard_probe_args,
 )
 from mythril_tpu.parallel.probe import (
@@ -28,6 +29,7 @@ __all__ = [
     "CAND_AXIS",
     "PATH_AXIS",
     "make_frontier_mesh",
+    "shard_frontier_inputs",
     "shard_probe_args",
     "evaluate_batch_sharded",
     "frontier_step",

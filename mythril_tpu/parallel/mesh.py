@@ -52,6 +52,31 @@ def make_frontier_mesh(
     return Mesh(np.asarray(devices).reshape(p, c), (PATH_AXIS, CAND_AXIS))
 
 
+def shard_frontier_inputs(state, arena_dev, visited, code_dev, mesh: Mesh):
+    """Shard the batched frontier-interpreter inputs over ``mesh``'s path
+    axis: every FrontierState field carries a leading [B] path dimension
+    (split across devices), while the term arena, coverage bitmap and code
+    tables are replicated (read-mostly; the arena scatter's row blocks are
+    disjoint per path, so GSPMD keeps writes shard-local and inserts the
+    collectives for the cross-path fork-grant phase).
+
+    Returns (state, arena_dev, visited, code_dev) re-placed; pass them to
+    the ordinary jitted segment — XLA partitions the program (SURVEY.md
+    §5.8's ICI frontier sharding with no separate SPMD code path).
+    """
+
+    def path_shard(x):
+        spec = P(PATH_AXIS, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    repl = NamedSharding(mesh, P())
+    state = jax.tree.map(path_shard, state)
+    arena_dev = jax.tree.map(lambda x: jax.device_put(x, repl), arena_dev)
+    visited = jax.device_put(visited, repl)
+    code_dev = jax.tree.map(lambda x: jax.device_put(x, repl), code_dev)
+    return state, arena_dev, visited, code_dev
+
+
 def _leaf_spec(batch_dims: int) -> P:
     """PartitionSpec for a probe-input leaf.
 
