@@ -166,23 +166,22 @@ class HostArena:
     def pull_from_device(self, dev_arrays, new_length: int) -> None:
         """Copy rows [self.length:new_length) appended by the device.
 
-        One batched transfer for all seven slices — the arrays themselves
-        stay device-resident (only the increment crosses the link)."""
+        Chunked packed transfer (step.pull_arena_rows): ONE fixed-shape
+        dispatch and ONE host copy per chunk — per-slice pulls with fresh
+        bounds paid a remote compile + round trip each on tunneled chips."""
         if new_length <= self.length:
             return
-        import jax
+        from mythril_tpu.frontier.step import pull_arena_rows
 
         lo, hi = self.length, int(new_length)
-        op, a, b, c, width, val, isconst = jax.device_get(
-            tuple(arr[lo:hi] for arr in dev_arrays)
-        )
+        op, a, b, c, width, isconst, val = pull_arena_rows(dev_arrays, lo, hi)
         self.op[lo:hi] = op
         self.a[lo:hi] = a
         self.b[lo:hi] = b
         self.c[lo:hi] = c
         self.width[lo:hi] = width
         self.val[lo:hi] = val
-        self.isconst[lo:hi] = isconst
+        self.isconst[lo:hi] = isconst.astype(bool)
         self.length = hi
 
     # ------------------------------------------------------------------

@@ -275,8 +275,11 @@ class FrontierEngine:
             out_state, dev_arena, out_len, n_exec, visited = segment(
                 st, dev_arena, arena_len, visited, code_dev, cfg
             )
-            # pull state to host mirrors (writable: harvest mutates slots)
-            st = FrontierState(*[np.array(x) for x in out_state])
+            # pull state to host mirrors (writable: harvest mutates slots);
+            # packed: one transfer instead of one round trip per field
+            from mythril_tpu.frontier.step import pull_state
+
+            st = pull_state(out_state)
             arena_len_new = int(out_len)
             arena.pull_from_device(dev_arena, arena_len_new)
             arena_len = arena_len_new
