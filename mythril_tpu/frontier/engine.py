@@ -50,7 +50,14 @@ from mythril_tpu.frontier.code import (
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
 from mythril_tpu.frontier.stats import FrontierStatistics
-from mythril_tpu.frontier.step import ArenaDev, CfgScalars, CodeDev, cached_segment
+from mythril_tpu.frontier.step import (
+    ArenaDev,
+    CfgScalars,
+    CodeDev,
+    cached_segment,
+    pull_state,
+    push_state,
+)
 from mythril_tpu.frontier.walker import Walker
 from mythril_tpu.support.support_args import args
 from mythril_tpu.support.time_handler import time_handler
@@ -273,12 +280,10 @@ class FrontierEngine:
             stats = FrontierStatistics()
             t_seg = time.time()
             out_state, dev_arena, out_len, n_exec, visited = segment(
-                st, dev_arena, arena_len, visited, code_dev, cfg
+                push_state(st), dev_arena, arena_len, visited, code_dev, cfg
             )
             # pull state to host mirrors (writable: harvest mutates slots);
             # packed: one transfer instead of one round trip per field
-            from mythril_tpu.frontier.step import pull_state
-
             st = pull_state(out_state)
             arena_len_new = int(out_len)
             arena.pull_from_device(dev_arena, arena_len_new)

@@ -21,7 +21,7 @@ and XLA fuses the lot into one kernel per step.
 
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -1091,32 +1091,48 @@ ARENA_CHUNK = 8192  # rows per packed arena pull (22 i32 words per row)
 
 
 @lru_cache(maxsize=16)
-def _state_packer(field_sizes: tuple):
-    sizes = list(field_sizes)
+def _state_packer(field_shapes: tuple):
+    shapes = list(field_shapes)
+    sizes = [int(np.prod(s)) for s in shapes]
+    bounds = np.cumsum([0] + sizes)
 
     @jax.jit
     def pack(state: FrontierState):
         return jnp.concatenate([f.reshape(-1) for f in state])
 
-    def unpack(buf: np.ndarray, shapes) -> FrontierState:
-        out = []
-        off = 0
-        for size, shape in zip(sizes, shapes):
-            out.append(buf[off: off + size].reshape(shape).copy())
-            off += size
-        return FrontierState(*out)
+    def unpack_host(buf: np.ndarray) -> FrontierState:
+        return FrontierState(*(
+            buf[bounds[i]: bounds[i + 1]].reshape(shapes[i]).copy()
+            for i in range(len(shapes))
+        ))
 
-    return pack, unpack
+    @jax.jit
+    def unpack_dev(buf) -> FrontierState:
+        return FrontierState(*(
+            jax.lax.dynamic_slice_in_dim(buf, int(bounds[i]), sizes[i])
+            .reshape(shapes[i])
+            for i in range(len(shapes))
+        ))
+
+    return pack, unpack_host, unpack_dev
 
 
 def pull_state(state: FrontierState) -> FrontierState:
-    """One packed transfer for the whole state pytree (writable mirror)."""
-    shapes = [f.shape for f in state]
-    pack, unpack = _state_packer(tuple(int(np.prod(s)) for s in shapes))
-    return unpack(np.asarray(pack(state)), shapes)
+    """One packed device->host transfer for the whole state pytree."""
+    assert all(f.dtype == np.int32 for f in state), (
+        "packed state transfer assumes uniform int32 fields"
+    )
+    pack, unpack_host, _ = _state_packer(tuple(f.shape for f in state))
+    return unpack_host(np.asarray(pack(state)))
 
 
-from functools import partial
+def push_state(state: FrontierState):
+    """One packed host->device transfer: the numpy mirror crosses the link
+    as a single buffer and unpacks on device (the symmetric twin of
+    pull_state — per-field uploads pay one round trip each on a tunnel)."""
+    pack, _h, unpack_dev = _state_packer(tuple(f.shape for f in state))
+    buf = np.concatenate([np.asarray(f).reshape(-1) for f in state])
+    return unpack_dev(jax.device_put(buf))
 
 
 @partial(jax.jit, static_argnums=2)
