@@ -138,24 +138,6 @@ def test_arena_const_value_full_width():
     assert arena.const_value(row) == (1 << 256) - 1
 
 
-def test_differential_real_solc_contract():
-    """Regression: solc-compiled code (MSTORE/JUMPI dense) exercises event
-    buffer pressure and the fork-grant/event-emission coupling; issues must
-    match the host engine exactly."""
-    import pathlib
-
-    import pytest
-
-    path = pathlib.Path("/root/reference/tests/testdata/inputs/suicide.sol.o")
-    if not path.exists():
-        pytest.skip("reference corpus not mounted")
-    code = path.read_text().strip().replace("0x", "")
-    host = analyze(code, tx_count=2, modules=["AccidentallyKillable"])
-    dev = analyze(code, tx_count=2, modules=["AccidentallyKillable"], frontier=True)
-    assert issue_keys(host) == issue_keys(dev)
-    assert any(i.swc_id == "106" for i in dev)
-
-
 def test_mload_straddling_stored_word_parks():
     """Soundness regression: MLOAD at 16 over a word stored at 0 must not
     read zero on the device (exact-address miss); the path parks and the
@@ -193,3 +175,28 @@ def test_parked_call_body_falls_back_to_host():
     host = analyze(DISPATCH + body)
     dev = analyze(DISPATCH + body, frontier=True)
     assert issue_keys(host) == issue_keys(dev)
+
+
+@pytest.mark.parametrize(
+    "fixture,module,swc",
+    [
+        ("suicide.sol.o", "AccidentallyKillable", "106"),
+        ("exceptions.sol.o", "Exceptions", "110"),
+        ("origin.sol.o", "TxOrigin", "115"),
+        ("ether_send.sol.o", "EtherThief", "105"),
+    ],
+)
+def test_differential_corpus_contracts(fixture, module, swc):
+    """Frontier-vs-host issue parity across distinct detectors on real solc
+    output (the corpus sweep's recall contracts; solc code is MSTORE/JUMPI
+    dense, exercising event-buffer pressure and fork-grant coupling)."""
+    import pathlib
+
+    path = pathlib.Path("/root/reference/tests/testdata/inputs") / fixture
+    if not path.exists():
+        pytest.skip("reference corpus not mounted")
+    code = path.read_text().strip().replace("0x", "")
+    host = analyze(code, tx_count=2, modules=[module])
+    dev = analyze(code, tx_count=2, modules=[module], frontier=True)
+    assert issue_keys(host) == issue_keys(dev)
+    assert any(i.swc_id == swc for i in dev)
