@@ -343,7 +343,7 @@ def wl_corpus(production: bool):
 WORKLOADS = [
     ("suicide_1tx", wl_suicide, "states/sec", 3),
     ("killbilly_3tx", wl_killbilly, "states/sec", 3),
-    ("overflow_256bit", wl_overflow, "states/sec", 1),
+    ("overflow_256bit", wl_overflow, "states/sec", 2),
     ("wide_frontier", wl_wide_frontier, "states/sec", 2),
     ("concolic_flip", wl_concolic, "flips/sec", 3),
     ("corpus_sweep", wl_corpus, "states/sec", 2),

@@ -1112,7 +1112,8 @@ def solve_conjunction(
                 prune_critical=config.prune_critical,
             )
             status, asg = solve_conjunction(
-                bucket, sub_config, extra_seeds=extra_seeds, use_cache=use_cache
+                bucket, sub_config, extra_seeds=extra_seeds,
+                use_cache=use_cache, replay=replay,
             )
             if status == UNSAT:
                 if use_cache:
