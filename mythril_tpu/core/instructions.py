@@ -874,9 +874,19 @@ class Instruction:
     def log_(self, global_state: GlobalState) -> List[GlobalState]:
         s = global_state.mstate.stack
         num_topics = int(self.op_code[3:])
-        s.pop(), s.pop()  # offset, length
+        offset, length = s.pop(), s.pop()
         for _ in range(num_topics):
             s.pop()
+        # logged data lives in memory: a concrete range charges expansion
+        # (an absurd range must OOG, VMTests log1MemExp); symbolic ranges
+        # stay uncharged like the other approximated memory ops
+        try:
+            off = util.get_concrete_int(offset)
+            ln = util.get_concrete_int(length)
+            if ln:
+                global_state.mstate.mem_extend(off, ln)
+        except TypeError:
+            pass
         return [global_state]
 
     # ==================================================================
