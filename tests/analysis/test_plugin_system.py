@@ -129,3 +129,26 @@ def test_benchmark_long_series_downsampled_not_truncated(tmp_path):
     assert len(data["series"]) <= 10_001
     assert data["series"][-1] == [24.999, 25_000]  # last point kept
     assert "24" in (tmp_path / "long.json.svg").read_text()  # x axis ~25s
+
+
+def test_frontier_stats_in_report_meta():
+    """--frontier runs surface the park/segment telemetry in jsonv2 meta
+    (the data that prioritizes new device handlers, frontier/stats.py)."""
+    import json
+
+    from mythril_tpu.analysis.report import Report
+    from mythril_tpu.core.execution_info import FrontierStatsInfo
+    from mythril_tpu.frontier.stats import FrontierStatistics
+
+    stats = FrontierStatistics()
+    stats.reset()
+    stats.device_instructions = 123
+    stats.record_park("CALL")
+    try:
+        report = Report(execution_info=[FrontierStatsInfo()])
+        meta = json.loads(report.as_swc_standard_format())[0]["meta"]
+        frontier = meta["mythril_execution_info"]["frontier"]
+        assert frontier["device_instructions"] == 123
+        assert frontier["parks_by_opcode"] == {"CALL": 1}
+    finally:
+        stats.reset()
