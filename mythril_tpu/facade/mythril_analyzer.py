@@ -167,6 +167,12 @@ class MythrilAnalyzer:
             if benchmark_base and len(self.contracts) > 1:
                 # one series file per contract instead of silent overwrites
                 args.benchmark_path = f"{benchmark_base}.{n_contract}"
+            # the frontier counters are process-wide: without a per-contract
+            # reset, contract N's jsonv2 meta would report parks/segment time
+            # accumulated from earlier contracts in the same invocation
+            from mythril_tpu.frontier.stats import FrontierStatistics
+
+            FrontierStatistics().reset()
             try:
                 sym = self._sym_exec(contract)
                 issues = fire_lasers(sym, modules or self.cmd_args.modules)

@@ -336,7 +336,7 @@ class _Seeder:
             a, b = t.args
             for cst, other in ((a, b), (b, a)):
                 if cst.is_const:
-                    if value & ~cst.aux:
+                    if value & ~cst.aux & claim:
                         return  # needs a 1 where the mask forces 0
                     self._propagate_bits(other, value, claim & cst.aux, weak)
                     return
@@ -853,13 +853,15 @@ def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
     return result
 
 
-_split_cache: Dict[frozenset, List[List[Term]]] = {}
+_split_cache: Dict[frozenset, tuple] = {}
 
 
 def _split_remember(key: frozenset, result: List[List[Term]]) -> None:
     if len(_split_cache) >= 4096:
         _split_cache.clear()
-    _split_cache[key] = result
+    # tuples of tuples: the cache is shared, so accidental mutation by a
+    # future caller raises instead of corrupting unrelated queries
+    _split_cache[key] = tuple(tuple(group) for group in result)
 
 
 def _fast_path(
@@ -944,10 +946,22 @@ def check_satisfiable_batch(
             try:
                 vals = evaluate(union, asg)
             except Exception:
-                continue
+                # one unevaluable conjunct must not cost every sibling set its
+                # cache hit: fall back to per-set replay for this model and
+                # let only the sets containing the bad term miss
+                vals = None
             still = []
             for i, conj, key in pending:
-                if all(vals[c] for c in conj):
+                try:
+                    sat_here = (
+                        all(vals[c] for c in conj)
+                        if vals is not None
+                        else all(evaluate(conj, asg)[c] for c in conj)
+                    )
+                except Exception:
+                    still.append((i, conj, key))
+                    continue
+                if sat_here:
                     SolverStatistics().probe_hits += 1
                     _model_cache.remember(key, SAT, asg)
                     results[i] = True

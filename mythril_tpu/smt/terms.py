@@ -712,7 +712,8 @@ _TOPO_CACHE_MAX = 1024
 def topo_order(roots: Iterable[Term]):
     """Post-order (children first) over the DAG reachable from roots.
 
-    Returns a memoized list — callers must treat it as read-only."""
+    Returns a memoized tuple (immutable: the cache is shared across
+    callers, and a mutation would corrupt unrelated queries)."""
     roots = tuple(roots)
     key = tuple(r.tid for r in roots)
     cached = _TOPO_CACHE.get(key)
@@ -735,6 +736,7 @@ def topo_order(roots: Iterable[Term]):
                 stack.append((a, False))
     if len(_TOPO_CACHE) >= _TOPO_CACHE_MAX:
         _TOPO_CACHE.clear()
+    out = tuple(out)
     _TOPO_CACHE[key] = out
     return out
 
