@@ -1,0 +1,159 @@
+"""Cooperative corpus analysis: many contracts, one device frontier.
+
+The reference analyzes a corpus strictly sequentially — one contract, one
+full symbolic execution, next contract (reference mythril/mythril/
+mythril_analyzer.py:138-175).  On a TPU that serializes exactly the axis the
+hardware wants to batch: each small contract's frontier is too narrow to
+amortize segment dispatches, so per-contract runs stay host-bound.
+
+This driver instead runs the per-contract transaction loops in LOCKSTEP:
+
+  1. every contract's analysis is constructed (plugins, hooks, world state)
+     but deferred (``SymExecWrapper(defer_exec=True)``);
+  2. per transaction round, every live analysis seeds its work list
+     (``seed_message_call``) and the combined seed set — one code identity
+     per contract — executes as ONE wide multi-code frontier batch
+     (``frontier.engine.drain_lasers``): the corpus is the batch axis;
+  3. each analysis then drains its residual work list through its own host
+     engine (parked paths, frontier-ineligible states) and closes the round
+     (plugin signals, open-state reseeding) exactly as ``LaserEVM.
+     _execute_transactions`` does (core/svm.py:173-219);
+  4. issues are grouped per contract by the distinct address each analysis
+     ran at.
+
+Semantics per contract are unchanged — the frontier parks anything it
+cannot run and each laser's host engine finishes it — only the scheduling
+across contracts differs.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mythril_tpu.support.support_args import args
+from mythril_tpu.support.time_handler import time_handler
+
+log = logging.getLogger(__name__)
+
+#: default spacing of per-contract analysis addresses (issues group by address)
+BASE_ADDRESS = 0x0901D12E
+
+
+def analyze_cooperative(
+    jobs: Sequence[Tuple[str, bytes]],
+    transaction_count: int = 2,
+    modules: Optional[List[str]] = None,
+    strategy: str = "bfs",
+    execution_timeout: int = 60,
+    base_address: int = BASE_ADDRESS,
+    caps=None,
+):
+    """Analyze ``jobs`` (name, runtime bytecode) cooperatively.
+
+    Returns ``(issues_by_name, total_states)``.  Every contract gets its own
+    laser/plugins/hooks at a distinct address; recall semantics match
+    sequential per-contract analysis (differentially tested in
+    tests/analysis/test_cooperative.py).
+    """
+    from mythril_tpu.analysis.security import retrieve_callback_issues
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.core.transaction import symbolic as sym_tx
+    from mythril_tpu.frontier.engine import drain_lasers
+    from mythril_tpu.smt.solver import check_satisfiable_batch
+
+    addresses = [base_address + 0x10000 * i for i in range(len(jobs))]
+    wrappers = [
+        SymExecWrapper(
+            code,
+            address=addr,
+            strategy=strategy,
+            transaction_count=transaction_count,
+            execution_timeout=execution_timeout,
+            modules=modules,
+            defer_exec=True,
+        )
+        for (name, code), addr in zip(jobs, addresses)
+    ]
+
+    # the global wall-clock budget covers the whole batch: the lockstep
+    # rounds interleave contracts, so per-contract budgets do not partition
+    time_handler.start_execution(execution_timeout * max(1, len(jobs)))
+    t0 = time.time()
+    for w, addr in zip(wrappers, addresses):
+        w.laser._fire("start_sym_exec")
+        w.laser.time = t0
+        w.laser.open_states = [w.deferred_world_state]
+        w.laser.executed_transactions = True
+
+    use_frontier = bool(args.frontier)
+    for round_idx in range(transaction_count):
+        live = []
+        for w, addr in zip(wrappers, addresses):
+            laser = w.laser
+            if not laser.open_states:
+                continue
+            # batched open-state prune (core/svm.py:186-197)
+            if not args.sparse_pruning:
+                flags = check_satisfiable_batch(
+                    [s.constraints.get_all_raw() for s in laser.open_states]
+                )
+                laser.open_states = [
+                    s for s, ok in zip(laser.open_states, flags) if ok
+                ]
+            if not laser.open_states:
+                continue
+            laser._fire("start_sym_trans")
+            sym_tx.seed_message_call(laser, addr)
+            live.append(w)
+        if not live:
+            break
+        log.info(
+            "cooperative round %d: %d live contracts, %d seeds",
+            round_idx,
+            len(live),
+            sum(len(w.laser.work_list) for w in live),
+        )
+        if use_frontier:
+            # the whole corpus round as one wide multi-code segment batch
+            try:
+                drain_lasers([w.laser for w in live], caps=caps)
+            except Exception as e:  # graceful degradation, never lose a run
+                log.warning(
+                    "cooperative frontier failed; host engines continue: %s",
+                    e, exc_info=True,
+                )
+        for w in live:
+            # host continuation: parked paths + frontier-ineligible states
+            w.laser.exec()
+            w.laser._fire("stop_sym_trans")
+
+    benchmark_base = args.benchmark_path
+    try:
+        for n, w in enumerate(wrappers):
+            w.laser._fire("stop_sym_exec")
+            if benchmark_base and len(wrappers) > 1:
+                # one series file per contract (same convention as
+                # facade/mythril_analyzer.py) instead of silent overwrites
+                args.benchmark_path = f"{benchmark_base}.{n}"
+            w.finalize()
+    finally:
+        args.benchmark_path = benchmark_base
+
+    # callback issues accumulated across ALL contracts: group by the code
+    # hash every issue carries (Issue.bytecode_hash; Issue.address is the
+    # instruction address, not the account).  Identical bytecode under two
+    # names shares its issues — the per-code issue cache (module/base.py:49)
+    # deduplicates detection, so both names must see the findings.
+    from mythril_tpu.support.support_utils import get_code_hash
+
+    by_hash: Dict[str, List] = {}
+    for issue in retrieve_callback_issues(modules):
+        by_hash.setdefault(issue.bytecode_hash, []).append(issue)
+    issues_by_name = {
+        name: by_hash.get(get_code_hash(code), [])
+        for (name, code) in jobs
+    }
+    total_states = sum(w.laser.total_states for w in wrappers)
+    return issues_by_name, total_states

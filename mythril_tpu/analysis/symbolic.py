@@ -59,6 +59,7 @@ class SymExecWrapper:
         custom_modules_directory: str = "",
         checkpoint_path: Optional[str] = None,
         resume_from: Optional[str] = None,
+        defer_exec: bool = False,
     ):
         if isinstance(address, str):
             address = int(address, 16)
@@ -157,6 +158,22 @@ class SymExecWrapper:
                 hook_dict=get_detection_module_hooks(analysis_modules, "post"),
             )
 
+        # deferred execution: the cooperative corpus driver owns the tx loop
+        # (analysis/cooperative.py) — set up the account, stash the world
+        # state, and skip both execution and statespace post-processing
+        self.deferred_world_state: Optional[WorldState] = None
+        if defer_exec:
+            if not isinstance(contract, (bytes, bytearray)):
+                raise ValueError("defer_exec supports raw runtime bytecode only")
+            from mythril_tpu.frontend.disassembler import Disassembly
+
+            acct = world_state.create_account(
+                balance=0, address=address, concrete_storage=False
+            )
+            acct.code = Disassembly(bytes(contract))
+            self.deferred_world_state = world_state
+            return
+
         # execute (creation vs runtime, reference symbolic.py:168-220)
         if self._resume_from:
             self._exec_resumed(address)
@@ -188,6 +205,21 @@ class SymExecWrapper:
         if not requires_statespace:
             return
 
+        self.nodes = self.laser.nodes
+        self.edges = self.laser.edges
+        self._parse_calls()
+
+    def finalize(self) -> None:
+        """Deferred-run epilogue: benchmark series + statespace post-
+        processing, exactly what the eager constructor path does after
+        execution.  Called by the cooperative driver once its tx loop ends."""
+        if self._benchmark_plugin is not None:
+            try:
+                self._benchmark_plugin.write_to_file(args.benchmark_path)
+            except OSError as e:
+                log.warning("could not write benchmark series: %s", e)
+        if not self.laser.requires_statespace:
+            return
         self.nodes = self.laser.nodes
         self.edges = self.laser.edges
         self._parse_calls()

@@ -78,10 +78,13 @@ def generate_function_constraints(
     return [Or(*options)]
 
 
-def execute_message_call(
+def seed_message_call(
     laser_evm, callee_address: int, func_hashes: Optional[List[int]] = None
 ) -> None:
-    """Spawn one symbolic message-call tx per open world state (reference :99-144)."""
+    """Seed the work list with one symbolic message-call tx per open world
+    state WITHOUT executing (reference :99-144 minus the exec call) — the
+    cooperative corpus driver seeds many lasers first, then runs all their
+    seeds as one multi-code frontier batch."""
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
 
@@ -101,6 +104,13 @@ def execute_message_call(
         )
         constraints = generate_function_constraints(calldata, func_hashes or [])
         _setup_global_state_for_execution(laser_evm, transaction, constraints)
+
+
+def execute_message_call(
+    laser_evm, callee_address: int, func_hashes: Optional[List[int]] = None
+) -> None:
+    """Spawn one symbolic message-call tx per open world state (reference :99-144)."""
+    seed_message_call(laser_evm, callee_address, func_hashes)
     laser_evm.exec()
 
 

@@ -189,14 +189,14 @@ class CodeTables:
             addr_cap *= 2
         return instr_cap, addr_cap, 512
 
-    def padded_device_tables(self):
+    def padded_device_tables(self, bucket: Optional[tuple] = None):
         """CodeDev-shaped numpy arrays padded to the size bucket; the pad
         region dispatches F_STOP (unreachable: pc never exceeds n).
 
         JUMPDESTs beyond the loops cap get loop_id -1 (no loop bound for
         them, rather than aliasing counters and killing loop-free paths);
         max_depth and the segment step cap still bound those paths."""
-        instr_cap, addr_cap, loops_cap = self.size_bucket()
+        instr_cap, addr_cap, loops_cap = bucket or self.size_bucket()
 
         def pad1(a, cap, fill):
             out = np.full(cap, fill, a.dtype)
@@ -214,3 +214,42 @@ class CodeTables:
             pad1(self.jumpmap, addr_cap, -1),
             pad1(loop_id, instr_cap, -1),
         )
+
+
+def multi_size_bucket(tables: List["CodeTables"]) -> tuple:
+    """(code_cap, instr_cap, addr_cap, loops_cap) covering every table.
+
+    The code axis buckets at 1/8/32/... so one compiled segment serves any
+    corpus batch of similar shape; instr/addr caps are the max over members
+    (each member's own bucket, so a corpus of small contracts stays small)."""
+    code_cap = 1
+    while code_cap < len(tables):
+        code_cap *= 8
+    instr_cap = addr_cap = loops_cap = 0
+    for t in tables:
+        ic, ac, lc = t.size_bucket()
+        instr_cap, addr_cap, loops_cap = (
+            max(instr_cap, ic), max(addr_cap, ac), max(loops_cap, lc)
+        )
+    return code_cap, instr_cap, addr_cap, loops_cap
+
+
+def stacked_device_tables(tables: List["CodeTables"], bucket: tuple):
+    """Stack per-code padded tables into the [C, ...] CodeDev arrays the
+    segment consumes — the dispatch tables become per-path inputs via one
+    [B] gather per table (multi-code frontier batching: paths from different
+    contracts share a single wide device segment).  Pad codes beyond
+    ``len(tables)`` dispatch F_STOP everywhere (unreachable: code_id is
+    always a real index)."""
+    code_cap, instr_cap, addr_cap, loops_cap = bucket
+    per_code = [t.padded_device_tables((instr_cap, addr_cap, loops_cap))
+                for t in tables]
+    fills = (O.F_STOP, 0, 0, 0, 0, True, -1, -1)
+    out = []
+    for col, fill in enumerate(fills):
+        first = per_code[0][col]
+        stack = np.full((code_cap,) + first.shape, fill, first.dtype)
+        for ci, cols in enumerate(per_code):
+            stack[ci] = cols[col]
+        out.append(stack)
+    return out

@@ -12,6 +12,13 @@ packed into the device batch, the jitted segment program executes up to
      paths onto ``laser.work_list`` for the host engine to continue,
   4. recycles freed slots for queued seeds / pending forks.
 
+Multi-code batching: the dispatch tables are stacked per code identity and
+every path carries a ``code_id``, so seeds from DIFFERENT contracts — a
+corpus sweep driven by ``drain_lasers``, or several codes on one work list —
+share a single wide segment.  The reference analyzes a corpus strictly
+sequentially (mythril/mythril/mythril_analyzer.py:138-175, one contract at a
+time); here the corpus IS the batch axis.
+
 Anything the device cannot run (CALL family, creation txs, symbolic memory
 addressing, cap overflows) degrades gracefully: the path is parked with its
 exact machine state and the ordinary host engine picks it up — the frontier
@@ -22,7 +29,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -46,6 +53,8 @@ from mythril_tpu.frontier.code import (
     CTX_STORAGE,
     CTX_TIMESTAMP,
     CodeTables,
+    multi_size_bucket,
+    stacked_device_tables,
 )
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
@@ -63,6 +72,37 @@ from mythril_tpu.support.support_args import args
 from mythril_tpu.support.time_handler import time_handler
 
 log = logging.getLogger(__name__)
+
+# codes a frontier run proved dynamically narrow (max live paths stayed under
+# caps.MIN_LIVE): later narrow drains skip the device for them a priori —
+# repeat tx rounds on a narrow contract must not re-pay the probe dispatches
+_NARROW_CODES: set = set()
+
+# static width hint: below this many JUMPIs across the seed codes a narrow
+# seed set cannot fan out wide enough to amortize segment dispatches
+_MIN_STATIC_JUMPIS = 8
+
+_jumpi_count_cache: Dict[object, int] = {}
+
+
+def _code_key(code):
+    bytecode = getattr(code, "bytecode", None)
+    return hash(bytecode) if bytecode else id(code)
+
+
+def _jumpi_count(code) -> int:
+    # keyed by bytecode hash (NOT id(code): a freed Disassembly's id can be
+    # recycled for a different contract), bounded against unbounded growth
+    key = _code_key(code)
+    got = _jumpi_count_cache.get(key)
+    if got is None:
+        got = sum(
+            1 for ins in code.instruction_list if ins.opcode == "JUMPI"
+        )
+        if len(_jumpi_count_cache) >= 4096:
+            _jumpi_count_cache.clear()
+        _jumpi_count_cache[key] = got
+    return got
 
 
 def _strategy_chain(laser):
@@ -124,6 +164,29 @@ def _eligible(gs) -> bool:
         return False
 
 
+def drain_lasers(lasers: List, caps: Optional[Caps] = None) -> int:
+    """Run eligible seeds from EVERY laser's work list as one multi-code
+    frontier batch (the cooperative corpus entry point).  Parked paths land
+    back on their own laser's work list.  Returns #instructions executed.
+
+    Lasers must share search configuration (max_depth / strategy family);
+    heterogeneous groups run as separate batches."""
+    groups: Dict[tuple, List[Tuple]] = {}
+    for laser in lasers:
+        if _is_concolic(laser):
+            continue
+        seeds = [s for s in laser.work_list if _eligible(s)]
+        if not seeds:
+            continue
+        key = (laser.max_depth, _sel_mode(laser))
+        groups.setdefault(key, []).extend((laser, s) for s in seeds)
+    executed = 0
+    for pairs in groups.values():
+        engine = FrontierEngine(pairs[0][0], caps)
+        executed += engine._drain_pairs(pairs)
+    return executed
+
+
 class FrontierEngine:
     def __init__(self, laser, caps: Optional[Caps] = None):
         self.laser = laser
@@ -140,31 +203,47 @@ class FrontierEngine:
         seeds = [s for s in laser.work_list if _eligible(s)]
         if not seeds:
             return 0
-        for s in seeds:
-            laser.work_list.remove(s)
+        return self._drain_pairs([(laser, s) for s in seeds])
 
-        # one code identity per run: extra code identities stay host-side
-        code0 = seeds[0].environment.code
-        same, rest = [], []
-        for s in seeds:
-            (same if s.environment.code is code0 else rest).append(s)
-        laser.work_list.extend(rest)
+    def _drain_pairs(self, pairs: List[Tuple]) -> int:
+        """Run (laser, seed) pairs as one batch; seeds are removed from
+        their work lists and never lost (parked back on failure)."""
+        if not self._device_worthwhile(pairs):
+            return 0
+        for laser, s in pairs:
+            laser.work_list.remove(s)
         try:
-            return self._run(same)
+            return self._run(pairs)
         except Exception:
-            # never lose a seed: hand everything back to the host engine.
+            # never lose a seed: hand everything back to the host engines.
             # Paths a partial frontier run already completed re-run on host;
             # the per-(address, bytecode) issue cache absorbs duplicates.
-            laser.work_list.extend(same)
+            for laser, s in pairs:
+                laser.work_list.append(s)
             raise
+
+    def _device_worthwhile(self, pairs: List[Tuple]) -> bool:
+        """A-priori narrow bail: segment dispatches only amortize over wide
+        frontiers, so a seed set that cannot fan out stays host-side.  Wide
+        seed sets always go; narrow ones need enough static branch points
+        (JUMPIs) and no prior narrow-bail verdict on their codes."""
+        if args.frontier_force:
+            return True
+        if len(pairs) >= self.caps.MIN_LIVE:
+            return True
+        codes = {id(s.environment.code): s.environment.code for _, s in pairs}
+        if all(_code_key(c) in _NARROW_CODES for c in codes.values()):
+            return False
+        return sum(_jumpi_count(c) for c in codes.values()) >= _MIN_STATIC_JUMPIS
 
     # ------------------------------------------------------------------
 
-    def _hooked_opcodes(self) -> set:
+    @staticmethod
+    def _hooked_opcodes(laser) -> set:
         # defaultdict access creates empty entries; only real hooks count
         return {
             op
-            for reg in (self.laser._pre_hooks, self.laser._post_hooks)
+            for reg in (laser._pre_hooks, laser._post_hooks)
             for op, funcs in reg.items()
             if op and funcs
         }
@@ -199,51 +278,81 @@ class FrontierEngine:
         return ctx
 
     def _inject(self, st: FrontierState, slot: int, seed_idx: int,
-                ctx: np.ndarray) -> None:
+                ctx: np.ndarray, code_idx: int) -> None:
         clear_slot(st, slot)
         st.seed[slot] = seed_idx
         st.halt[slot] = O.H_RUNNING
         st.ctx[slot] = ctx
+        st.code_id[slot] = code_idx
 
     # ------------------------------------------------------------------
 
-    def _run(self, seeds: List) -> int:
-        laser = self.laser
+    def _run(self, pairs: List[Tuple]) -> int:
         caps = self.caps
         t_start = time.time()
+
+        seed_lasers = [laser for laser, _ in pairs]
+        seeds = [gs for _, gs in pairs]
+        lasers: List = []
+        for laser in seed_lasers:
+            if laser not in lasers:
+                lasers.append(laser)
 
         arena = HostArena(caps.ARENA)
         arena.seeds = seeds
         row_zero = arena.const_row(0, 256)
         row_one = arena.const_row(1, 256)
 
-        code = seeds[0].environment.code
-        tables = CodeTables(
-            code.instruction_list,
-            arena,
-            hooked_opcodes=self._hooked_opcodes(),
-            code_size=len(getattr(code, "bytecode", b"") or b"") or None,
-        )
-        instr_cap, addr_cap, loops_cap = tables.size_bucket()
-        segment = cached_segment(caps, instr_cap, addr_cap, loops_cap)
+        # one stacked table entry per (laser, code) identity: hooks differ
+        # per laser, so the same bytecode under two lasers gets two entries
+        tables: List[CodeTables] = []
+        table_laser: List = []
+        table_code: List = []
+        table_idx: Dict[tuple, int] = {}
+        seed_code_idx: List[int] = []
+        for laser, gs in pairs:
+            code = gs.environment.code
+            key = (id(laser), id(code))
+            ci = table_idx.get(key)
+            if ci is None:
+                ci = len(tables)
+                table_idx[key] = ci
+                tables.append(
+                    CodeTables(
+                        code.instruction_list,
+                        arena,
+                        hooked_opcodes=self._hooked_opcodes(laser),
+                        code_size=len(getattr(code, "bytecode", b"") or b"")
+                        or None,
+                    )
+                )
+                table_laser.append(laser)
+                table_code.append(code)
+            seed_code_idx.append(ci)
+
+        bucket = multi_size_bucket(tables)
+        code_cap, instr_cap, addr_cap, loops_cap = bucket
+        segment = cached_segment(caps, *bucket)
         import jax
 
         # tables never change during the run: upload once, reuse per segment
         code_dev = CodeDev(
-            *[jax.device_put(a) for a in tables.padded_device_tables()]
+            *[jax.device_put(a) for a in stacked_device_tables(tables, bucket)]
         )
+        laser0 = lasers[0]
         cfg = CfgScalars(
-            max_depth=np.int32(laser.max_depth),
+            max_depth=np.int32(laser0.max_depth),
             loop_bound=np.int32(args.loop_bound or 0),
             row_zero=np.int32(row_zero),
             row_one=np.int32(row_one),
-            sel_mode=np.int32(_sel_mode(laser)),
+            sel_mode=np.int32(_sel_mode(laser0)),
         )
 
         # seed contexts (also fills the arena with env rows)
         ctxs = [self._seed_ctx(arena, gs, i) for i, gs in enumerate(seeds)]
 
-        walker = Walker(laser, arena, tables, seeds)
+        walker = Walker(seed_lasers, arena,
+                        [tables[ci] for ci in seed_code_idx], seeds)
         st = empty_state(caps, loops_cap)
         records: Dict[int, Optional[PathRecord]] = {i: None for i in range(caps.B)}
         seed_queue = list(range(len(seeds)))
@@ -254,27 +363,32 @@ class FrontierEngine:
             if not seed_queue:
                 break
             si = seed_queue.pop(0)
-            self._inject(st, slot, si, ctxs[si])
+            self._inject(st, slot, si, ctxs[si], seed_code_idx[si])
             records[slot] = PathRecord(seed_idx=si)
             ev_seen[slot] = 0
 
         # the arena stays device-resident across segments; the host pulls
         # only the newly appended row slices at each harvest
-        import jax
-
         dev_arena = ArenaDev(
             *[jax.device_put(a) for a in arena.device_arrays()]
         )
         arena_len = arena.length
-        visited = jax.device_put(np.zeros(instr_cap, bool))
+        visited = jax.device_put(np.zeros((code_cap, instr_cap), bool))
         executed = 0
-        deadline = t_start + (laser.execution_timeout or args.execution_timeout)
+        exec_timeout = min(
+            laser.execution_timeout or args.execution_timeout
+            for laser in lasers
+        )
+        deadline = t_start + exec_timeout
         narrow_harvests = 0
+        max_live = 0
 
+        width_verdict_valid = True  # False when the run was cut short
         while True:
             if time.time() > deadline or time_handler.time_remaining() <= 0:
                 log.info("frontier: execution timeout; parking live paths")
                 self._park_all(st, records, walker, reason="timeout")
+                width_verdict_valid = False
                 break
 
             stats = FrontierStatistics()
@@ -301,16 +415,18 @@ class FrontierEngine:
             for slot in range(caps.B):
                 if records[slot] is None and seed_queue:
                     si = seed_queue.pop(0)
-                    self._inject(st, slot, si, ctxs[si])
+                    self._inject(st, slot, si, ctxs[si], seed_code_idx[si])
                     records[slot] = PathRecord(seed_idx=si)
                     ev_seen[slot] = 0
 
             live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
+            max_live = max(max_live, live)
             if live == 0 and not seed_queue:
                 break
             if arena_len + max(live, 1) * caps.R * 2 >= caps.ARENA:
                 log.warning("frontier: arena nearly full; parking live paths")
                 self._park_all(st, records, walker, reason="arena-full")
+                width_verdict_valid = False
                 break
             # adaptive bail-out: the device pays off only on wide frontiers
             # (the per-segment dispatch amortizes over live paths); a run
@@ -328,15 +444,26 @@ class FrontierEngine:
             else:
                 narrow_harvests = 0
 
-        self._merge_coverage(np.asarray(visited), tables, code)
-        laser.total_states += executed
+        if max_live < caps.MIN_LIVE and width_verdict_valid:
+            # dynamically narrow (bailed or just completed narrow): later
+            # narrow drains on these codes skip the device entirely.  A run
+            # cut short by timeout/arena pressure proves nothing about width
+            # — marking there would disable the device for a wide contract
+            # process-wide.
+            for code in table_code:
+                _NARROW_CODES.add(_code_key(code))
+
+        visited_host = np.asarray(visited)
+        for ci, (laser, code) in enumerate(zip(table_laser, table_code)):
+            self._merge_coverage(visited_host[ci], tables[ci], code, laser)
         return executed
 
-    def _merge_coverage(self, visited: np.ndarray, tables, code) -> None:
+    @staticmethod
+    def _merge_coverage(visited: np.ndarray, tables, code, laser) -> None:
         """Device-executed instructions into the coverage plugin's bitmap
         (the walker only replays hook events, so plugin-side coverage alone
         would underreport frontier runs)."""
-        cov = getattr(self.laser, "coverage_plugin", None)
+        cov = getattr(laser, "coverage_plugin", None)
         bytecode = getattr(code, "bytecode", None)
         if cov is None or not bytecode:
             return
@@ -380,6 +507,18 @@ class FrontierEngine:
                         changed = True
                 ev_seen[slot] = n_ev
 
+        # 1b. per-laser total_states attribution from the device step
+        # counters (the host engine counts every state it steps; the device
+        # equivalent is instructions executed per path)
+        for slot in range(caps.B):
+            rec = records[slot]
+            if rec is None:
+                continue
+            delta = int(st.steps[slot]) - rec.steps_seen
+            if delta > 0:
+                rec.steps_seen = int(st.steps[slot])
+                walker.lasers[rec.seed_idx].total_states += delta
+
         # 2b. feasibility prune: the host engine drops unsat successors at
         # every fork (svm._prune_unsatisfiable); the frontier batches the
         # same check per segment over every still-running path whose
@@ -420,7 +559,7 @@ class FrontierEngine:
                 stats.record_bulk_park("batch-full")
             elif halt == O.H_PARK:
                 pc = int(rec.final["pc"])
-                names = walker.tables.opcode_names
+                names = walker.tables_for(rec).opcode_names
                 stats.record_park(names[pc] if pc < len(names) else "?")
             try:
                 walker.finish(rec)
@@ -468,7 +607,7 @@ class FrontierEngine:
         from mythril_tpu.plugins.plugins.mutation_pruner import MUTATOR_OPCODES
 
         mutators = frozenset(MUTATOR_OPCODES)
-        names = walker.tables.opcode_names
+        names = walker.tables_for(rec).opcode_names
         node, upto = rec, len(rec.events)
         while node is not None:
             for k in range(upto):

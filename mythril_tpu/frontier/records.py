@@ -27,6 +27,7 @@ class PathRecord:
         "carrier_pos",
         "children_by_event",
         "_pruned_at",
+        "steps_seen",
     )
 
     def __init__(self, seed_idx: int, parent: Optional["PathRecord"] = None,
@@ -41,6 +42,7 @@ class PathRecord:
         self.carrier_pos = 0  # events processed so far
         self.children_by_event: Dict[int, "PathRecord"] = {}
         self._pruned_at = 0  # constraint count last proven satisfiable
+        self.steps_seen = 0  # device step count already attributed
 
 
 def snapshot_slot(st, slot: int) -> dict:

@@ -48,6 +48,10 @@ class FrontierState(NamedTuple):
     pc: np.ndarray  # [B] i32 instruction index
     halt: np.ndarray  # [B] i32 ops.H_*; free slots marked by seed < 0
     seed: np.ndarray  # [B] i32 seed index, -1 = free slot
+    code_id: np.ndarray  # [B] i32 index into the stacked CodeDev tables —
+    # paths from DIFFERENT contracts share one segment (multi-code batching)
+    steps: np.ndarray  # [B] i32 instructions this path executed on device
+    # (per-laser total_states attribution; reset on fork-copy)
     stack: np.ndarray  # [B, STK] i32 arena rows
     stack_len: np.ndarray  # [B] i32
     mem_addr: np.ndarray  # [B, MEM] i32 byte address, -1 = empty
@@ -74,6 +78,8 @@ def empty_state(caps: Caps, n_loops: int) -> FrontierState:
         pc=np.zeros(B, np.int32),
         halt=np.full(B, O.H_STOP, np.int32),
         seed=np.full(B, -1, np.int32),
+        code_id=np.zeros(B, np.int32),
+        steps=np.zeros(B, np.int32),
         stack=np.full((B, caps.STK), -1, np.int32),
         stack_len=np.zeros(B, np.int32),
         mem_addr=np.full((B, caps.MEM), -1, np.int32),
@@ -99,6 +105,8 @@ def clear_slot(st: FrontierState, i: int) -> None:
     """Host-side: free slot ``i`` in the numpy mirror (after harvest)."""
     st.seed[i] = -1
     st.halt[i] = O.H_STOP
+    st.code_id[i] = 0
+    st.steps[i] = 0
     st.stack_len[i] = 0
     st.stack[i] = -1
     st.mem_len[i] = 0

@@ -81,16 +81,21 @@ def _memgas(size_bytes):
 class CodeDev(NamedTuple):
     """Per-instruction dispatch tables as DEVICE INPUTS (padded to a size
     bucket) so one compiled segment program serves every contract — compile
-    cost is paid once per (caps, bucket), not once per contract."""
+    cost is paid once per (caps, bucket), not once per contract.
 
-    fam: jnp.ndarray  # [N] i32, padded with F_STOP
-    aux: jnp.ndarray  # [N] i32
-    arity: jnp.ndarray  # [N] i32
-    gmin: jnp.ndarray  # [N] i32
-    gmax: jnp.ndarray  # [N] i32
-    event: jnp.ndarray  # [N] bool
-    jumpmap: jnp.ndarray  # [ADDR_CAP] i32
-    loopid: jnp.ndarray  # [N] i32 (clamped to the loops cap)
+    All tables carry a leading [C] code axis and every path indexes them by
+    its ``state.code_id`` — one [B] gather per table per step — so paths
+    from DIFFERENT contracts (a corpus sweep, inner-call frames) batch into
+    a single wide segment (multi-code frontier batching, SURVEY.md §7.3)."""
+
+    fam: jnp.ndarray  # [C, N] i32, padded with F_STOP
+    aux: jnp.ndarray  # [C, N] i32
+    arity: jnp.ndarray  # [C, N] i32
+    gmin: jnp.ndarray  # [C, N] i32
+    gmax: jnp.ndarray  # [C, N] i32
+    event: jnp.ndarray  # [C, N] bool
+    jumpmap: jnp.ndarray  # [C, ADDR_CAP] i32
+    loopid: jnp.ndarray  # [C, N] i32 (clamped to the loops cap)
 
 
 class CfgScalars(NamedTuple):
@@ -125,15 +130,17 @@ def build_segment(caps: Caps):
     def path_step(st: FrontierState, ids, arena: ArenaDev, code: CodeDev,
                   cfg: CfgScalars):
         """st: per-path slice (no leading B); ids: [R] reserved arena rows."""
-        fam_t, aux_t, arity_t = code.fam, code.aux, code.arity
-        gmin_t, gmax_t, event_t = code.gmin, code.gmax, code.event
-        jumpmap_t, loopid_t = code.jumpmap, code.loopid
+        # per-path code identity: every table read is a SCALAR (cid, idx)
+        # gather — [B] elements total under vmap.  Never materialize a
+        # per-path table row (code.fam[cid] would broadcast [B, N] per step,
+        # the same HBM hazard as closing over the arena in handlers).
+        cid = jnp.clip(st.code_id, 0, code.fam.shape[0] - 1)
         max_depth, loop_bound = cfg.max_depth, cfg.loop_bound
         row_zero, row_one = cfg.row_zero, cfg.row_one
-        pc = jnp.clip(st.pc, 0, code.fam.shape[0] - 1)
-        fam = fam_t[pc]
-        aux = aux_t[pc]
-        arity = arity_t[pc]
+        pc = jnp.clip(st.pc, 0, code.fam.shape[1] - 1)
+        fam = code.fam[cid, pc]
+        aux = code.aux[cid, pc]
+        arity = code.arity[cid, pc]
         running = (st.halt == O.H_RUNNING) & (st.seed >= 0)
 
         gas_pre = (st.gas_min, st.gas_max)
@@ -174,12 +181,12 @@ def build_segment(caps: Caps):
         ok_addr1, addr1 = conc_from(pop_c[1], pop_v[1])
 
         def valid_dest(addr):
-            a = jnp.clip(addr, 0, jumpmap_t.shape[0] - 1)
-            idx = jumpmap_t[a]
-            return (addr < jumpmap_t.shape[0]) & (idx >= 0), idx
+            a = jnp.clip(addr, 0, code.jumpmap.shape[1] - 1)
+            idx = code.jumpmap[cid, a]
+            return (addr < code.jumpmap.shape[1]) & (idx >= 0), idx
 
         valid0, jidx0 = valid_dest(addr0)
-        lid_pc = loopid_t[pc]
+        lid_pc = code.loopid[cid, pc]
 
         rows0 = NewRows(
             op=jnp.zeros(R, I32),
@@ -799,8 +806,12 @@ def build_segment(caps: Caps):
         # host; forking paths are charged in the batch phase)
         skip_gas = terminalish | pending
         st2 = st2._replace(
-            gas_min=jnp.where(skip_gas, st2.gas_min, st2.gas_min + gmin_t[pc]),
-            gas_max=jnp.where(skip_gas, st2.gas_max, st2.gas_max + gmax_t[pc]),
+            gas_min=jnp.where(
+                skip_gas, st2.gas_min, st2.gas_min + code.gmin[cid, pc]
+            ),
+            gas_max=jnp.where(
+                skip_gas, st2.gas_max, st2.gas_max + code.gmax[cid, pc]
+            ),
         )
         # depth cap (host strategy drops deeper states silently)
         st2 = st2._replace(
@@ -830,7 +841,7 @@ def build_segment(caps: Caps):
             jnp.where(terminal_halt, O.E_TERMINAL, O.E_HOOK),
         )
         emit = (
-            event_t[pc]
+            code.event[cid, pc]
             & ~pending
             & ~underflow
             & (st2.halt != O.H_PARK)
@@ -891,15 +902,15 @@ def build_segment(caps: Caps):
 
     def batch_step(carry):
         state, arena, arena_len, t, n_exec, visited, code, cfg = carry
-        gmin_t, gmax_t = code.gmin, code.gmax
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         n_live = running.sum().astype(I32)
         n_exec = n_exec + n_live
-        # coverage: mark every live path's pc (dropped index for idle slots)
-        pc_or_oob = jnp.where(
-            running, jnp.clip(state.pc, 0, visited.shape[0] - 1), visited.shape[0]
-        )
-        visited = visited.at[pc_or_oob].set(True, mode="drop")
+        state = state._replace(steps=state.steps + running.astype(I32))
+        # coverage: mark every live path's (code, pc) (idle slots drop)
+        cid_live = jnp.clip(state.code_id, 0, visited.shape[0] - 1)
+        cid_or_oob = jnp.where(running, cid_live, visited.shape[0])
+        pc_or_oob = jnp.clip(state.pc, 0, visited.shape[1] - 1)
+        visited = visited.at[cid_or_oob, pc_or_oob].set(True, mode="drop")
         # arena rows are reserved for LIVE paths only (prefix-sum block
         # assignment): a wide batch with few live paths must not burn B*R
         # rows per step — that exhausts the arena in ARENA/(B*R) steps.
@@ -940,8 +951,8 @@ def build_segment(caps: Caps):
         # strategies; only matters when forks outnumber free slots): rank
         # wanters by descending score — argsort is stable, so SEL_NONE
         # (score 0) degenerates to the legacy slot order
-        target_pc = jnp.clip(fork.target, 0, visited.shape[0] - 1)
-        uncovered = ~visited[target_pc]
+        target_pc = jnp.clip(fork.target, 0, visited.shape[1] - 1)
+        uncovered = ~visited[cid_live, target_pc]
         sel = cfg.sel_mode
         score = jnp.where(
             sel == SEL_DEEP, state.depth,
@@ -987,7 +998,9 @@ def build_segment(caps: Caps):
         # (parent = fall-through + Not(cond); child = taken + cond)
         touched = granted | forked_into
         jumpi_pc = jnp.clip(jnp.where(forked_into, state.pc[src], state.pc),
-                            0, code.fam.shape[0] - 1)
+                            0, code.fam.shape[1] - 1)
+        # child slots copied code_id from their parent via copy_field
+        cid2 = jnp.clip(state2.code_id, 0, code.fam.shape[0] - 1)
         branch_pc = jnp.where(forked_into, taken_pc, jumpi_pc + 1)
         branch_row = jnp.where(forked_into, cond_of_child, ncond_of_parent)
         cl = jnp.clip(state2.cons_len, 0, CON - 1)
@@ -995,10 +1008,14 @@ def build_segment(caps: Caps):
             pc=jnp.where(touched, branch_pc, state2.pc),
             depth=jnp.where(touched, state2.depth + 1, state2.depth),
             stack_len=jnp.where(touched, state2.stack_len - 2, state2.stack_len),
-            gas_min=jnp.where(touched, state2.gas_min + gmin_t[jumpi_pc],
-                              state2.gas_min),
-            gas_max=jnp.where(touched, state2.gas_max + gmax_t[jumpi_pc],
-                              state2.gas_max),
+            gas_min=jnp.where(
+                touched, state2.gas_min + code.gmin[cid2, jumpi_pc],
+                state2.gas_min,
+            ),
+            gas_max=jnp.where(
+                touched, state2.gas_max + code.gmax[cid2, jumpi_pc],
+                state2.gas_max,
+            ),
             cons=jnp.where(
                 touched[:, None],
                 state2.cons.at[jnp.arange(B), cl].set(branch_row),
@@ -1011,6 +1028,9 @@ def build_segment(caps: Caps):
                 state2.events,
             ),
             ev_len=jnp.where(forked_into, 0, state2.ev_len),
+            # fresh per-path step counter: the parent keeps its count, the
+            # child starts at zero (per-laser total_states attribution)
+            steps=jnp.where(forked_into, 0, state2.steps),
             halt=jnp.where(forked_into, O.H_RUNNING, state2.halt),
         )
 
@@ -1170,10 +1190,11 @@ def pull_arena_rows(dev_arena: ArenaDev, lo: int, hi: int):
 
 
 @lru_cache(maxsize=16)
-def cached_segment(caps: Caps, instr_cap: int, addr_cap: int, loops_cap: int):
+def cached_segment(caps: Caps, code_cap: int, instr_cap: int, addr_cap: int,
+                   loops_cap: int):
     """One compiled segment per (caps, size bucket) — shared by every
-    contract whose padded tables fit the bucket, and persisted across
-    processes by the XLA compilation cache."""
+    contract batch whose stacked tables fit the bucket, and persisted
+    across processes by the XLA compilation cache."""
     import mythril_tpu
 
     mythril_tpu.enable_persistent_compilation_cache()

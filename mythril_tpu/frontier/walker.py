@@ -58,10 +58,19 @@ def fork_branch_row(ev: np.ndarray, taken: bool) -> int:
 
 
 class Walker:
-    def __init__(self, laser, arena: HostArena, tables, seeds: List):
-        self.laser = laser
+    def __init__(self, lasers, arena: HostArena, tables, seeds: List):
+        """``lasers`` and ``tables`` are PER-SEED lists (parallel to
+        ``seeds``): a multi-code batch replays each path through the laser
+        and dispatch tables of the analysis that seeded it, so a corpus-wide
+        segment harvests into 17 independent analyses correctly.  A single
+        laser / CodeTables is accepted for the single-contract case."""
+        if not isinstance(lasers, (list, tuple)):
+            lasers = [lasers] * len(seeds)
+        if not isinstance(tables, (list, tuple)):
+            tables = [tables] * len(seeds)
+        self.lasers = list(lasers)
         self.arena = arena
-        self.tables = tables
+        self.tables = list(tables)
         self.seeds = seeds  # list of seed GlobalStates (one per tx spawn)
         # device gas counters start at 0 per path; issues must report
         # seed-relative totals (carrier copies don't carry custom attrs)
@@ -71,6 +80,12 @@ class Walker:
         # arena row -> wrapper bound at a hook site (annotation carrier)
         self.bound: Dict[int, object] = {}
         self._anno_memo: Dict[int, frozenset] = {}
+
+    def laser_for(self, rec: PathRecord):
+        return self.lasers[rec.seed_idx]
+
+    def tables_for(self, rec: PathRecord):
+        return self.tables[rec.seed_idx]
 
     # ------------------------------------------------------------------
     # decode with annotation closure
@@ -193,11 +208,12 @@ class Walker:
         carrier.mstate.pc = pc
         self._set_gas(carrier, rec.seed_idx, int(ev[O.EV_GMIN]), int(ev[O.EV_GMAX]))
 
+        laser = self.laser_for(rec)
         if kind in (O.E_HOOK, O.E_TERMINAL):
             self._set_stack_from_ops(carrier, ev)
-            new_states, op_code = self.laser.execute_state(carrier)
-            if self.laser.requires_statespace:
-                self.laser.manage_cfg(op_code, new_states)
+            new_states, op_code = laser.execute_state(carrier)
+            if laser.requires_statespace:
+                laser.manage_cfg(op_code, new_states)
             if not new_states:
                 rec.dead = True  # terminal, exceptional, or skipped
                 rec.carrier = None
@@ -213,8 +229,8 @@ class Walker:
             return
 
         if kind == O.E_FORK:
-            op_name = self.tables.opcode_names[pc] if pc < len(
-                self.tables.opcode_names) else "JUMPI"
+            names = self.tables_for(rec).opcode_names
+            op_name = names[pc] if pc < len(names) else "JUMPI"
             dest_row = int(ev[O.EV_OP0 + 0])
             word_row = int(ev[O.EV_OP0 + 1])
             if word_row >= 0:
@@ -227,7 +243,7 @@ class Walker:
             # JUMPI pre-hooks (detectors); a skip kills the whole subtree,
             # matching the host engine dropping the state pre-execution
             try:
-                for hook in self.laser._pre_hooks.get(op_name, []):
+                for hook in laser._pre_hooks.get(op_name, []):
                     hook(carrier)
             except PluginSkipState:
                 rec.dead = True
@@ -247,7 +263,7 @@ class Walker:
                     carrier.world_state.constraints.append(condition)
                 carrier.mstate.pc = int(ev[O.EV_RES])  # decided next pc
                 carrier.mstate.depth += 1
-                self._branch_node(carrier, condition)
+                self._branch_node(laser, carrier, condition)
                 return
             # granted fork: extra = child slot; child record was linked at
             # harvest via children_by_event
@@ -260,26 +276,27 @@ class Walker:
                 child_carrier.world_state.constraints.append(cond)
                 child_carrier.mstate.pc = int(ev[O.EV_OP0 + 4])
                 child_carrier.mstate.depth += 1
-                self._branch_node(child_carrier, cond)
+                self._branch_node(laser, child_carrier, cond)
                 child.carrier = child_carrier
             ncond = self.decode_wrapped(ncond_row)
             carrier.world_state.constraints.append(ncond)
             carrier.mstate.pc = pc + 1
             carrier.mstate.depth += 1
-            self._branch_node(carrier, ncond)
+            self._branch_node(laser, carrier, ncond)
             return
 
         log.warning("unknown event kind %d", kind)
 
-    def _branch_node(self, carrier, condition) -> None:
+    @staticmethod
+    def _branch_node(laser, carrier, condition) -> None:
         """CFG node transition for a JUMPI branch: function-entry naming and
         statespace bookkeeping (mirrors svm.manage_cfg for JUMPI,
         reference mythril/laser/ethereum/svm.py:506-532)."""
-        if not self.laser.requires_statespace:
+        if not laser.requires_statespace:
             return
         from mythril_tpu.core.cfg import JumpType
 
-        self.laser._new_node_state(carrier, JumpType.CONDITIONAL, condition)
+        laser._new_node_state(carrier, JumpType.CONDITIONAL, condition)
         if carrier.node is not None:
             carrier.node.states.append(carrier)
 
@@ -312,6 +329,6 @@ class Walker:
             self._set_gas(carrier, rec.seed_idx, snap["gas_min"], snap["gas_max"])
             carrier.mstate.depth = snap["depth"]
             carrier.mstate.memory_size = snap["mem_size"]
-            self.laser.work_list.append(carrier)
+            self.laser_for(rec).work_list.append(carrier)
             return
         log.warning("unhandled halt kind %d", halt)

@@ -953,11 +953,11 @@ def check_satisfiable_batch(
             still = []
             for i, conj, key in pending:
                 try:
-                    sat_here = (
-                        all(vals[c] for c in conj)
-                        if vals is not None
-                        else all(evaluate(conj, asg)[c] for c in conj)
-                    )
+                    if vals is None:
+                        per_set = evaluate(conj, asg)
+                        sat_here = all(per_set[c] for c in conj)
+                    else:
+                        sat_here = all(vals[c] for c in conj)
                 except Exception:
                     still.append((i, conj, key))
                     continue

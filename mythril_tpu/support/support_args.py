@@ -53,6 +53,10 @@ class Args:
     # batched device-resident frontier interpreter (SURVEY.md §7.1)
     frontier: bool = False  # run message-call txs on the device frontier
     frontier_width: int = 64  # batch width B (paths held on device)
+    # bypass the a-priori narrow gate (engine._device_worthwhile): used by
+    # differential tests so frontier=True really exercises the device even
+    # on deliberately tiny contracts
+    frontier_force: bool = False
 
 
 args = Args()

@@ -17,7 +17,7 @@ import pytest
 from mythril_tpu.frontier import ops as O
 from mythril_tpu.frontier import step as step_mod
 from mythril_tpu.frontier.arena import HostArena
-from mythril_tpu.frontier.code import CodeTables
+from mythril_tpu.frontier.code import CodeTables, stacked_device_tables
 from mythril_tpu.frontier.state import Caps, empty_state
 from mythril_tpu.frontier.step import ArenaDev, CfgScalars, CodeDev, cached_segment
 from mythril_tpu.smt import terms as T
@@ -45,8 +45,11 @@ def _run_one_step(sel_mode: int):
 
     tables = CodeTables(PROGRAM, arena)
     instr_cap, addr_cap, loops_cap = tables.size_bucket()
-    segment = cached_segment(CAPS, instr_cap, addr_cap, loops_cap)
-    code_dev = CodeDev(*[jax.device_put(a) for a in tables.padded_device_tables()])
+    segment = cached_segment(CAPS, 1, instr_cap, addr_cap, loops_cap)
+    code_dev = CodeDev(*[
+        jax.device_put(a)
+        for a in stacked_device_tables([tables], (1, instr_cap, addr_cap, loops_cap))
+    ])
     cfg = CfgScalars(
         max_depth=np.int32(128),
         loop_bound=np.int32(0),
@@ -67,7 +70,7 @@ def _run_one_step(sel_mode: int):
         st.depth[slot] = depth
 
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
-    visited = jax.device_put(np.zeros(instr_cap, bool))
+    visited = jax.device_put(np.zeros((1, instr_cap), bool))
     out_state, _arena, _alen, n_exec, _visited = segment(
         st, dev_arena, arena.length, visited, code_dev, cfg
     )
@@ -116,8 +119,11 @@ def test_coverage_mode_prefers_uncovered_target():
 
     tables = CodeTables(program, arena)
     instr_cap, addr_cap, loops_cap = tables.size_bucket()
-    segment = cached_segment(CAPS, instr_cap, addr_cap, loops_cap)
-    code_dev = CodeDev(*[jax.device_put(a) for a in tables.padded_device_tables()])
+    segment = cached_segment(CAPS, 1, instr_cap, addr_cap, loops_cap)
+    code_dev = CodeDev(*[
+        jax.device_put(a)
+        for a in stacked_device_tables([tables], (1, instr_cap, addr_cap, loops_cap))
+    ])
     cfg = CfgScalars(
         max_depth=np.int32(128),
         loop_bound=np.int32(0),
@@ -143,8 +149,8 @@ def test_coverage_mode_prefers_uncovered_target():
     st.halt[2] = O.H_RUNNING
     st.pc[2] = 1  # sits at STOP; occupies the slot this step
 
-    visited = np.zeros(instr_cap, bool)
-    visited[2] = True  # the covered JUMPDEST
+    visited = np.zeros((1, instr_cap), bool)
+    visited[0, 2] = True  # the covered JUMPDEST
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
     out_state, _arena, _alen, _n, _v = segment(
         st, dev_arena, arena.length, visited, code_dev, cfg

@@ -22,7 +22,11 @@ def analyze(code_hex: str, tx_count=1, modules=None, frontier=False):
         if hasattr(m, "cache"):
             m.cache.clear()
     old = global_args.frontier
+    old_force = global_args.frontier_force
     global_args.frontier = frontier
+    # differential fixtures are deliberately tiny: bypass the a-priori
+    # narrow gate so frontier=True really exercises the device
+    global_args.frontier_force = frontier
     try:
         sym = SymExecWrapper(
             bytes.fromhex(code_hex),
@@ -35,6 +39,7 @@ def analyze(code_hex: str, tx_count=1, modules=None, frontier=False):
         return fire_lasers(sym, white_list=modules)
     finally:
         global_args.frontier = old
+        global_args.frontier_force = old_force
 
 
 def issue_keys(issues):
@@ -118,11 +123,14 @@ def test_multi_tx_killbilly_exploit():
     import bench
 
     old = global_args.frontier
+    old_force = global_args.frontier_force
     global_args.frontier = True
+    global_args.frontier_force = True
     try:
         _sym, issues, _wall = bench.run_analysis("auto")
     finally:
         global_args.frontier = old
+        global_args.frontier_force = old_force
     bench.check_recall(issues)
 
 
