@@ -64,7 +64,7 @@ from mythril_tpu.frontier.step import (
     CfgScalars,
     CodeDev,
     cached_segment,
-    pull_state,
+    pull_harvest,
     push_state,
 )
 from mythril_tpu.frontier.walker import Walker
@@ -396,19 +396,26 @@ class FrontierEngine:
             out_state, dev_arena, out_len, n_exec, visited = segment(
                 push_state(st), dev_arena, arena_len, visited, code_dev, cfg
             )
-            # pull state to host mirrors (writable: harvest mutates slots);
-            # packed: one transfer instead of one round trip per field
-            st = pull_state(out_state)
-            arena_len_new = int(out_len)
+            # pull state to host mirrors (writable: harvest mutates slots):
+            # one packed meta transfer (scalars ride along) + one
+            # bucket-capped events pull
+            st, arena_len_new, n_exec_host = pull_harvest(
+                out_state, out_len, n_exec
+            )
             arena.pull_from_device(dev_arena, arena_len_new)
             arena_len = arena_len_new
-            executed += int(n_exec)
-            stats.device_instructions += int(n_exec)
+            executed += n_exec_host
+            stats.device_instructions += n_exec_host
             stats.segments += 1
             stats.segment_s += time.time() - t_seg
 
             t_har = time.time()
             self._harvest(st, records, walker, ev_seen)
+            # events were fully drained into the path records, and the next
+            # segment starts with EMPTY device buffers (push_state rebuilds
+            # them; events never cross the link upward) — restart the
+            # per-slot seen counters to match
+            ev_seen.fill(0)
             stats.harvest_s += time.time() - t_har
 
             # refill free slots with queued seeds

@@ -30,8 +30,10 @@ class Caps:
     MEM: int = 48  # word-granular memory entries
     STO: int = 32  # storage assoc entries (concrete-fold cache)
     CON: int = 96  # device-added path constraints
-    EVT: int = 192  # events per path per lifetime-on-device (solc code is
-    # MSTORE/JUMPI-dense and every one is an event; overflow parks the path)
+    EVT: int = 192  # events per path PER SEGMENT (buffers are drained at
+    # every harvest and rebuilt empty; solc code is MSTORE/JUMPI-dense and
+    # every one is an event; mid-instruction overflow parks the path, a
+    # fork-site overflow just pends until the next segment)
     R: int = 4  # arena rows reserved per path per step
     K: int = 128  # max steps per device segment
     ARENA: int = 1 << 17

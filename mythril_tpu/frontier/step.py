@@ -942,7 +942,8 @@ def build_segment(caps: Caps):
         # ---- fork grants ----
         # a grant REQUIRES room for the parent's E_FORK event: a granted
         # fork whose event is dropped orphans the child (no lineage record
-        # on the host).  Full-buffer parents park at the pristine JUMPI.
+        # on the host).  Full-buffer parents pend at the pristine JUMPI
+        # until the next segment's drained buffer.
         buf_ok = new_state.ev_len < EVT
         want = fork.want & buf_ok
         free = new_state.seed < 0
@@ -1035,14 +1036,15 @@ def build_segment(caps: Caps):
         )
 
         # a denied fork pends at the pristine JUMPI: the harvest re-runs it
-        # once slots have been freed (or spills it to the host engine); a
-        # full event buffer can never clear on device, so those park
+        # once slots have been freed (or spills it to the host engine).  A
+        # full event buffer also pends — the harvest drains buffers every
+        # segment, so the fork can be granted next segment with a fresh one
         denied = want & ~granted
         state2 = state2._replace(
             halt=jnp.where(
-                fork.want & ~buf_ok,
-                O.H_PARK,
-                jnp.where(denied, O.H_PENDING_FORK, state2.halt),
+                (fork.want & ~buf_ok) | denied,
+                O.H_PENDING_FORK,
+                state2.halt,
             )
         )
         emit_fork = granted
@@ -1109,50 +1111,109 @@ def build_segment(caps: Caps):
 
 ARENA_CHUNK = 8192  # rows per packed arena pull (22 i32 words per row)
 
+# events are by far the largest state field ([B, EVT, EV_W]: ~2.5 MB at
+# B=256, ~10 MB at B=1024) and the harvest drains them COMPLETELY every
+# segment, so they are excluded from both packed transfers: the upload
+# rebuilds empty buffers on device, and the download pulls only a
+# size-bucketed [B, cap, EV_W] slice covering max(ev_len)
+_EVENT_BUCKETS = (8, 32, 128)  # plus full EVT as the last resort
+
 
 @lru_cache(maxsize=16)
 def _state_packer(field_shapes: tuple):
+    """Packers for the state WITHOUT the events buffer (+2 trailing scalars
+    on the pull side: arena_len and n_exec ride the same transfer)."""
+    names = [n for n in FrontierState._fields if n != "events"]
     shapes = list(field_shapes)
     sizes = [int(np.prod(s)) for s in shapes]
     bounds = np.cumsum([0] + sizes)
+    total = int(bounds[-1])
+    ev_index = names.index("ev_len")
 
     @jax.jit
-    def pack(state: FrontierState):
-        return jnp.concatenate([f.reshape(-1) for f in state])
+    def pack_meta(state: FrontierState, arena_len, n_exec):
+        flat = [
+            f.reshape(-1)
+            for name, f in zip(state._fields, state)
+            if name != "events"
+        ]
+        flat.append(jnp.stack([
+            jnp.asarray(arena_len, jnp.int32), jnp.asarray(n_exec, jnp.int32)
+        ]))
+        return jnp.concatenate(flat)
 
-    def unpack_host(buf: np.ndarray) -> FrontierState:
-        return FrontierState(*(
-            buf[bounds[i]: bounds[i + 1]].reshape(shapes[i]).copy()
+    def unpack_host(buf: np.ndarray, events: np.ndarray):
+        fields = {
+            names[i]: buf[bounds[i]: bounds[i + 1]].reshape(shapes[i]).copy()
             for i in range(len(shapes))
-        ))
+        }
+        fields["events"] = events
+        state = FrontierState(**fields)
+        return state, int(buf[total]), int(buf[total + 1])
+
+    def ev_len_of(buf: np.ndarray) -> np.ndarray:
+        return buf[bounds[ev_index]: bounds[ev_index + 1]]
 
     @jax.jit
-    def unpack_dev(buf) -> FrontierState:
-        return FrontierState(*(
-            jax.lax.dynamic_slice_in_dim(buf, int(bounds[i]), sizes[i])
+    def unpack_dev(buf, events, ev_len) -> FrontierState:
+        fields = {
+            names[i]: jax.lax.dynamic_slice_in_dim(buf, int(bounds[i]), sizes[i])
             .reshape(shapes[i])
             for i in range(len(shapes))
-        ))
+        }
+        fields["events"] = events
+        fields["ev_len"] = ev_len
+        return FrontierState(**fields)
 
-    return pack, unpack_host, unpack_dev
+    return pack_meta, unpack_host, unpack_dev, ev_len_of
 
 
-def pull_state(state: FrontierState) -> FrontierState:
-    """One packed device->host transfer for the whole state pytree."""
+@partial(jax.jit, static_argnums=1)
+def _pack_events(state: FrontierState, cap: int):
+    return state.events[:, :cap, :].reshape(-1)
+
+
+def pull_harvest(state: FrontierState, arena_len, n_exec):
+    """Device->host harvest transfer: ONE packed pull of every non-event
+    field (+ the arena_len / n_exec scalars — no separate scalar round
+    trips), then one bucket-capped events pull sized by max(ev_len)."""
     assert all(f.dtype == np.int32 for f in state), (
         "packed state transfer assumes uniform int32 fields"
     )
-    pack, unpack_host, _ = _state_packer(tuple(f.shape for f in state))
-    return unpack_host(np.asarray(pack(state)))
+    shapes = tuple(
+        f.shape for name, f in zip(state._fields, state) if name != "events"
+    )
+    pack_meta, unpack_host, _d, ev_len_of = _state_packer(shapes)
+    buf = np.asarray(pack_meta(state, arena_len, n_exec))
+    max_ev = int(ev_len_of(buf).max()) if buf.size else 0
+    B, EVT, EVW = state.events.shape
+    cap = next((b for b in _EVENT_BUCKETS if b >= max_ev and b <= EVT), EVT)
+    events = np.full((B, EVT, EVW), -1, np.int32)
+    if max_ev > 0:
+        pulled = np.asarray(_pack_events(state, cap)).reshape(B, cap, EVW)
+        events[:, :cap, :] = pulled
+    return unpack_host(buf, events)
 
 
 def push_state(state: FrontierState):
-    """One packed host->device transfer: the numpy mirror crosses the link
-    as a single buffer and unpacks on device (the symmetric twin of
-    pull_state — per-field uploads pay one round trip each on a tunnel)."""
-    pack, _h, unpack_dev = _state_packer(tuple(f.shape for f in state))
-    buf = np.concatenate([np.asarray(f).reshape(-1) for f in state])
-    return unpack_dev(jax.device_put(buf))
+    """One packed host->device transfer of the non-event fields; the event
+    buffers are rebuilt EMPTY on device (the harvest drains them every
+    segment, so nothing needs to cross the link)."""
+    assert all(f.dtype == np.int32 for f in state), (
+        "packed state transfer assumes uniform int32 fields"
+    )
+    shapes = tuple(
+        f.shape for name, f in zip(state._fields, state) if name != "events"
+    )
+    _p, _h, unpack_dev, _e = _state_packer(shapes)
+    buf = np.concatenate([
+        np.asarray(f).reshape(-1)
+        for name, f in zip(state._fields, state)
+        if name != "events"
+    ])
+    events = jnp.full(state.events.shape, -1, jnp.int32)
+    ev_len = jnp.zeros(state.ev_len.shape, jnp.int32)
+    return unpack_dev(jax.device_put(buf), events, ev_len)
 
 
 @partial(jax.jit, static_argnums=2)

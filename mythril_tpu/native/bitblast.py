@@ -83,6 +83,21 @@ def _load():
             ctypes.c_double,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
         ]
+        lib.bb_open.restype = ctypes.c_void_p
+        lib.bb_open.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        lib.bb_solve_assume.restype = ctypes.c_int32
+        lib.bb_solve_assume.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+        lib.bb_close.restype = None
+        lib.bb_close.argtypes = [ctypes.c_void_p]
         _lib = lib
     except OSError as e:
         log.warning("native library failed to load: %s", e)
@@ -276,9 +291,13 @@ def _add_congruence(tape: _Tape, pairs: List[Tuple[List[int], int]]):
             tape.roots.append(tape.emit(OP_OR, 1, na, out_eq))
 
 
-def serialize(conjuncts: Sequence[Term]) -> _Tape:
+def serialize(
+    conjuncts: Sequence[Term], extra: Sequence[Term] = ()
+) -> _Tape:
+    """Serialize ``conjuncts`` as roots; ``extra`` terms (e.g. optimization
+    objectives) are included in the DAG walk without being asserted."""
     tape = _Tape()
-    for t in terms.topo_order(conjuncts):
+    for t in terms.topo_order(list(conjuncts) + list(extra)):
         node = _serialize_node(tape, t)
         if node is not None:
             tape.node_of[t.tid] = node
@@ -387,3 +406,122 @@ def solve(
     except Exception as e:  # reconstruction must never crash the solver
         log.debug("native model reconstruction failed: %s", e)
         return UNKNOWN, None
+
+
+# ---------------------------------------------------------------------------
+# Incremental session: bound refinement for Optimize
+# ---------------------------------------------------------------------------
+
+
+class OptimizeSession:
+    """Blast a conjunction ONCE and answer many objective-bound queries.
+
+    For each objective the tape gains a fresh bound vector ``M`` plus enable
+    booleans wired as ``en_le => obj <= M``, ``en_ge => M <= obj``,
+    ``en_eq => obj == M``; a query assumes one enable literal and M's bits.
+    The CDCL state (learned clauses, activity, phases) persists across
+    queries, so the Optimize binary search pays circuit construction once
+    instead of once per bound — the z3-incremental-optimize analogue the
+    reference gets from ``z3.Optimize`` (mythril/analysis/solver.py:216-256).
+
+    UNSAT answers are exact (abstractions only add behaviors, see module
+    docstring); SAT models must be validated by the caller exactly like
+    ``solve``'s.
+    """
+
+    def __init__(self, conjuncts: Sequence[Term], objectives: Sequence[Term]):
+        lib = _load()
+        if lib is None:
+            raise Unsupported("native library unavailable")
+        tape = serialize(conjuncts, extra=objectives)
+        self._conjuncts = list(conjuncts)
+        self._controls = []  # per objective: (m_node, width, {op: en_node})
+        for i, obj in enumerate(objectives):
+            w = obj.width
+            obj_node = tape.node_of[obj.tid]
+            m_var = terms.var(f"__optimize_bound_{i}", w)
+            m_node = tape.fresh(w, ("scalar", m_var))
+            ens = {}
+            for op_name, cmp_node in (
+                ("le", tape.emit(OP_ULE, 1, obj_node, m_node)),
+                ("ge", tape.emit(OP_ULE, 1, m_node, obj_node)),
+                ("eq", tape.emit(OP_EQ, 1, obj_node, m_node)),
+            ):
+                en_var = terms.var(f"__optimize_en_{op_name}_{i}", 1)
+                en_node = tape.fresh(1, ("scalar", en_var))
+                not_en = tape.emit(OP_NOT, 1, en_node)
+                tape.roots.append(tape.emit(OP_OR, 1, not_en, cmp_node))
+                ens[op_name] = en_node
+            self._controls.append((m_node, w, ens))
+        self._tape = tape
+        rec = np.asarray(tape.records, dtype=np.int32).reshape(-1)
+        consts = np.frombuffer(bytes(tape.consts) or b"\x00", dtype=np.uint8)
+        roots = np.asarray(tape.roots, dtype=np.int32)
+        self._model_size = sum(
+            (w + 7) // 8 for op, w, *_ in tape.records if op == OP_VAR
+        )
+        self._lib = lib
+        self._handle = lib.bb_open(
+            rec.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(tape.records),
+            consts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(consts),
+            roots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(roots),
+        )
+        if not self._handle:
+            raise Unsupported("session open failed")
+
+    def solve(
+        self, bounds: Sequence[Tuple[int, str, int]], timeout_s: float
+    ) -> Tuple[str, Optional[Assignment]]:
+        """Solve under objective bounds [(obj_index, 'le'|'ge'|'eq', value)].
+
+        Returns (status, assignment-or-None); SAT models are unvalidated
+        (caller validates with the exact evaluator, as for ``solve``)."""
+        if self._handle is None:
+            return UNKNOWN, None
+        assume: List[int] = []
+        for idx, op_name, value in bounds:
+            m_node, w, ens = self._controls[idx]
+            assume.append((ens[op_name] << 16) | 1)
+            for bit in range(w):
+                assume.append(
+                    (m_node << 16) | (bit << 1) | ((value >> bit) & 1)
+                )
+        arr = np.asarray(assume, dtype=np.int64)
+        model = np.zeros(max(1, self._model_size), dtype=np.uint8)
+        status = self._lib.bb_solve_assume(
+            self._handle,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(arr),
+            float(timeout_s),
+            model.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(model),
+        )
+        if status == 0:
+            return UNSAT, None
+        if status != 1:
+            return UNKNOWN, None
+        try:
+            return SAT, _rebuild_assignment(self._tape, model.tobytes())
+        except Exception as e:
+            log.debug("session model reconstruction failed: %s", e)
+            return UNKNOWN, None
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.bb_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC safety net
+        try:
+            self.close()
+        except Exception:
+            pass

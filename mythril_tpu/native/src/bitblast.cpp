@@ -111,8 +111,13 @@ class Solver {
     return ok_;
   }
 
-  // status: 1 sat, 0 unsat, -1 budget exceeded
-  int solve(double deadline_wall) {
+  // status: 1 sat, 0 unsat (w.r.t. assumptions when given), -1 budget
+  // exceeded.  Assumptions are decided first, MiniSat-style (each on its
+  // own level; already-true ones get a dummy level) — learned clauses are
+  // consequences of the CNF alone, so they persist soundly across calls
+  // with different assumption sets (the incremental Optimize session).
+  int solve(double deadline_wall, const std::vector<Lit>& assumptions = {}) {
+    backtrack(0);
     if (!ok_) return 0;
     if (propagate() != nullptr) return 0;
     int64_t conflicts = 0;
@@ -123,6 +128,13 @@ class Solver {
       if (confl != nullptr) {
         conflicts++;
         if (decision_level() == 0) return 0;
+        if (decision_level() <= (int)assumptions.size()) {
+          // conflict entirely under the assumption prefix: analyze() would
+          // need to flip an assumption — UNSAT under these assumptions.
+          // (Learned-clause quality is irrelevant here; just report.)
+          backtrack(0);
+          return 0;
+        }
         std::vector<Lit> learnt;
         int bt;
         analyze(confl, learnt, bt);
@@ -145,7 +157,22 @@ class Solver {
           backtrack(0);
         }
       } else {
-        Lit next = decide();
+        Lit next = -1;
+        while (decision_level() < (int)assumptions.size()) {
+          Lit a = assumptions[decision_level()];
+          Value v = value(a);
+          if (v == V_TRUE) {
+            trail_lim_.push_back((int)trail_.size());  // dummy level
+            continue;
+          }
+          if (v == V_FALSE) {
+            backtrack(0);
+            return 0;  // UNSAT under assumptions
+          }
+          next = a;
+          break;
+        }
+        if (next == -1) next = decide();
         if (next == -1) return 1;  // all assigned: SAT
         trail_lim_.push_back((int)trail_.size());
         enqueue(next, nullptr);
@@ -653,24 +680,27 @@ enum Op : int32_t {
 
 const int REC = 7;  // int32s per tape record
 
-}  // namespace
-
-extern "C" {
-
-// status: 1 sat (model filled), 0 unsat, -1 unknown (unsupported op /
-// budget / timeout).  model_out receives, for each VAR node in tape order,
-// ceil(width/8) bytes little-endian.
-int32_t bb_solve(const int32_t* tape, int64_t n_nodes, const uint8_t* consts,
-                 int64_t consts_len, const int32_t* roots, int64_t n_roots,
-                 double timeout_s, uint8_t* model_out, int64_t model_cap) {
-  (void)consts_len;
+// A blasted tape kept alive for incremental solving: the CNF (with all
+// learned clauses) persists across bb_solve_assume calls, so a sequence of
+// bound queries over the same formula — the Optimize refinement loop — pays
+// the circuit construction once instead of once per query.
+struct Blasted {
   Solver solver;
-  Circuit cir(solver);
-  std::vector<Circuit::BV> val(n_nodes);
-  struct timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  double deadline = ts.tv_sec + ts.tv_nsec * 1e-9 + timeout_s;
+  std::vector<Circuit::BV> val;
+  std::vector<int32_t> tape;  // copy (REC per node) for model packing
+  int64_t n_nodes = 0;
+  int status = 1;  // 1 usable, 0 globally unsat, -1 unsupported
+};
 
+// Fills b.val / b.solver from the tape; returns 1 ok, 0 unsat, -1 unsupported.
+static int blast(Blasted& b, const int32_t* tape, int64_t n_nodes,
+                 const uint8_t* consts, const int32_t* roots, int64_t n_roots) {
+  Solver& solver = b.solver;
+  Circuit cir(solver);
+  b.val.assign(n_nodes, {});
+  b.tape.assign(tape, tape + n_nodes * REC);
+  b.n_nodes = n_nodes;
+  std::vector<Circuit::BV>& val = b.val;
   for (int64_t i = 0; i < n_nodes; i++) {
     const int32_t* r = tape + i * REC;
     int32_t op = r[0], w = r[1], a0 = r[2], a1 = r[3], a2 = r[4], x0 = r[5],
@@ -800,34 +830,107 @@ int32_t bb_solve(const int32_t* tape, int64_t n_nodes, const uint8_t* consts,
   for (int64_t k = 0; k < n_roots; k++) {
     if (!solver.add_clause({val[roots[k]][0]})) return 0;
   }
+  return 1;
+}
 
-  int status = solver.solve(deadline);
-  if (status != 1) return status;
-
-  // pack VAR models in tape order
+// Pack VAR models in tape order; returns 1, or -1 if model_cap is short.
+static int pack_model(const Blasted& b, uint8_t* model_out, int64_t model_cap) {
   int64_t off = 0;
-  for (int64_t i = 0; i < n_nodes; i++) {
-    const int32_t* r = tape + i * REC;
+  for (int64_t i = 0; i < b.n_nodes; i++) {
+    const int32_t* r = b.tape.data() + i * REC;
     if (r[0] != OP_VAR) continue;
     int w = r[1];
     int nbytes = (w + 7) / 8;
     if (off + nbytes > model_cap) return -1;
-    for (int b = 0; b < nbytes; b++) model_out[off + b] = 0;
+    for (int k = 0; k < nbytes; k++) model_out[off + k] = 0;
     for (int bit = 0; bit < w; bit++) {
-      Lit l = val[i][bit];
+      Lit l = b.val[i][bit];
       bool bv;
       if (l == LIT_TRUE)
         bv = true;
       else if (l == LIT_FALSE)
         bv = false;
       else
-        bv = sign_of(l) ? !solver.model_value(var_of(l))
-                        : solver.model_value(var_of(l));
+        bv = sign_of(l) ? !b.solver.model_value(var_of(l))
+                        : b.solver.model_value(var_of(l));
       if (bv) model_out[off + bit / 8] |= (1 << (bit % 8));
     }
     off += nbytes;
   }
   return 1;
 }
+
+static double wall_deadline(double timeout_s) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9 + timeout_s;
+}
+
+}  // namespace
+
+extern "C" {
+
+// status: 1 sat (model filled), 0 unsat, -1 unknown (unsupported op /
+// budget / timeout).  model_out receives, for each VAR node in tape order,
+// ceil(width/8) bytes little-endian.
+int32_t bb_solve(const int32_t* tape, int64_t n_nodes, const uint8_t* consts,
+                 int64_t consts_len, const int32_t* roots, int64_t n_roots,
+                 double timeout_s, uint8_t* model_out, int64_t model_cap) {
+  (void)consts_len;
+  Blasted b;
+  int st = blast(b, tape, n_nodes, consts, roots, n_roots);
+  if (st != 1) return st;
+  int status = b.solver.solve(wall_deadline(timeout_s));
+  if (status != 1) return status;
+  return pack_model(b, model_out, model_cap);
+}
+
+// ---------------------------------------------------------------------------
+// Incremental session (Optimize bound refinement): blast once, then answer
+// many queries under assumptions.  Assumption encoding per int64 element:
+// (node_id << 16) | (bit_index << 1) | value — node must be an OP_VAR.
+// ---------------------------------------------------------------------------
+
+void* bb_open(const int32_t* tape, int64_t n_nodes, const uint8_t* consts,
+              int64_t consts_len, const int32_t* roots, int64_t n_roots) {
+  (void)consts_len;
+  Blasted* b = new Blasted();
+  b->status = blast(*b, tape, n_nodes, consts, roots, n_roots);
+  if (b->status == -1) {
+    delete b;
+    return nullptr;
+  }
+  return b;
+}
+
+int32_t bb_solve_assume(void* handle, const int64_t* assume, int64_t n_assume,
+                        double timeout_s, uint8_t* model_out,
+                        int64_t model_cap) {
+  Blasted* b = static_cast<Blasted*>(handle);
+  if (b == nullptr) return -1;
+  if (b->status == 0) return 0;  // globally unsat at blast time
+  std::vector<Lit> assumptions;
+  assumptions.reserve((size_t)n_assume);
+  for (int64_t k = 0; k < n_assume; k++) {
+    int64_t a = assume[k];
+    int64_t node = a >> 16;
+    int bit = (int)((a >> 1) & 0x7FFF);
+    bool value = (a & 1) != 0;
+    if (node < 0 || node >= b->n_nodes) return -1;
+    if (b->tape[node * REC] != OP_VAR) return -1;
+    if (bit >= (int)b->val[node].size()) return -1;
+    Lit l = b->val[node][bit];
+    if (l == LIT_TRUE || l == LIT_FALSE) {
+      if ((l == LIT_TRUE) != value) return 0;
+      continue;
+    }
+    assumptions.push_back(value ? l : neg(l));
+  }
+  int status = b->solver.solve(wall_deadline(timeout_s), assumptions);
+  if (status != 1) return status;
+  return pack_model(*b, model_out, model_cap);
+}
+
+void bb_close(void* handle) { delete static_cast<Blasted*>(handle); }
 
 }  // extern "C"

@@ -1414,8 +1414,17 @@ class Optimize(Solver):
     def maximize(self, expr) -> None:
         self._maximize.append(expr.raw if hasattr(expr, "raw") else expr)
 
-    def _refine(self, conj, obj, asg, deadline: float, want_min: bool):
-        """Tighten one objective to its proven optimum (or best effort)."""
+    def _refine(self, conj, obj, asg, deadline: float, want_min: bool,
+                session=None, obj_idx: int = 0, pins=()):
+        """Tighten one objective to its proven optimum (or best effort).
+
+        With an incremental CDCL ``session`` (native OptimizeSession), each
+        bound query is answered under assumptions against the ONCE-blasted
+        formula — learned clauses persist, so the whole binary search costs
+        about one solver call.  Session SAT models are validated exactly;
+        an invalid one (keccak abstraction) falls back to the probe stack
+        for that query.  ``pins`` are the bounds already fixed for earlier
+        objectives (lexicographic ordering)."""
         width = obj.width
         top = (1 << width) - 1
         def cfg_step() -> ProbeConfig:
@@ -1432,19 +1441,46 @@ class Optimize(Solver):
         def value(a) -> int:
             return evaluate([obj], a)[obj]
 
+        def bound_term(op: str, v: int):
+            c = terms.const(v, width)
+            if op == "le":
+                return terms.ule(obj, c)
+            if op == "ge":
+                return terms.uge(obj, c)
+            return terms.eq(obj, c)
+
+        def ask_op(op: str, v: int):
+            bt = bound_term(op, v)
+            if session is not None:
+                SolverStatistics().cdcl_calls += 1
+                budget = max(0.05, min(
+                    self.config.timeout_ms / 4000.0, deadline - time.time()
+                ))
+                st, a2 = session.solve(
+                    list(pins) + [(obj_idx, op, v)], budget
+                )
+                if st == UNSAT:
+                    return UNSAT, None
+                if st == SAT and a2 is not None:
+                    vals = evaluate(conj + [bt], a2)
+                    if all(vals[c] for c in conj) and vals[bt]:
+                        return SAT, a2
+                    # abstraction artifact: exact validation failed — the
+                    # probe stack owns this query (a true model may exist)
+            return solve_conjunction(conj + [bt], cfg_step())
+
         best = value(asg)
         # fast path: the global optimum in one query
         target = 0 if want_min else top
         if best != target and time.time() < deadline:
-            status, a2 = solve_conjunction(
-                conj + [terms.eq(obj, terms.const(target, width))], cfg_step()
-            )
+            status, a2 = ask_op("eq", target)
             if status == SAT and a2 is not None:
                 return a2, True
         steps = 0
-
-        def ask(bound):
-            return solve_conjunction(conj + [bound], cfg_step())
+        # value bisection over a w-bit range needs up to w steps to converge
+        # exactly; with an incremental session each step is ~a propagation,
+        # so the budget is the width (the probe path keeps the tight cap)
+        max_steps = (width + 16) if session is not None else self.MAX_BOUND_STEPS
 
         if want_min:
             lo, hi = 0, best
@@ -1453,10 +1489,10 @@ class Optimize(Solver):
             # halvings; doubling from the current model reaches the optimum's
             # magnitude in log2(opt) SAT steps and one UNSAT caps the range
             lo, hi = best, top
-            while lo < hi and steps < self.MAX_BOUND_STEPS and time.time() < deadline:
+            while lo < hi and steps < max_steps and time.time() < deadline:
                 steps += 1
                 probe_to = min(2 * best + 1, top)
-                status, a2 = ask(terms.uge(obj, terms.const(probe_to, width)))
+                status, a2 = ask_op("ge", probe_to)
                 if status == SAT and a2 is not None:
                     asg, best = a2, value(a2)
                     lo = best
@@ -1468,15 +1504,14 @@ class Optimize(Solver):
                 else:
                     return asg, False
         proven = best == target
-        while lo < hi and steps < self.MAX_BOUND_STEPS and time.time() < deadline:
+        while lo < hi and steps < max_steps and time.time() < deadline:
             steps += 1
             if want_min:
                 mid = lo + (hi - 1 - lo) // 2  # strictly below current best
-                bound = terms.ule(obj, terms.const(mid, width))
+                status, a2 = ask_op("le", mid)
             else:
                 mid = hi - (hi - lo - 1) // 2  # strictly above current best
-                bound = terms.uge(obj, terms.const(mid, width))
-            status, a2 = ask(bound)
+                status, a2 = ask_op("ge", mid)
             if status == SAT and a2 is not None:
                 asg, best = a2, value(a2)
                 if want_min:
@@ -1500,23 +1535,80 @@ class Optimize(Solver):
         # ONE timeout budget covers the initial solve AND all refinement
         # (support/model.py sizes it against the remaining execution time)
         deadline = time.time() + self.config.timeout_ms / 1000.0
-        status, asg = solve_conjunction(conj, self.config)
+        objectives = [(m, True) for m in self._minimize] + [
+            (m, False) for m in self._maximize
+        ]
+        # initial solve: cheap tiers (fold/memo/replay) first — only a query
+        # they cannot answer pays for blasting an incremental CDCL session,
+        # which then serves the initial solve AND every bound query of every
+        # objective against the once-blasted formula (pins carry earlier
+        # objectives' achieved bounds as assumptions); unsupported structure
+        # degrades to the per-query probe/CDCL stack
+        status, asg = UNKNOWN, None
+        resolved, folded_conj, cache_key = _fast_path(conj)
+        if resolved is not None:
+            status, asg = resolved
+            if status != SAT or asg is None:
+                # cheap-tier UNSAT: no session was ever built, nothing to pay
+                self._model = None
+                return status
+        session = None
+        if status != UNSAT and objectives:
+            try:
+                from mythril_tpu.native import bitblast
+
+                if bitblast.available():
+                    session = bitblast.OptimizeSession(
+                        conj, [obj for obj, _ in objectives]
+                    )
+            except Exception as e:
+                log.debug("optimize session unavailable: %s", e)
+                session = None
+        if status == UNKNOWN and session is not None:
+            SolverStatistics().cdcl_calls += 1
+            st, a = session.solve(
+                [], max(0.05, min(self.config.timeout_ms / 2000.0,
+                                  deadline - time.time()))
+            )
+            if st == UNSAT:
+                _model_cache.remember(cache_key, UNSAT, None)
+                status = UNSAT
+            elif st == SAT and a is not None:
+                vals = evaluate(folded_conj, a)
+                if all(vals[c] for c in folded_conj):
+                    _model_cache.remember(cache_key, SAT, a)
+                    status, asg = SAT, a
+        if status == UNKNOWN:
+            status, asg = solve_conjunction(conj, self.config)
         if status != SAT or asg is None:
             self._model = None
+            if session is not None:
+                session.close()
             return status
-        # lexicographic: each objective's achievement is pinned before the
-        # next — exactly (==) when proven optimal, as a bound (<=/>=) when
-        # refinement gave up, so later objectives can never regress it
-        for obj, want_min in [(m, True) for m in self._minimize] + [
-            (m, False) for m in self._maximize
-        ]:
-            asg, proven = self._refine(conj, obj, asg, deadline, want_min)
-            achieved = terms.const(evaluate([obj], asg)[obj], obj.width)
-            if proven:
-                conj = conj + [terms.eq(obj, achieved)]
-            elif want_min:
-                conj = conj + [terms.ule(obj, achieved)]
-            else:
-                conj = conj + [terms.uge(obj, achieved)]
+        pins: List = []
+        try:
+            # lexicographic: each objective's achievement is pinned before
+            # the next — exactly (==) when proven optimal, as a bound
+            # (<=/>=) when refinement gave up, so later objectives can
+            # never regress it
+            for i, (obj, want_min) in enumerate(objectives):
+                asg, proven = self._refine(
+                    conj, obj, asg, deadline, want_min,
+                    session=session, obj_idx=i, pins=pins,
+                )
+                achieved_val = evaluate([obj], asg)[obj]
+                achieved = terms.const(achieved_val, obj.width)
+                if proven:
+                    conj = conj + [terms.eq(obj, achieved)]
+                    pins.append((i, "eq", achieved_val))
+                elif want_min:
+                    conj = conj + [terms.ule(obj, achieved)]
+                    pins.append((i, "le", achieved_val))
+                else:
+                    conj = conj + [terms.uge(obj, achieved)]
+                    pins.append((i, "ge", achieved_val))
+        finally:
+            if session is not None:
+                session.close()
         self._model = Model(asg)
         return SAT
