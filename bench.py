@@ -88,20 +88,38 @@ def _analyze(contract, address, tx_count, modules=None, strategy="bfs",
     return sym, issues
 
 
-def _configure(production: bool, frontier: bool = False) -> None:
+def _configure(production: bool) -> None:
     """baseline = host probe + host engine.  production = latency-aware
-    hybrid probe; the batched device frontier additionally engages on the
-    workload built for it (``wide_frontier`` — the win scales with frontier
-    width, while narrow exploration is faster through the host engine)."""
+    hybrid probe + the batched device frontier ENABLED EVERYWHERE — the
+    engine's own width gating (a-priori narrow gate + adaptive narrow-bail,
+    frontier/engine.py) decides per run whether the device pays, so narrow
+    workloads run unchanged and wide ones go device-resident."""
     from mythril_tpu.support.support_args import args
 
     args.probe_backend = "auto" if production else "host"
-    args.frontier = production and frontier
+    args.frontier = production
+    args.frontier_force = False
 
 
 # ---------------------------------------------------------------------------
 # recall helpers
 # ---------------------------------------------------------------------------
+
+
+def _ttfe(issues, t0: float, swc: str = None) -> float:
+    """Time-to-first-exploit: wall seconds from analysis start to the first
+    (matching) issue's discovery (BASELINE.json's second metric).  Issue
+    discovery stamps are process-global (report.StartTime), so they are
+    rebased against this run's ``t0``."""
+    from mythril_tpu.analysis.report import StartTime
+
+    base = StartTime().global_start_time
+    stamps = [
+        i.discovery_time for i in issues if swc is None or i.swc_id == swc
+    ]
+    if not stamps:
+        return float("nan")
+    return max(0.0, base + min(stamps) - t0)
 
 
 def _selects(input_hex: str, selector: int) -> bool:
@@ -165,14 +183,15 @@ def wl_suicide(production: bool):
     t0 = time.time()
     sym, issues = _analyze(code, 0x0901D12E, 1, modules=["AccidentallyKillable"])
     assert any(i.swc_id == "106" for i in issues), "suicide recall lost"
-    return sym.laser.total_states, time.time() - t0
+    return sym.laser.total_states, time.time() - t0, _ttfe(issues, t0, "106")
 
 
 def wl_killbilly(production: bool):
     _configure(production)
+    t0 = time.time()
     sym, issues, wall = run_analysis("auto" if production else "host")
     check_recall(issues)
-    return sym.laser.total_states, wall
+    return sym.laser.total_states, wall, _ttfe(issues, t0, "106")
 
 
 def wl_overflow(production: bool):
@@ -180,20 +199,25 @@ def wl_overflow(production: bool):
     states, t0 = 0, time.time()
     found = set()
     ran = 0
+    ttfe = float("nan")
     for name in ("overflow.sol.o", "underflow.sol.o"):
         path = _corpus_dir() / name
         if not path.exists():
             continue
         ran += 1
         _clear_caches()
+        t_file = time.time()
         sym, issues = _analyze(
             _read_runtime(path), 0x0901D12E, 2, modules=["IntegerArithmetics"]
         )
         states += sym.laser.total_states
         found |= {i.swc_id for i in issues}
+        file_ttfe = _ttfe(issues, t_file, "101")
+        if file_ttfe == file_ttfe and not ttfe == ttfe:
+            ttfe = file_ttfe
     if ran:
         assert "101" in found, "integer overflow recall lost"
-    return states, time.time() - t0
+    return states, time.time() - t0, ttfe
 
 
 def _wide_contract(n_branches: int) -> bytes:
@@ -214,7 +238,7 @@ def wl_wide_frontier(production: bool):
     the whole state space executes as ONE device segment at width 1024."""
     from mythril_tpu.support.support_args import args
 
-    _configure(production, frontier=True)
+    _configure(production)
     old_width = args.frontier_width
     if production:
         args.frontier_width = 1024
@@ -223,7 +247,7 @@ def wl_wide_frontier(production: bool):
         # cooperates) — a one-time cost that would swamp this workload
         _clear_caches()
         _analyze(
-            _wide_contract(2), 0x0901D12E, 1,
+            _wide_contract(10), 0x0901D12E, 1,
             modules=["AccidentallyKillable"], timeout=300,
         )
     try:
@@ -236,7 +260,7 @@ def wl_wide_frontier(production: bool):
     finally:
         args.frontier_width = old_width
     assert any(i.swc_id == "106" for i in issues), "wide-frontier recall lost"
-    return sym.laser.total_states, time.time() - t0
+    return sym.laser.total_states, time.time() - t0, _ttfe(issues, t0, "106")
 
 
 # if (calldataload(0) == 5) storage[0] = 1 else storage[0] = 2
@@ -286,7 +310,7 @@ def wl_concolic(production: bool):
         word = int(results[0]["steps"][0]["input"][2:66].ljust(64, "0"), 16)
         assert word == 5, "flipped input does not take the other branch"
         flips += 1
-    return flips, time.time() - t0
+    return flips, time.time() - t0, float("nan")
 
 
 # known-vulnerable subset of the corpus: file -> SWC id that must be found
@@ -300,41 +324,100 @@ CORPUS_RECALL = {
 }
 
 
+_corpus_warmed = False
+
+
 def wl_corpus(production: bool):
+    """THE HEADLINE: the whole reference corpus.  Baseline analyzes one
+    contract at a time (the reference's corpus flow, mythril_analyzer.py:
+    138-175); production runs this shard's slice COOPERATIVELY — lockstep tx
+    rounds whose combined seeds execute as one wide multi-code device
+    segment (analysis/cooperative.py).  Recall is asserted over the UNION of
+    shard findings (single-host: everything; multi-host launches return
+    shard-local findings for the driver to union via assert_corpus_recall)."""
+    global _corpus_warmed
     _configure(production)
-    from mythril_tpu.parallel.corpus import run_corpus
+    from mythril_tpu.parallel.corpus import (
+        assert_corpus_recall,
+        run_corpus,
+        shard_corpus,
+        shard_identity,
+    )
 
     corpus = sorted(p for g in CORPUS_GLOBS for p in _corpus_dir().glob(g))
     assert corpus, "no corpus inputs found"
-    totals = {"states": 0}
-    found = {}
-    walls = {}
+    all_issues = []
 
-    def analyze_one(path):
-        _clear_caches()
-        t0 = time.time()
-        sym, issues = _analyze(
-            _read_runtime(Path(path)), 0x0901D12E, 2, timeout=60
-        )
-        walls[Path(path).name] = time.time() - t0
-        totals["states"] += sym.laser.total_states
-        found[Path(path).name] = {i.swc_id for i in issues}
-        return len(issues)
+    if production:
+        from mythril_tpu.analysis.cooperative import analyze_cooperative
+        from mythril_tpu.support.support_args import args as global_args
 
-    t0 = time.time()
-    run_corpus([str(p) for p in corpus], analyze_one)
-    wall = time.time() - t0
-    # recall asserted only over THIS SHARD's slice (multi-host sweeps split
-    # the corpus; other shards' contracts never appear in `found`)
-    tag = "production" if production else "baseline"
-    for name, swc in CORPUS_RECALL.items():
-        if name in found:
-            assert swc in found[name], (
-                f"corpus recall lost ({tag}): {name} found={found[name]} "
-                f"wall={walls.get(name, -1):.1f}s "
-                f"(all walls: { {k: round(v, 1) for k, v in walls.items()} })"
+        mine = shard_corpus([str(p) for p in corpus])
+        jobs = [(Path(p).name, _read_runtime(Path(p))) for p in mine]
+        old_width = global_args.frontier_width
+        global_args.frontier_width = 256
+        try:
+            if not _corpus_warmed:
+                # one-time segment-program compile for the corpus bucket,
+                # outside the timers (persistently cached by XLA)
+                _clear_caches()
+                analyze_cooperative(
+                    jobs, transaction_count=1, execution_timeout=15
+                )
+                _corpus_warmed = True
+            _clear_caches()
+            from mythril_tpu.frontier.stats import FrontierStatistics
+
+            dev_before = FrontierStatistics().device_instructions
+            t0 = time.time()
+            issues_by_name, states = analyze_cooperative(
+                jobs, transaction_count=2, execution_timeout=60
             )
-    return totals["states"], wall
+            wall = time.time() - t0
+            # residency measured around the TIMED run only (the one-time
+            # warm-up above also executes device instructions)
+            dev_delta = FrontierStatistics().device_instructions - dev_before
+        finally:
+            global_args.frontier_width = old_width
+        findings = [
+            (name, {i.swc_id for i in issues})
+            for name, issues in issues_by_name.items()
+        ]
+        all_issues = [i for iss in issues_by_name.values() for i in iss]
+    else:
+        totals = {"states": 0}
+        issue_lists = {}
+
+        def analyze_one(path):
+            _clear_caches()
+            sym, issues = _analyze(
+                _read_runtime(Path(path)), 0x0901D12E, 2, timeout=60
+            )
+            totals["states"] += sym.laser.total_states
+            issue_lists[Path(path).name] = issues
+            return {i.swc_id for i in issues}
+
+        t0 = time.time()
+        results = run_corpus([str(p) for p in corpus], analyze_one)
+        wall = time.time() - t0
+        states = totals["states"]
+        findings = [(Path(p).name, res) for p, res in results]
+        all_issues = [i for iss in issue_lists.values() for i in iss]
+
+    _idx, cnt = shard_identity()
+    shard_names = {name for name, _ in findings}
+    expected = (
+        CORPUS_RECALL
+        if cnt == 1
+        # multi-host: this process can only vouch for its own slice; the
+        # launcher unions the returned findings via assert_corpus_recall
+        else {k: v for k, v in CORPUS_RECALL.items() if k in shard_names}
+    )
+    assert_corpus_recall([findings], expected)
+    ttfe = _ttfe(
+        [i for i in all_issues if i.swc_id in set(CORPUS_RECALL.values())], t0
+    )
+    return states, wall, ttfe, (dev_delta if production else None)
 
 
 # (name, fn, unit, reps) — sub-minute workloads are dominated by scheduling
@@ -365,18 +448,49 @@ def main() -> None:
         except Exception:
             pass
 
+    from mythril_tpu.frontier.stats import FrontierStatistics
+
     table = {}
     for name, fn, unit, reps in WORKLOADS:
         samples = {"baseline": [], "production": []}
+        ttfes = {"baseline": [], "production": []}
+        residency = []
         for _rep in range(reps):
             for tag, production in (("baseline", False), ("production", True)):
-                work, wall = fn(production)
+                dev_before = FrontierStatistics().device_instructions
+                out = fn(production)
+                work, wall, ttfe = out[:3]
                 samples[tag].append(work / wall if wall > 0 else 0.0)
+                if ttfe == ttfe:  # not NaN
+                    ttfes[tag].append(ttfe)
+                # residency = device-executed instructions / states explored:
+                # meaningful only for state-counting workloads, and a
+                # workload that warms up internally supplies its own delta
+                if production and work and unit == "states/sec":
+                    dev = (
+                        out[3]
+                        if len(out) > 3 and out[3] is not None
+                        else FrontierStatistics().device_instructions - dev_before
+                    )
+                    residency.append(dev / work)
         rates = {tag: sorted(vals)[len(vals) // 2] for tag, vals in samples.items()}
+        med_ttfe = {
+            tag: (sorted(vals)[len(vals) // 2] if vals else None)
+            for tag, vals in ttfes.items()
+        }
+        dev_pct = (
+            round(100 * sorted(residency)[len(residency) // 2], 1)
+            if residency
+            else 0.0
+        )
         for tag in ("baseline", "production"):
+            t = med_ttfe[tag]
             print(
                 f"[bench] {name:16s} {tag:10s} {rates[tag]:10.1f} {unit}"
-                f"  (median of {reps})",
+                f"  (median of {reps}"
+                + (f", ttfe {t:.2f}s" if t is not None else "")
+                + (f", device {dev_pct}%" if tag == "production" else "")
+                + ")",
                 file=sys.stderr,
             )
         table[name] = {
@@ -386,6 +500,11 @@ def main() -> None:
             "speedup": round(rates["production"] / rates["baseline"], 3)
             if rates["baseline"]
             else None,
+            "ttfe_s": {
+                tag: (round(v, 3) if v is not None else None)
+                for tag, v in med_ttfe.items()
+            },
+            "device_residency_pct": dev_pct,
         }
 
     headline = table["corpus_sweep"]
@@ -395,9 +514,10 @@ def main() -> None:
                 "metric": "corpus_sweep_states_per_sec",
                 "value": headline["production"],
                 "unit": "states/sec over the reference contract corpus "
-                "(production: latency-aware hybrid probe; the batched device "
-                "frontier is measured by the wide_frontier workload; recall "
-                "asserted per workload)",
+                "(production: frontier enabled everywhere — the corpus runs "
+                "cooperatively as wide multi-code device segments, narrow "
+                "workloads auto-bail to host; recall asserted per workload, "
+                "ttfe_s = time-to-first-exploit)",
                 "vs_baseline": round(
                     headline["production"] / headline["baseline"], 3
                 )

@@ -129,6 +129,7 @@ def _sel_mode(laser) -> int:
     annotation importance, random) keep slot order; their ordering applies
     when parked/spilled paths re-enter the host work list."""
     from mythril_tpu.core.strategy.basic import (
+        BeamSearch,
         BreadthFirstSearchStrategy,
         DepthFirstSearchStrategy,
     )
@@ -138,11 +139,23 @@ def _sel_mode(laser) -> int:
     for strategy in _strategy_chain(laser):
         if isinstance(strategy, CoverageStrategy):
             return step_mod.SEL_COVERAGE
+        if isinstance(strategy, BeamSearch):
+            return step_mod.SEL_BEAM
         if isinstance(strategy, DepthFirstSearchStrategy):
             return step_mod.SEL_DEEP
         if isinstance(strategy, BreadthFirstSearchStrategy):
             return step_mod.SEL_SHALLOW
     return step_mod.SEL_NONE
+
+
+def _beam_importance(gs) -> int:
+    """The host beam score (strategy/basic.py BeamSearch.beam_priority):
+    annotations are SHARED across a seed's fork tree, so this is exact for
+    every device descendant of ``gs`` at segment time."""
+    try:
+        return int(sum(a.search_importance for a in gs._annotations))
+    except Exception:
+        return 0
 
 
 def _eligible(gs) -> bool:
@@ -278,12 +291,13 @@ class FrontierEngine:
         return ctx
 
     def _inject(self, st: FrontierState, slot: int, seed_idx: int,
-                ctx: np.ndarray, code_idx: int) -> None:
+                ctx: np.ndarray, code_idx: int, score: int = 0) -> None:
         clear_slot(st, slot)
         st.seed[slot] = seed_idx
         st.halt[slot] = O.H_RUNNING
         st.ctx[slot] = ctx
         st.code_id[slot] = code_idx
+        st.score[slot] = score
 
     # ------------------------------------------------------------------
 
@@ -358,12 +372,17 @@ class FrontierEngine:
         seed_queue = list(range(len(seeds)))
         ev_seen = np.zeros(caps.B, np.int64)
 
+        from mythril_tpu.frontier import step as step_mod
+
+        beam = _sel_mode(laser0) == step_mod.SEL_BEAM
+
         # initial fill
         for slot in range(caps.B):
             if not seed_queue:
                 break
             si = seed_queue.pop(0)
-            self._inject(st, slot, si, ctxs[si], seed_code_idx[si])
+            self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
+                         _beam_importance(seeds[si]) if beam else 0)
             records[slot] = PathRecord(seed_idx=si)
             ev_seen[slot] = 0
 
@@ -374,6 +393,58 @@ class FrontierEngine:
         )
         arena_len = arena.length
         visited = jax.device_put(np.zeros((code_cap, instr_cap), bool))
+
+        # SPMD over the mesh path axis (SURVEY.md §5.8): with >1 attached
+        # device the segment inputs are placed path-sharded (state) /
+        # replicated (arena, tables, coverage) and GSPMD partitions the SAME
+        # jitted program — the fork-grant prefix sum becomes the only
+        # cross-shard collective
+        mesh = None
+        n_dev = jax.device_count()
+        if args.frontier_mesh and n_dev > 1 and caps.B % n_dev == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from mythril_tpu.parallel.mesh import PATH_AXIS, make_frontier_mesh
+
+            mesh = make_frontier_mesh(path_size=n_dev)
+            FrontierStatistics().mesh_devices = n_dev
+            repl = NamedSharding(mesh, PartitionSpec())
+            # read-mostly inputs placed replicated ONCE; segment outputs keep
+            # their shardings, so no per-segment re-placement is needed
+            dev_arena = jax.tree.map(
+                lambda x: jax.device_put(x, repl), dev_arena
+            )
+            visited = jax.device_put(visited, repl)
+            code_dev = jax.tree.map(
+                lambda x: jax.device_put(x, repl), code_dev
+            )
+
+            def _path_sharding(x):
+                return NamedSharding(
+                    mesh, PartitionSpec(PATH_AXIS, *([None] * (x.ndim - 1)))
+                )
+
+            # event buffers start empty every segment: one constant sharded
+            # pair reused for the whole run (nothing crosses the link)
+            mesh_empty_events = jax.device_put(
+                np.full_like(st.events, -1), _path_sharding(st.events)
+            )
+            mesh_empty_ev_len = jax.device_put(
+                np.zeros_like(st.ev_len), _path_sharding(st.ev_len)
+            )
+
+            def push_sharded(state: FrontierState) -> FrontierState:
+                """Host mirror -> path-sharded device state: each field ships
+                straight from host numpy to its shards (no single-device
+                staging hop; local-device transfers are cheap, unlike the
+                tunnel case the packed push_state optimizes for)."""
+                fields = {
+                    name: jax.device_put(f, _path_sharding(f))
+                    for name, f in zip(state._fields, state)
+                    if name not in ("events", "ev_len")
+                }
+                fields["events"] = mesh_empty_events
+                fields["ev_len"] = mesh_empty_ev_len
+                return FrontierState(**fields)
         executed = 0
         exec_timeout = min(
             laser.execution_timeout or args.execution_timeout
@@ -393,8 +464,9 @@ class FrontierEngine:
 
             stats = FrontierStatistics()
             t_seg = time.time()
+            st_dev = push_sharded(st) if mesh is not None else push_state(st)
             out_state, dev_arena, out_len, n_exec, visited = segment(
-                push_state(st), dev_arena, arena_len, visited, code_dev, cfg
+                st_dev, dev_arena, arena_len, visited, code_dev, cfg
             )
             # pull state to host mirrors (writable: harvest mutates slots):
             # one packed meta transfer (scalars ride along) + one
@@ -418,13 +490,19 @@ class FrontierEngine:
             ev_seen.fill(0)
             stats.harvest_s += time.time() - t_har
 
-            # refill free slots with queued seeds
+            # refill free slots with queued seeds; under beam search
+            # also refresh live slots' scores (a seed's shared annotation
+            # may have gained importance from sibling replays)
             for slot in range(caps.B):
-                if records[slot] is None and seed_queue:
+                rec = records[slot]
+                if rec is None and seed_queue:
                     si = seed_queue.pop(0)
-                    self._inject(st, slot, si, ctxs[si], seed_code_idx[si])
+                    self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
+                                 _beam_importance(seeds[si]) if beam else 0)
                     records[slot] = PathRecord(seed_idx=si)
                     ev_seen[slot] = 0
+                elif beam and rec is not None:
+                    st.score[slot] = _beam_importance(seeds[rec.seed_idx])
 
             live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
             max_live = max(max_live, live)

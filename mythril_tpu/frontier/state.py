@@ -54,6 +54,10 @@ class FrontierState(NamedTuple):
     # paths from DIFFERENT contracts share one segment (multi-code batching)
     steps: np.ndarray  # [B] i32 instructions this path executed on device
     # (per-laser total_states attribution; reset on fork-copy)
+    score: np.ndarray  # [B] i32 beam importance (sum of the seed's
+    # annotation search_importance; inherited on fork — annotations are
+    # SHARED across forks, potential_issues.py __copy__): the SEL_BEAM
+    # fork-grant ranks by it under slot scarcity
     stack: np.ndarray  # [B, STK] i32 arena rows
     stack_len: np.ndarray  # [B] i32
     mem_addr: np.ndarray  # [B, MEM] i32 byte address, -1 = empty
@@ -82,6 +86,7 @@ def empty_state(caps: Caps, n_loops: int) -> FrontierState:
         seed=np.full(B, -1, np.int32),
         code_id=np.zeros(B, np.int32),
         steps=np.zeros(B, np.int32),
+        score=np.zeros(B, np.int32),
         stack=np.full((B, caps.STK), -1, np.int32),
         stack_len=np.zeros(B, np.int32),
         mem_addr=np.full((B, caps.MEM), -1, np.int32),
@@ -109,6 +114,7 @@ def clear_slot(st: FrontierState, i: int) -> None:
     st.halt[i] = O.H_STOP
     st.code_id[i] = 0
     st.steps[i] = 0
+    st.score[i] = 0
     st.stack_len[i] = 0
     st.stack[i] = -1
     st.mem_len[i] = 0

@@ -27,6 +27,7 @@ class FrontierStatistics(metaclass=Singleton):
         self.segments = 0  # device segment dispatches
         self.segment_s = 0.0  # wall time in segment dispatch + state pull
         self.harvest_s = 0.0  # wall time in host-side harvest
+        self.mesh_devices = 0  # >0: segments ran path-sharded over a mesh
 
     def record_park(self, opcode: str) -> None:
         self.parks_by_opcode[opcode] += 1
@@ -41,6 +42,7 @@ class FrontierStatistics(metaclass=Singleton):
             "device_instructions": self.device_instructions,
             "device_paths": self.device_paths,
             "segments": self.segments,
+            "mesh_devices": self.mesh_devices,
             "segment_s": round(self.segment_s, 3),
             "harvest_s": round(self.harvest_s, 3),
             "parks_by_opcode": dict(self.parks_by_opcode.most_common()),

@@ -116,6 +116,9 @@ SEL_NONE = 0  # slot order (no strategy preference)
 SEL_DEEP = 1  # deepest parents first (depth-first flavor)
 SEL_SHALLOW = 2  # shallowest parents first (breadth-first flavor)
 SEL_COVERAGE = 3  # forks targeting not-yet-visited code first
+SEL_BEAM = 4  # highest annotation search_importance first (beam search,
+# reference laser/ethereum/strategy/beam.py:7-31; the score column is the
+# batched beam_priority)
 
 
 def build_segment(caps: Caps):
@@ -962,7 +965,7 @@ def build_segment(caps: Caps):
                 jnp.where(
                     sel == SEL_COVERAGE,
                     uncovered.astype(I32) * (1 << 20) + state.depth,
-                    0,
+                    jnp.where(sel == SEL_BEAM, state.score, 0),
                 ),
             ),
         )

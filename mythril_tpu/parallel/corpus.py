@@ -102,3 +102,35 @@ def run_corpus(
             log.exception("corpus item %s failed", path)
             results.append((path, e))
     return results
+
+
+def assert_corpus_recall(
+    shard_results: Sequence[Sequence[Tuple[str, object]]],
+    expected: dict,
+) -> None:
+    """Aggregate recall across ALL shards' findings.
+
+    ``shard_results``: one ``[(path, swc-id set | Exception)]`` list per
+    shard (what each host's sweep returned).  Every contract in ``expected``
+    must appear in exactly the union — a shard that never reported (or
+    errored on) a contract carrying a known vulnerability fails the sweep
+    loudly instead of weakening recall silently on multi-host runs.
+    """
+    import os
+
+    found: dict = {}
+    for shard in shard_results:
+        for path, result in shard:
+            name = os.path.basename(str(path))
+            if isinstance(result, Exception):
+                continue  # absence is caught by the coverage check below
+            found.setdefault(name, set()).update(result)
+    missing = [
+        f"{name} (want SWC-{swc}, got {sorted(found.get(name, set()))})"
+        for name, swc in expected.items()
+        if swc not in found.get(name, set())
+    ]
+    if missing:
+        raise AssertionError(
+            "corpus recall lost across shards: " + "; ".join(missing)
+        )

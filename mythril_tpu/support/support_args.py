@@ -57,6 +57,10 @@ class Args:
     # differential tests so frontier=True really exercises the device even
     # on deliberately tiny contracts
     frontier_force: bool = False
+    # SPMD the frontier segment over all visible devices (path axis); the
+    # engine shards automatically when >1 device is attached and the batch
+    # width divides evenly
+    frontier_mesh: bool = True
 
 
 args = Args()

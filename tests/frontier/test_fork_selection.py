@@ -36,7 +36,7 @@ CAPS = Caps(B=4, K=1)
 DEPTHS = (5, 9, 1)  # slots 0..2; slot 3 free
 
 
-def _run_one_step(sel_mode: int):
+def _run_one_step(sel_mode: int, scores=(0, 0, 0)):
     arena = HostArena(CAPS.ARENA)
     row_zero = arena.const_row(0, 256)
     row_one = arena.const_row(1, 256)
@@ -68,6 +68,7 @@ def _run_one_step(sel_mode: int):
         st.stack[slot, 1] = dest_row
         st.stack_len[slot] = 2
         st.depth[slot] = depth
+        st.score[slot] = scores[slot]
 
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
     visited = jax.device_put(np.zeros((1, instr_cap), bool))
@@ -97,6 +98,18 @@ def test_scarce_fork_grant_follows_selection_mode(sel_mode, winner):
         else:
             # denied parents pend pristine for the next segment/harvest
             assert halt[slot] == O.H_PENDING_FORK
+
+
+def test_beam_mode_grants_highest_importance():
+    """SEL_BEAM ranks fork wanters by the state's beam score column (the
+    batched ``BeamSearch.beam_priority``, strategy/basic.py:86-87): the
+    parent carrying the most potential-issue importance wins the scarce
+    slot even when a rival is deeper."""
+    halt, seed = _run_one_step(step_mod.SEL_BEAM, scores=(3, 7, 50))
+    assert seed[3] == 0 and halt[3] == O.H_RUNNING
+    assert halt[2] == O.H_RUNNING  # score 50 granted
+    assert halt[0] == O.H_PENDING_FORK
+    assert halt[1] == O.H_PENDING_FORK
 
 
 def test_coverage_mode_prefers_uncovered_target():
