@@ -99,6 +99,11 @@ def _configure(production: bool) -> None:
     args.probe_backend = "auto" if production else "host"
     args.frontier = production
     args.frontier_force = False
+    if production:
+        # one production width across workloads (wide_frontier overrides to
+        # 1024): every device run shares the segment program _warm_frontier
+        # compiled, so no workload pays an XLA compile inside its timer
+        args.frontier_width = 256
 
 
 # ---------------------------------------------------------------------------
@@ -313,6 +318,26 @@ def wl_concolic(production: bool):
     return flips, time.time() - t0, float("nan")
 
 
+def wl_bectoken(production: bool):
+    """BECToken batchTransfer (CVE-2018-10299, BASELINE.md config 3's real
+    shape): a hand-assembled ERC20 with the unchecked ``cnt * _value``
+    multiply, SafeMath everywhere else, keccak-mapped balances and a
+    symbolic-length receiver loop (bench_contracts.py — no solc in the
+    image, matching /root/reference/solidity_examples/BECToken.sol:255-268).
+    Width comes from the dispatcher x requires x loop x 2-tx crossing."""
+    from bench_contracts import bectoken_like
+
+    _configure(production)  # production width 256 = the warmed bucket
+    _clear_caches()
+    t0 = time.time()
+    sym, issues = _analyze(
+        bectoken_like(), 0x0901D12E, 2,
+        modules=["IntegerArithmetics"], timeout=120,
+    )
+    assert any(i.swc_id == "101" for i in issues), "batchTransfer recall lost"
+    return sym.laser.total_states, time.time() - t0, _ttfe(issues, t0, "101")
+
+
 # known-vulnerable subset of the corpus: file -> SWC id that must be found
 CORPUS_RECALL = {
     "suicide.sol.o": "106",
@@ -428,9 +453,30 @@ WORKLOADS = [
     ("killbilly_3tx", wl_killbilly, "states/sec", 3),
     ("overflow_256bit", wl_overflow, "states/sec", 2),
     ("wide_frontier", wl_wide_frontier, "states/sec", 2),
+    ("bectoken_batch", wl_bectoken, "states/sec", 2),
     ("concolic_flip", wl_concolic, "flips/sec", 3),
     ("corpus_sweep", wl_corpus, "states/sec", 2),
 ]
+
+
+def _warm_frontier() -> None:
+    """Compile the segment programs for the production widths OUTSIDE every
+    workload timer (the XLA disk cache is invalidated by any program change,
+    so a fresh build pays each (caps, bucket) combination once here)."""
+    from mythril_tpu.support.support_args import args
+
+    _configure(True)
+    args.frontier_force = True
+    try:
+        for width in (256, 1024):
+            args.frontier_width = width
+            _clear_caches()
+            _analyze(
+                _wide_contract(4), 0x0901D12E, 1,
+                modules=["AccidentallyKillable"], timeout=300,
+            )
+    finally:
+        args.frontier_force = False
 
 
 def main() -> None:
@@ -450,6 +496,7 @@ def main() -> None:
 
     from mythril_tpu.frontier.stats import FrontierStatistics
 
+    _warm_frontier()
     table = {}
     for name, fn, unit, reps in WORKLOADS:
         samples = {"baseline": [], "production": []}

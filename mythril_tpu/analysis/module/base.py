@@ -31,6 +31,13 @@ class DetectionModule:
     entry_point = EntryPoint.CALLBACK
     pre_hooks: List[str] = []
     post_hooks: List[str] = []
+    # opcodes whose hook is provably a NO-OP when every popped operand is a
+    # concrete value: the device frontier evaluates that predicate per event
+    # (operand concreteness is a device-resident bit) and suppresses the
+    # event entirely — the batched probe-then-confirm form of the hook
+    # (SURVEY.md §7.2 item 7).  Declare ONLY when _execute provably returns
+    # without observable effect for all-concrete operands.
+    concrete_nop_hooks: frozenset = frozenset()
 
     def __init__(self):
         self.issues: List[Issue] = []

@@ -23,6 +23,10 @@ class ArbitraryJump(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMP", "JUMPI"]
+    # _analyze_state returns [] for a concrete jump destination; the device
+    # executes only concrete-dest JUMPs (symbolic dests park to the host),
+    # so device JUMP events exist purely for this hook and can be suppressed
+    concrete_nop_hooks = frozenset({"JUMP"})
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
         if self._cache_key(state) in self.cache:

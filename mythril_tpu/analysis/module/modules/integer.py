@@ -84,6 +84,10 @@ class IntegerArithmetics(DetectionModule):
         "CALL",
         "RETURN",
     ]
+    # _handle_add/mul/sub/exp return immediately when both operands are
+    # concrete (a.value/b.value not None) — the device suppresses those
+    # events (solc code is dominated by concrete pointer arithmetic)
+    concrete_nop_hooks = frozenset({"ADD", "MUL", "SUB", "EXP"})
 
     def _execute(self, state: GlobalState) -> None:
         opcode = state.get_current_instruction()["opcode"]

@@ -59,13 +59,21 @@ def concolic_execution(
     """Main entry (reference :67-85): returns new concrete inputs, one per
     flipped branch."""
     from mythril_tpu.support.support_args import args
+    from mythril_tpu.support.time_handler import time_handler
 
     old_timeout = args.solver_timeout
+    old_remaining = time_handler.time_remaining()
     args.solver_timeout = solver_timeout
+    # the time handler is process-global: without a fresh budget HERE, a
+    # deadline left expired by an earlier analysis in the same process makes
+    # the concrete replay execute zero instructions (empty trace, no flips)
+    time_handler.start_execution(1000)
     try:
         init_state, trace = concrete_execution(concrete_data)
         return flip_branches(init_state, concrete_data, jump_addresses, trace)
     finally:
-        # a leaked per-query budget silently reshapes every later analysis
-        # in the process (it feeds the engine's prune/confirm deadlines)
+        # leaked process-global budgets silently reshape every later
+        # analysis (solver_timeout feeds the engine's prune/confirm
+        # deadlines; the time handler feeds every exec loop)
         args.solver_timeout = old_timeout
+        time_handler.start_execution(max(0, old_remaining))

@@ -244,6 +244,9 @@ class LaserEVM:
             if create and self.create_timeout
             else start + self.execution_timeout
         )
+        frontier_live = args.frontier and not create and not track_gas
+        pending_seeds = 0  # fresh frames added since the last drain attempt
+        iteration = 0
         for global_state in self.strategy:
             if time.time() > deadline or time_handler.time_remaining() <= 0:
                 log.info("%s timeout reached; halting exec loop", "create" if create else "execution")
@@ -257,6 +260,25 @@ class LaserEVM:
             self.total_states += len(new_states)
             if track_gas and not new_states:
                 final_states.append(global_state)
+            # nested frontier segments (SURVEY.md §7.4 item 4): inner
+            # message-call frames pushed by the CALL-family handlers are
+            # fresh pc=0 seeds — periodically hand them to the device (the
+            # engine's own width gate decides whether a drain pays)
+            iteration += 1
+            pending_seeds += sum(
+                1 for s in new_states if s.mstate.pc == 0 and not s.mstate.stack
+            )
+            if frontier_live and pending_seeds and iteration % 8 == 0:
+                pending_seeds = 0
+                try:
+                    from mythril_tpu.frontier import FrontierEngine
+
+                    FrontierEngine(self).drain_work_list()
+                except Exception as e:  # graceful degradation
+                    log.warning(
+                        "nested frontier drain failed; host continues: %s", e,
+                        exc_info=True,
+                    )
         self._fire("stop_exec")
         return final_states if track_gas else None
 

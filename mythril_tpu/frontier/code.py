@@ -73,10 +73,15 @@ class CodeTables:
         arena: HostArena,
         hooked_opcodes: Optional[Iterable[str]] = None,
         code_size: Optional[int] = None,
+        conc_nop_opcodes: Optional[Iterable[str]] = None,
     ):
         from mythril_tpu.support.opcodes import OPCODES
 
         hooked: Set[str] = set(hooked_opcodes or ())
+        # hooked opcodes whose every hook is a declared no-op on all-concrete
+        # operands (module concrete_nop_hooks): evented, but the device
+        # suppresses the event when operand concreteness proves the no-op
+        conc_nop: Set[str] = set(conc_nop_opcodes or ()) - _ALWAYS_EVENT
         n = len(instruction_list)
         self.n = n
         self.instruction_list = instruction_list
@@ -86,6 +91,7 @@ class CodeTables:
         self.gmin = np.zeros(n + 1, np.int32)
         self.gmax = np.zeros(n + 1, np.int32)
         self.event = np.zeros(n + 1, bool)
+        self.concskip = np.zeros(n + 1, bool)
         self.addr = np.zeros(n + 1, np.int32)
         self.opcode_names: List[str] = []
 
@@ -103,6 +109,7 @@ class CodeTables:
                 _, arity, _, g0, g1 = info
                 self.arity[i], self.gmin[i], self.gmax[i] = arity, g0, g1
             self.event[i] = name in _ALWAYS_EVENT or name in hooked
+            self.concskip[i] = name in conc_nop
             fam, aux = self._classify(ins, arena, code_size)
             self.fam[i], self.aux[i] = fam, aux
             if name == "JUMPDEST":
@@ -213,6 +220,7 @@ class CodeTables:
             pad1(self.event, instr_cap, True),
             pad1(self.jumpmap, addr_cap, -1),
             pad1(loop_id, instr_cap, -1),
+            pad1(self.concskip, instr_cap, False),
         )
 
 
@@ -244,7 +252,7 @@ def stacked_device_tables(tables: List["CodeTables"], bucket: tuple):
     code_cap, instr_cap, addr_cap, loops_cap = bucket
     per_code = [t.padded_device_tables((instr_cap, addr_cap, loops_cap))
                 for t in tables]
-    fills = (O.F_STOP, 0, 0, 0, 0, True, -1, -1)
+    fills = (O.F_STOP, 0, 0, 0, 0, True, -1, -1, False)
     out = []
     for col, fill in enumerate(fills):
         first = per_code[0][col]

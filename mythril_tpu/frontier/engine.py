@@ -159,7 +159,11 @@ def _beam_importance(gs) -> int:
 
 
 def _eligible(gs) -> bool:
-    """Seed states the device can take: fresh outermost message-call frames."""
+    """Seed states the device can take: fresh message-call frames (pc 0,
+    empty stack).  This deliberately includes INNER call frames — the
+    nested-frontier drains in svm.exec rely on callee frames created by the
+    CALL-family handlers passing this predicate, and the walker resumes
+    their callers at E_TERMINAL replay (walker.py)."""
     from mythril_tpu.core.transaction.transaction_models import (
         MessageCallTransaction,
     )
@@ -252,14 +256,30 @@ class FrontierEngine:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _hooked_opcodes(laser) -> set:
+    def _hook_info(laser) -> Tuple[set, set]:
+        """(hooked opcodes, concrete-nop opcodes) for this laser.
+
+        An opcode is concrete-nop when EVERY hook on it (pre and post) is a
+        bound method of a module that declares it in ``concrete_nop_hooks``
+        — the device may then suppress its events for all-concrete operands
+        (the hook provably does nothing there)."""
         # defaultdict access creates empty entries; only real hooks count
-        return {
+        hooked = {
             op
             for reg in (laser._pre_hooks, laser._post_hooks)
             for op, funcs in reg.items()
             if op and funcs
         }
+        conc_nop = set()
+        for op in hooked:
+            if all(
+                op in getattr(getattr(hook, "__self__", None),
+                              "concrete_nop_hooks", ())
+                for reg in (laser._pre_hooks, laser._post_hooks)
+                for hook in reg.get(op, [])
+            ):
+                conc_nop.add(op)
+        return hooked, conc_nop
 
     def _seed_ctx(self, arena: HostArena, gs, seed_idx: int) -> np.ndarray:
         from mythril_tpu.smt import symbol_factory
@@ -331,13 +351,15 @@ class FrontierEngine:
             if ci is None:
                 ci = len(tables)
                 table_idx[key] = ci
+                hooked, conc_nop = self._hook_info(laser)
                 tables.append(
                     CodeTables(
                         code.instruction_list,
                         arena,
-                        hooked_opcodes=self._hooked_opcodes(laser),
+                        hooked_opcodes=hooked,
                         code_size=len(getattr(code, "bytecode", b"") or b"")
                         or None,
+                        conc_nop_opcodes=conc_nop,
                     )
                 )
                 table_laser.append(laser)
@@ -465,15 +487,16 @@ class FrontierEngine:
             stats = FrontierStatistics()
             t_seg = time.time()
             st_dev = push_sharded(st) if mesh is not None else push_state(st)
-            out_state, dev_arena, out_len, n_exec, visited = segment(
-                st_dev, dev_arena, arena_len, visited, code_dev, cfg
+            out_state, dev_arena, out_len, n_exec, seg_max_live, visited = (
+                segment(st_dev, dev_arena, arena_len, visited, code_dev, cfg)
             )
             # pull state to host mirrors (writable: harvest mutates slots):
             # one packed meta transfer (scalars ride along) + one
             # bucket-capped events pull
-            st, arena_len_new, n_exec_host = pull_harvest(
-                out_state, out_len, n_exec
+            st, arena_len_new, n_exec_host, seg_ml_host = pull_harvest(
+                out_state, out_len, n_exec, seg_max_live
             )
+            max_live = max(max_live, seg_ml_host)
             arena.pull_from_device(dev_arena, arena_len_new)
             arena_len = arena_len_new
             executed += n_exec_host

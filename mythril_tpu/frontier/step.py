@@ -96,6 +96,8 @@ class CodeDev(NamedTuple):
     event: jnp.ndarray  # [C, N] bool
     jumpmap: jnp.ndarray  # [C, ADDR_CAP] i32
     loopid: jnp.ndarray  # [C, N] i32 (clamped to the loops cap)
+    concskip: jnp.ndarray  # [C, N] bool — hooked-only event suppressible
+    # when every popped operand is concrete (module concrete_nop_hooks)
 
 
 class CfgScalars(NamedTuple):
@@ -843,8 +845,16 @@ def build_segment(caps: Caps):
             is_jumpi, O.E_FORK,
             jnp.where(terminal_halt, O.E_TERMINAL, O.E_HOOK),
         )
+        # device detector predicate: hooks declared no-op on all-concrete
+        # operands (IntegerArithmetics arithmetic, ArbitraryJump JUMP) emit
+        # no event when operand concreteness proves the no-op — the walker
+        # then never replays them (probe-then-confirm at event granularity)
+        all_conc = jnp.asarray(True)
+        for j in range(7):
+            all_conc = all_conc & ((arity <= j) | pop_c[j])
         emit = (
             code.event[cid, pc]
+            & ~(code.concskip[cid, pc] & all_conc)
             & ~pending
             & ~underflow
             & (st2.halt != O.H_PARK)
@@ -904,10 +914,14 @@ def build_segment(caps: Caps):
     B = caps.B
 
     def batch_step(carry):
-        state, arena, arena_len, t, n_exec, visited, code, cfg = carry
+        state, arena, arena_len, t, n_exec, max_live, visited, code, cfg = carry
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         n_live = running.sum().astype(I32)
         n_exec = n_exec + n_live
+        # width as seen DURING the segment: a whole exploration that runs
+        # wide and completes within one segment must not read as narrow at
+        # the (empty) harvest — the engine's narrow-memo uses this
+        max_live = jnp.maximum(max_live, n_live)
         state = state._replace(steps=state.steps + running.astype(I32))
         # coverage: mark every live path's (code, pc) (idle slots drop)
         cid_live = jnp.clip(state.code_id, 0, visited.shape[0] - 1)
@@ -1083,10 +1097,11 @@ def build_segment(caps: Caps):
             ),
         )
 
-        return (state2, arena, arena_len, t + 1, n_exec, visited, code, cfg)
+        return (state2, arena, arena_len, t + 1, n_exec, max_live, visited,
+                code, cfg)
 
     def cond(carry):
-        state, _, arena_len, t, _n, _v, _code, _cfg = carry
+        state, _, arena_len, t, _n, _m, _v, _code, _cfg = carry
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         room = arena_len + running.sum() * R < caps.ARENA
         return (t < caps.K) & running.any() & room
@@ -1095,10 +1110,11 @@ def build_segment(caps: Caps):
     def segment(state: FrontierState, arena: ArenaDev, arena_len,
                 visited, code: CodeDev, cfg: CfgScalars):
         carry = (state, arena, jnp.asarray(arena_len, I32),
-                 jnp.asarray(0, I32), jnp.asarray(0, I32), visited, code, cfg)
-        (state, arena, arena_len, t, n_exec, visited, _code,
+                 jnp.asarray(0, I32), jnp.asarray(0, I32),
+                 jnp.asarray(0, I32), visited, code, cfg)
+        (state, arena, arena_len, t, n_exec, max_live, visited, _code,
          _cfg) = jax.lax.while_loop(cond, batch_step, carry)
-        return state, arena, arena_len, n_exec, visited
+        return state, arena, arena_len, n_exec, max_live, visited
 
     return segment
 
@@ -1134,14 +1150,16 @@ def _state_packer(field_shapes: tuple):
     ev_index = names.index("ev_len")
 
     @jax.jit
-    def pack_meta(state: FrontierState, arena_len, n_exec):
+    def pack_meta(state: FrontierState, arena_len, n_exec, max_live):
         flat = [
             f.reshape(-1)
             for name, f in zip(state._fields, state)
             if name != "events"
         ]
         flat.append(jnp.stack([
-            jnp.asarray(arena_len, jnp.int32), jnp.asarray(n_exec, jnp.int32)
+            jnp.asarray(arena_len, jnp.int32),
+            jnp.asarray(n_exec, jnp.int32),
+            jnp.asarray(max_live, jnp.int32),
         ]))
         return jnp.concatenate(flat)
 
@@ -1152,7 +1170,7 @@ def _state_packer(field_shapes: tuple):
         }
         fields["events"] = events
         state = FrontierState(**fields)
-        return state, int(buf[total]), int(buf[total + 1])
+        return state, int(buf[total]), int(buf[total + 1]), int(buf[total + 2])
 
     def ev_len_of(buf: np.ndarray) -> np.ndarray:
         return buf[bounds[ev_index]: bounds[ev_index + 1]]
@@ -1176,10 +1194,10 @@ def _pack_events(state: FrontierState, cap: int):
     return state.events[:, :cap, :].reshape(-1)
 
 
-def pull_harvest(state: FrontierState, arena_len, n_exec):
+def pull_harvest(state: FrontierState, arena_len, n_exec, max_live):
     """Device->host harvest transfer: ONE packed pull of every non-event
-    field (+ the arena_len / n_exec scalars — no separate scalar round
-    trips), then one bucket-capped events pull sized by max(ev_len)."""
+    field (+ the arena_len / n_exec / max_live scalars — no separate scalar
+    round trips), then one bucket-capped events pull sized by max(ev_len)."""
     assert all(f.dtype == np.int32 for f in state), (
         "packed state transfer assumes uniform int32 fields"
     )
@@ -1187,7 +1205,7 @@ def pull_harvest(state: FrontierState, arena_len, n_exec):
         f.shape for name, f in zip(state._fields, state) if name != "events"
     )
     pack_meta, unpack_host, _d, ev_len_of = _state_packer(shapes)
-    buf = np.asarray(pack_meta(state, arena_len, n_exec))
+    buf = np.asarray(pack_meta(state, arena_len, n_exec, max_live))
     max_ev = int(ev_len_of(buf).max()) if buf.size else 0
     B, EVT, EVW = state.events.shape
     cap = next((b for b in _EVENT_BUCKETS if b >= max_ev and b <= EVT), EVT)

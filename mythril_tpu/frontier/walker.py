@@ -214,6 +214,15 @@ class Walker:
             new_states, op_code = laser.execute_state(carrier)
             if laser.requires_statespace:
                 laser.manage_cfg(op_code, new_states)
+            if kind == O.E_TERMINAL and new_states:
+                # an INNER transaction ended on device: the host terminal
+                # handler resumed the caller frame(s) (svm._end_message_call
+                # via the <op>_post resume) — they continue on the host work
+                # list.  (Outermost ends return [] after archiving the open
+                # world state.)
+                laser.work_list.extend(new_states)
+                rec.carrier = None
+                return
             if not new_states:
                 rec.dead = True  # terminal, exceptional, or skipped
                 rec.carrier = None

@@ -681,9 +681,47 @@ class CandidateGenerator:
             if target is not None and lo_v + bump <= hi_max:
                 self._dyn_write(target, lo_v + bump, asg)
                 continue
+            if (
+                hi.op == "mul"
+                and lo_v + bump <= hi_max
+                and self._raise_product(hi, lo_v + bump, asg)
+            ):
+                # product bound (overflow predicates: Not(BVMulNoOverflow)
+                # is ``2^w <= mul(zext a, zext b)``): raise one FACTOR so
+                # the product clears the bound — exact host arithmetic,
+                # where the bit-blasted 2w-bit multiply is hopeless
+                continue
             target = self._dyn_target(lo)
             if target is not None and hi_v >= bump:
                 self._dyn_write(target, hi_v - bump, asg)
+
+    def _raise_product(self, mul_term, target: int, asg: Assignment) -> bool:
+        """Drive ``mul(x, y) >= target`` by forcing one factor to
+        ceil(target / other) through the invertible-op write machinery.
+        The side is randomized across candidates so a factor pinned by
+        other constraints (a loop count with ``cnt <= 20``) gets the small
+        role in half the attempts.  Returns False when nothing was written
+        (caller falls back to lowering the other side of the pair)."""
+        factors = [
+            a.args[0] if a.op in ("zext", "sext") else a
+            for a in mul_term.args[:2]
+        ]
+        try:
+            vals = evaluate(factors, asg)
+        except NotImplementedError:
+            return False
+        x, y = factors
+        if self.rng.random() < 0.5:
+            x, y = y, x
+        base = vals[y]
+        if base == 0:
+            self._force_value(y, 1, asg)
+            base = 1
+        need = -(-target // base)  # ceil
+        if need.bit_length() > x.width:
+            return False
+        self._force_value(x, need, asg)
+        return True
 
     @staticmethod
     def _dyn_write(info, value: int, asg: Assignment) -> None:

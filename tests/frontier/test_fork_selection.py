@@ -72,7 +72,7 @@ def _run_one_step(sel_mode: int, scores=(0, 0, 0)):
 
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
     visited = jax.device_put(np.zeros((1, instr_cap), bool))
-    out_state, _arena, _alen, n_exec, _visited = segment(
+    out_state, _arena, _alen, n_exec, _ml, _visited = segment(
         st, dev_arena, arena.length, visited, code_dev, cfg
     )
     assert int(n_exec) == 3
@@ -165,7 +165,7 @@ def test_coverage_mode_prefers_uncovered_target():
     visited = np.zeros((1, instr_cap), bool)
     visited[0, 2] = True  # the covered JUMPDEST
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
-    out_state, _arena, _alen, _n, _v = segment(
+    out_state, _arena, _alen, _n, _ml, _v = segment(
         st, dev_arena, arena.length, visited, code_dev, cfg
     )
     halt = np.array(out_state.halt)

@@ -1,0 +1,106 @@
+"""Inner message-call frames as frontier seeds (SURVEY.md §7.4 item 4).
+
+A CALL parks the CALLER to the host (call setup is host-orchestrated), but
+the CALLEE's fresh frame is an eligible seed: with periodic re-drains inside
+the host loop, the callee body executes device-resident as its own
+multi-code batch member, its terminal replays through the host transaction
+end, and the resumed caller continues on the host work list — the
+"host-orchestrated nested segment" design (reference svm.py:386-445).
+"""
+
+import pytest
+
+from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.support.support_args import args as global_args
+
+
+def _self_call_contract() -> bytes:
+    """fn outer(): writes calldataload(4) to memory, CALLs self with it as
+    the inner calldata (selector inner()), SSTOREs the call's success flag;
+    fn inner(): forks on its argument word and SELFDESTRUCTs on one branch
+    — symbolic width INSIDE the callee frame."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))
+    from bench_contracts import Asm
+
+    a = Asm()
+    # dispatcher on first calldata byte (kept primitive on purpose)
+    a.push(0).op("CALLDATALOAD").push(0xF8).op("SHR")
+    a.op("DUP1").push(0x01).op("EQ").jumpi("outer")
+    a.op("DUP1").push(0x02).op("EQ").jumpi("inner")
+    a.revert()
+
+    a.label("outer")
+    # memory[0] = selector byte for inner (0x02 << 248); memory[1..33) = arg
+    a.push(0x02).push(248).op("SHL").push(0).op("MSTORE")
+    a.push(4).op("CALLDATALOAD").push(1).op("MSTORE")
+    # call(gas, address(this), 0, 0, 33, 64, 32)
+    a.push(32).push(64).push(33).push(0).push(0)
+    a.op("ADDRESS")
+    a.push(50000)
+    a.op("CALL")
+    a.push(0).op("SSTORE")
+    a.op("STOP")
+
+    a.label("inner")
+    # fork on the argument word: JUMPI chain over two bits, then the
+    # vulnerable branch selfdestructs (detectable through the inner frame)
+    a.push(1).op("CALLDATALOAD")
+    a.op("DUP1").push(1).op("AND").jumpi("inner_kill")
+    a.op("POP")
+    a.push(1).push(0).op("MSTORE").push(32).push(0).op("RETURN")
+    a.label("inner_kill")
+    a.op("POP", "CALLER")
+    a.op("SELFDESTRUCT")
+
+    # ADDRESS opcode is not in the minimal Asm table: patch it in
+    return a.assemble()
+
+
+
+def _analyze(code: bytes, frontier: bool):
+    reset_callback_modules()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        if hasattr(m, "cache"):
+            m.cache.clear()
+    old = (global_args.frontier, global_args.frontier_force)
+    global_args.frontier = frontier
+    global_args.frontier_force = frontier
+    try:
+        sym = SymExecWrapper(
+            code,
+            address=0x0901D12E,
+            strategy="bfs",
+            transaction_count=1,
+            execution_timeout=60,
+            modules=["AccidentallyKillable"],
+        )
+        return fire_lasers(sym, white_list=["AccidentallyKillable"])
+    finally:
+        global_args.frontier, global_args.frontier_force = old
+
+
+def keys(issues):
+    return sorted((i.swc_id, i.address, i.function) for i in issues)
+
+
+def test_inner_call_frame_runs_on_device_with_host_parity():
+    code = _self_call_contract()
+    host = _analyze(code, frontier=False)
+    FrontierStatistics().reset()
+    dev = _analyze(code, frontier=True)
+    stats = FrontierStatistics().as_dict()
+    assert keys(host) == keys(dev), (
+        f"inner-call issues diverged: host={keys(host)} dev={keys(dev)}"
+    )
+    # the selfdestruct lives INSIDE the callee frame: finding it via the
+    # frontier requires the inner frame to have executed (device or host
+    # spill) and its terminal to resume the caller correctly
+    assert any(i.swc_id == "106" for i in dev), "inner selfdestruct lost"
+    assert stats["device_instructions"] > 0, "frontier never engaged"

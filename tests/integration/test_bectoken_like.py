@@ -1,0 +1,68 @@
+"""The BECToken batchTransfer workload contract (bench_contracts.py).
+
+Checks the hand-assembled runtime reproduces the CVE-2018-10299 semantics:
+the unchecked ``cnt * _value`` multiply is flagged (SWC-101) while the
+SafeMath-checked moves are not, and the frontier run matches the host run.
+"""
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))
+from bench_contracts import (  # noqa: E402
+    SEL_BATCH_TRANSFER,
+    SEL_TRANSFER,
+    bectoken_like,
+)
+from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.support.support_args import args as global_args
+
+
+def _analyze(frontier: bool):
+    reset_callback_modules()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        if hasattr(m, "cache"):
+            m.cache.clear()
+    old = (global_args.frontier, global_args.frontier_force)
+    global_args.frontier = frontier
+    global_args.frontier_force = frontier
+    try:
+        sym = SymExecWrapper(
+            bectoken_like(),
+            address=0x0901D12E,
+            strategy="bfs",
+            transaction_count=2,
+            execution_timeout=120,
+            modules=["IntegerArithmetics"],
+        )
+        return fire_lasers(sym, white_list=["IntegerArithmetics"])
+    finally:
+        global_args.frontier, global_args.frontier_force = old
+
+
+def _dispatches(issue, sel: int) -> bool:
+    steps = (issue.transaction_sequence or {}).get("steps", [])
+    if not steps:
+        return False
+    data = steps[-1]["input"][2:]
+    return data[:8].lower() == f"{sel:08x}"
+
+
+@pytest.mark.parametrize("frontier", [False, True])
+def test_batch_transfer_overflow_found(frontier):
+    issues = _analyze(frontier)
+    overflow = [i for i in issues if i.swc_id == "101"]
+    assert overflow, "batchTransfer cnt*value overflow not found"
+    # the exploit transaction must dispatch to batchTransfer — the checked
+    # SafeMath paths (transfer) must not be flagged
+    assert any(_dispatches(i, SEL_BATCH_TRANSFER) for i in overflow), (
+        "SWC-101 not attributed to batchTransfer"
+    )
+    assert not any(_dispatches(i, SEL_TRANSFER) for i in overflow), (
+        "SafeMath-checked transfer() wrongly flagged"
+    )

@@ -115,7 +115,7 @@ def test_graft_entry_single_chip_and_dryrun():
     import __graft_entry__ as graft
 
     fn, args = graft.entry()
-    out_state, _arena, out_len, n_exec, _visited = jax.jit(fn)(*args)
+    out_state, _arena, out_len, n_exec, _max_live, _visited = jax.jit(fn)(*args)
     # the frontier segment ran the 4 seeded paths to completion, forking
     # each symbolic JUMPI into the free half of the batch
     assert int(n_exec) > 0
@@ -155,7 +155,7 @@ def test_frontier_segment_shards_over_path_axis():
             st, dev_arena, visited, code_dev = shard_frontier_inputs(
                 st, dev_arena, visited, code_dev, mesh
             )
-        out_state, _arena, out_len, n_exec, _vis = segment(
+        out_state, _arena, out_len, n_exec, _ml, _vis = segment(
             st, dev_arena, arena_len, visited, code_dev, cfg
         )
         return jax.tree.map(np.asarray, out_state), int(out_len), int(n_exec)
