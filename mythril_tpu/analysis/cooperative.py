@@ -88,6 +88,17 @@ def analyze_cooperative(
         w.laser.executed_transactions = True
 
     use_frontier = bool(args.frontier)
+    # pin ONE segment-program bucket for the whole sweep: later rounds see
+    # fewer live codes, and a shrunken bucket would trigger a fresh XLA
+    # compile mid-run (measured at ~17s on the tunneled chip)
+    bucket_floor = None
+    if use_frontier:
+        from mythril_tpu.frontier.code import bucket_hint
+
+        bucket_floor = bucket_hint([
+            w.deferred_world_state[addr].code.instruction_list
+            for w, addr in zip(wrappers, addresses)
+        ])
     for round_idx in range(transaction_count):
         live = []
         for w, addr in zip(wrappers, addresses):
@@ -118,7 +129,10 @@ def analyze_cooperative(
         if use_frontier:
             # the whole corpus round as one wide multi-code segment batch
             try:
-                drain_lasers([w.laser for w in live], caps=caps)
+                drain_lasers(
+                    [w.laser for w in live], caps=caps,
+                    bucket_floor=bucket_floor,
+                )
             except Exception as e:  # graceful degradation, never lose a run
                 log.warning(
                     "cooperative frontier failed; host engines continue: %s",

@@ -72,10 +72,48 @@ def get_potential_issues_annotation(global_state: GlobalState) -> PotentialIssue
 
 def check_potential_issues(global_state: GlobalState) -> None:
     """Called by the engine at outermost transaction end (svm counterpart of
-    reference svm.py:423)."""
+    reference svm.py:423).
+
+    The sat/unsat GATE over all parked issues runs as ONE batched sweep
+    first (the sets share the whole path prefix — union model replay and
+    merged dispatch resolve most), so the per-issue exploit synthesis
+    (model + input minimization) is paid only for the satisfiable ones."""
     annotation = get_potential_issues_annotation(global_state)
     unsolved: List[PotentialIssue] = []
-    for potential_issue in annotation.potential_issues:
+    gate = [True] * len(annotation.potential_issues)
+    if len(annotation.potential_issues) >= 2:
+        from mythril_tpu.smt.solver import ProbeConfig, check_satisfiable_batch
+        from mythril_tpu.support.support_args import args
+        from mythril_tpu.support.time_handler import time_handler
+
+        # the gate gets the SAME budget the full solve would (solver_timeout
+        # clamped by remaining execution time, cf. support/model.py): a
+        # cheaper gate would turn hard-but-satisfiable issues into silent
+        # recall losses at the final transaction end
+        budget_ms = min(
+            args.solver_timeout,
+            int(max(time_handler.time_remaining(), 0) * 1000) // 2 + 1,
+        )
+        path_raws = list(global_state.world_state.constraints.get_all_raw())
+        gate = check_satisfiable_batch(
+            [
+                path_raws
+                + [c.raw if hasattr(c, "raw") else c for c in p.constraints]
+                for p in annotation.potential_issues
+            ],
+            ProbeConfig(
+                max_rounds=args.probe_rounds,
+                candidates_per_round=args.probe_candidates,
+                timeout_ms=max(1, budget_ms),
+                prune_critical=True,
+            ),
+        )
+    for potential_issue, feasible in zip(annotation.potential_issues, gate):
+        if not feasible:
+            # an UNKNOWN here degrades exactly like a failed solve below:
+            # the issue stays parked and is retried at a later tx end
+            unsolved.append(potential_issue)
+            continue
         try:
             transaction_sequence = get_transaction_sequence(
                 global_state,

@@ -64,10 +64,8 @@ def concolic_execution(
     old_timeout = args.solver_timeout
     old_remaining = time_handler.time_remaining()
     args.solver_timeout = solver_timeout
-    # the time handler is process-global: without a fresh budget HERE, a
-    # deadline left expired by an earlier analysis in the same process makes
-    # the concrete replay execute zero instructions (empty trace, no flips)
-    time_handler.start_execution(1000)
+    # (concrete_execution and flip_branches each reset the process-global
+    # time budget themselves; this frame only restores the caller's)
     try:
         init_state, trace = concrete_execution(concrete_data)
         return flip_branches(init_state, concrete_data, jump_addresses, trace)

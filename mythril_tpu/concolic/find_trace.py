@@ -53,6 +53,12 @@ def concrete_execution(concrete_data: ConcreteData) -> Tuple[WorldState, List]:
         requires_statespace=False,
         strategy=BreadthFirstSearchStrategy,
     )
+    # the exec loop consults the PROCESS-GLOBAL deadline too: an expired
+    # budget left by an earlier analysis in this process would record an
+    # empty trace (the laser's own execution_timeout is not enough)
+    from mythril_tpu.support.time_handler import time_handler
+
+    time_handler.start_execution(laser_evm.execution_timeout)
     trace: List[Tuple[int, str]] = []
 
     def execute_state_hook(global_state):

@@ -187,14 +187,9 @@ class CodeTables:
         segment program serves every contract in the same bucket.  Base caps
         fit EIP-170 runtime code (24576 bytes); larger inputs (initcode,
         arbitrary files) grow the bucket instead of crashing."""
-        n = self.fam.shape[0]
-        instr_cap = 512
-        while instr_cap < n:
-            instr_cap *= 4
-        addr_cap = 32768
-        while addr_cap < self.jumpmap.shape[0]:
-            addr_cap *= 2
-        return instr_cap, addr_cap, 512
+        instr_cap = _grow(_INSTR_BASE, _INSTR_GROWTH, self.fam.shape[0])
+        addr_cap = _grow(_ADDR_BASE, _ADDR_GROWTH, self.jumpmap.shape[0])
+        return instr_cap, addr_cap, _LOOPS_CAP
 
     def padded_device_tables(self, bucket: Optional[tuple] = None):
         """CodeDev-shaped numpy arrays padded to the size bucket; the pad
@@ -224,15 +219,46 @@ class CodeTables:
         )
 
 
+# bucket-growth bases shared by every sizing path (CodeTables.size_bucket,
+# multi_size_bucket, bucket_hint) — ONE set of constants so a tuning change
+# cannot desynchronize the cooperative driver's floor from the real bucket
+# (a mismatch silently reintroduces mid-sweep XLA recompiles)
+_INSTR_BASE, _INSTR_GROWTH = 512, 4
+_ADDR_BASE, _ADDR_GROWTH = 32768, 2
+_CODE_GROWTH = 8
+_LOOPS_CAP = 512
+
+
+def _grow(base: int, factor: int, need: int) -> int:
+    cap = base
+    while cap < need:
+        cap *= factor
+    return cap
+
+
+def bucket_hint(instruction_lists: List[List]) -> tuple:
+    """(code_cap, instr_cap, addr_cap, loops_cap) covering these codes
+    WITHOUT building tables — the cooperative driver pins this as the
+    bucket floor so every tx round of a sweep shares one compiled segment
+    program even as the live code set shrinks."""
+    code_cap = _grow(1, _CODE_GROWTH, len(instruction_lists))
+    instr_cap, addr_cap = _INSTR_BASE, _ADDR_BASE
+    for instruction_list in instruction_lists:
+        instr_cap = _grow(
+            instr_cap, _INSTR_GROWTH, len(instruction_list) + 1
+        )  # +1: implicit trailing STOP
+        max_addr = max((ins.address for ins in instruction_list), default=0)
+        addr_cap = _grow(addr_cap, _ADDR_GROWTH, max_addr + 2)
+    return code_cap, instr_cap, addr_cap, _LOOPS_CAP
+
+
 def multi_size_bucket(tables: List["CodeTables"]) -> tuple:
     """(code_cap, instr_cap, addr_cap, loops_cap) covering every table.
 
     The code axis buckets at 1/8/32/... so one compiled segment serves any
     corpus batch of similar shape; instr/addr caps are the max over members
     (each member's own bucket, so a corpus of small contracts stays small)."""
-    code_cap = 1
-    while code_cap < len(tables):
-        code_cap *= 8
+    code_cap = _grow(1, _CODE_GROWTH, len(tables))
     instr_cap = addr_cap = loops_cap = 0
     for t in tables:
         ic, ac, lc = t.size_bucket()

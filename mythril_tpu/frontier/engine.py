@@ -181,13 +181,21 @@ def _eligible(gs) -> bool:
         return False
 
 
-def drain_lasers(lasers: List, caps: Optional[Caps] = None) -> int:
+def drain_lasers(
+    lasers: List,
+    caps: Optional[Caps] = None,
+    bucket_floor: Optional[tuple] = None,
+) -> int:
     """Run eligible seeds from EVERY laser's work list as one multi-code
     frontier batch (the cooperative corpus entry point).  Parked paths land
     back on their own laser's work list.  Returns #instructions executed.
 
     Lasers must share search configuration (max_depth / strategy family);
-    heterogeneous groups run as separate batches."""
+    heterogeneous groups run as separate batches.  ``bucket_floor`` pins a
+    minimum (code_cap, instr_cap, addr_cap, loops_cap) so every round of a
+    cooperative run reuses ONE compiled segment program even as the live
+    code set shrinks (a smaller round must not trigger a fresh XLA compile
+    mid-sweep)."""
     groups: Dict[tuple, List[Tuple]] = {}
     for laser in lasers:
         if _is_concolic(laser):
@@ -197,10 +205,16 @@ def drain_lasers(lasers: List, caps: Optional[Caps] = None) -> int:
             continue
         key = (laser.max_depth, _sel_mode(laser))
         groups.setdefault(key, []).extend((laser, s) for s in seeds)
+    # the floor covers the WHOLE corpus: applying it to a small heterogeneous
+    # group would pad that group's device tables to the full code axis
+    # (wasted HBM); with one group — the practical cooperative case — the
+    # floor is exact
+    if len(groups) > 1:
+        bucket_floor = None
     executed = 0
     for pairs in groups.values():
         engine = FrontierEngine(pairs[0][0], caps)
-        executed += engine._drain_pairs(pairs)
+        executed += engine._drain_pairs(pairs, bucket_floor=bucket_floor)
     return executed
 
 
@@ -222,7 +236,8 @@ class FrontierEngine:
             return 0
         return self._drain_pairs([(laser, s) for s in seeds])
 
-    def _drain_pairs(self, pairs: List[Tuple]) -> int:
+    def _drain_pairs(self, pairs: List[Tuple],
+                     bucket_floor: Optional[tuple] = None) -> int:
         """Run (laser, seed) pairs as one batch; seeds are removed from
         their work lists and never lost (parked back on failure)."""
         if not self._device_worthwhile(pairs):
@@ -230,7 +245,7 @@ class FrontierEngine:
         for laser, s in pairs:
             laser.work_list.remove(s)
         try:
-            return self._run(pairs)
+            return self._run(pairs, bucket_floor=bucket_floor)
         except Exception:
             # never lose a seed: hand everything back to the host engines.
             # Paths a partial frontier run already completed re-run on host;
@@ -321,7 +336,8 @@ class FrontierEngine:
 
     # ------------------------------------------------------------------
 
-    def _run(self, pairs: List[Tuple]) -> int:
+    def _run(self, pairs: List[Tuple],
+             bucket_floor: Optional[tuple] = None) -> int:
         caps = self.caps
         t_start = time.time()
 
@@ -367,6 +383,8 @@ class FrontierEngine:
             seed_code_idx.append(ci)
 
         bucket = multi_size_bucket(tables)
+        if bucket_floor is not None:
+            bucket = tuple(max(b, f) for b, f in zip(bucket, bucket_floor))
         code_cap, instr_cap, addr_cap, loops_cap = bucket
         segment = cached_segment(caps, *bucket)
         import jax
