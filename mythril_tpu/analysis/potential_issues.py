@@ -80,34 +80,7 @@ def check_potential_issues(global_state: GlobalState) -> None:
     (model + input minimization) is paid only for the satisfiable ones."""
     annotation = get_potential_issues_annotation(global_state)
     unsolved: List[PotentialIssue] = []
-    gate = [True] * len(annotation.potential_issues)
-    if len(annotation.potential_issues) >= 2:
-        from mythril_tpu.smt.solver import ProbeConfig, check_satisfiable_batch
-        from mythril_tpu.support.support_args import args
-        from mythril_tpu.support.time_handler import time_handler
-
-        # the gate gets the SAME budget the full solve would (solver_timeout
-        # clamped by remaining execution time, cf. support/model.py): a
-        # cheaper gate would turn hard-but-satisfiable issues into silent
-        # recall losses at the final transaction end
-        budget_ms = min(
-            args.solver_timeout,
-            int(max(time_handler.time_remaining(), 0) * 1000) // 2 + 1,
-        )
-        path_raws = list(global_state.world_state.constraints.get_all_raw())
-        gate = check_satisfiable_batch(
-            [
-                path_raws
-                + [c.raw if hasattr(c, "raw") else c for c in p.constraints]
-                for p in annotation.potential_issues
-            ],
-            ProbeConfig(
-                max_rounds=args.probe_rounds,
-                candidates_per_round=args.probe_candidates,
-                timeout_ms=max(1, budget_ms),
-                prune_critical=True,
-            ),
-        )
+    gate = _gate_issues(global_state, annotation.potential_issues)
     for potential_issue, feasible in zip(annotation.potential_issues, gate):
         if not feasible:
             # an UNKNOWN here degrades exactly like a failed solve below:
@@ -150,3 +123,89 @@ def get_bytecode_hash(bytecode) -> str:
     from mythril_tpu.support.support_utils import get_code_hash
 
     return get_code_hash(bytecode) if bytecode is not None else ""
+
+
+def _has_wide_mul(raws) -> bool:
+    """True when a term DAG contains a multiply wider than the native word
+    (the zext-mul overflow encoding): its bit-blast exceeds the CDCL clause
+    budget, so such issues take the full per-issue solve path instead of
+    poisoning the shared session blast."""
+    from mythril_tpu.smt import terms as T
+
+    return any(
+        t.op == "bvmul" and T.is_bv_sort(t.sort) and t.width > 256
+        for t in T.topo_order(raws)
+    )
+
+
+def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
+    """sat/unsat gate over all parked issues at FULL solver budget.
+
+    All issues at one transaction end share the whole path prefix, so the
+    gate blasts ``path ∪ all issue constraints`` ONCE into an incremental
+    CDCL session with per-issue enable literals and answers each issue as a
+    solve-under-assumptions (learned clauses shared).  Exact UNSATs skip
+    the expensive exploit synthesis; SAT models are validated exactly;
+    anything undecidable here (UNKNOWN, unsupported structure, wide-mul
+    overflow encodings, no native library) passes through True to the full
+    per-issue solve — the gate can only SAVE work, never lose recall beyond
+    what the full solve itself would."""
+    gate = [True] * len(issues)
+    if len(issues) < 2:
+        return gate
+    from mythril_tpu.native import bitblast
+    from mythril_tpu.smt.concrete_eval import evaluate
+    from mythril_tpu.smt.solver import SolverStatistics
+    from mythril_tpu.support.support_args import args
+    from mythril_tpu.support.time_handler import time_handler
+
+    if not bitblast.available():
+        return gate
+    path_raws = list(global_state.world_state.constraints.get_all_raw())
+    issue_raws = [
+        [c.raw if hasattr(c, "raw") else c for c in p.constraints]
+        for p in issues
+    ]
+    # one enable-guarded conjunct per issue (land folds multi-term lists)
+    from mythril_tpu.smt import terms as T
+
+    guarded, members = [], []
+    for i, raws in enumerate(issue_raws):
+        folded = T.land(*raws) if raws else T.boolval(True)
+        if _has_wide_mul([folded]):
+            continue  # full solve path; do not poison the shared blast
+        guarded.append(folded)
+        members.append(i)
+    if len(members) < 2:
+        return gate
+    try:
+        session = bitblast.OptimizeSession(path_raws, guarded=guarded)
+    except bitblast.Unsupported:
+        return gate
+    try:
+        for gi, i in enumerate(members):
+            # the OVERALL analysis deadline is re-read per query: one hard
+            # issue must not spend the whole remaining budget N times over
+            budget_s = max(0.05, min(
+                args.solver_timeout / 1000.0,
+                max(time_handler.time_remaining(), 0) / 2,
+            ))
+            SolverStatistics().cdcl_calls += 1
+            status, asg = session.solve([], budget_s, enable=[gi])
+            if status == bitblast.UNSAT:
+                gate[i] = False
+            elif status == bitblast.SAT and asg is not None:
+                # exact validation, as for every native SAT model; a valid
+                # model is remembered so the full solve's replay tier hits
+                conj = path_raws + [guarded[gi]]
+                try:
+                    vals = evaluate(conj, asg)
+                    if all(vals[c] for c in conj):
+                        from mythril_tpu.smt.solver import remember_model
+
+                        remember_model(conj, asg)
+                except Exception:
+                    pass  # full solve decides from scratch
+    finally:
+        session.close()
+    return gate

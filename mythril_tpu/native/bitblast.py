@@ -424,16 +424,27 @@ class OptimizeSession:
     instead of once per bound — the z3-incremental-optimize analogue the
     reference gets from ``z3.Optimize`` (mythril/analysis/solver.py:216-256).
 
+    ``guarded`` terms are additionally compiled behind per-term enable
+    literals (``en_i => guarded[i]``): one blast serves a whole family of
+    sibling queries that differ by one conjunct each — the transaction-end
+    issue-confirmation gate, where every parked issue shares the full path
+    prefix (analysis/potential_issues.py).
+
     UNSAT answers are exact (abstractions only add behaviors, see module
     docstring); SAT models must be validated by the caller exactly like
     ``solve``'s.
     """
 
-    def __init__(self, conjuncts: Sequence[Term], objectives: Sequence[Term]):
+    def __init__(
+        self,
+        conjuncts: Sequence[Term],
+        objectives: Sequence[Term] = (),
+        guarded: Sequence[Term] = (),
+    ):
         lib = _load()
         if lib is None:
             raise Unsupported("native library unavailable")
-        tape = serialize(conjuncts, extra=objectives)
+        tape = serialize(conjuncts, extra=list(objectives) + list(guarded))
         self._conjuncts = list(conjuncts)
         self._controls = []  # per objective: (m_node, width, {op: en_node})
         for i, obj in enumerate(objectives):
@@ -453,6 +464,14 @@ class OptimizeSession:
                 tape.roots.append(tape.emit(OP_OR, 1, not_en, cmp_node))
                 ens[op_name] = en_node
             self._controls.append((m_node, w, ens))
+        self._guards = []  # per guarded term: its enable node
+        for i, g in enumerate(guarded):
+            g_node = tape.node_of[g.tid]
+            en_var = terms.var(f"__guard_en_{i}", 1)
+            en_node = tape.fresh(1, ("scalar", en_var))
+            not_en = tape.emit(OP_NOT, 1, en_node)
+            tape.roots.append(tape.emit(OP_OR, 1, not_en, g_node))
+            self._guards.append(en_node)
         self._tape = tape
         rec = np.asarray(tape.records, dtype=np.int32).reshape(-1)
         consts = np.frombuffer(bytes(tape.consts) or b"\x00", dtype=np.uint8)
@@ -473,15 +492,21 @@ class OptimizeSession:
             raise Unsupported("session open failed")
 
     def solve(
-        self, bounds: Sequence[Tuple[int, str, int]], timeout_s: float
+        self,
+        bounds: Sequence[Tuple[int, str, int]],
+        timeout_s: float,
+        enable: Sequence[int] = (),
     ) -> Tuple[str, Optional[Assignment]]:
-        """Solve under objective bounds [(obj_index, 'le'|'ge'|'eq', value)].
+        """Solve under objective bounds [(obj_index, 'le'|'ge'|'eq', value)]
+        and with the given guarded terms enabled (indices into ``guarded``).
 
         Returns (status, assignment-or-None); SAT models are unvalidated
         (caller validates with the exact evaluator, as for ``solve``)."""
         if self._handle is None:
             return UNKNOWN, None
         assume: List[int] = []
+        for gi in enable:
+            assume.append((self._guards[gi] << 16) | 1)
         for idx, op_name, value in bounds:
             m_node, w, ens = self._controls[idx]
             assume.append((ens[op_name] << 16) | 1)

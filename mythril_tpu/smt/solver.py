@@ -1114,6 +1114,18 @@ class _ModelCache:
 _model_cache = _ModelCache()
 
 
+def remember_model(conjuncts: Sequence[Term], assignment: Assignment) -> None:
+    """Install an externally-found VALIDATED model into the cache/replay
+    tiers (e.g. the issue-confirmation gate's session models), so the next
+    solve of the same — or an extended — conjunction hits the cheap
+    replay tier instead of re-solving.  The caller owns validation."""
+    folded = terms.land(*conjuncts)
+    if folded.op == "const":
+        return
+    conj = list(folded.args) if folded.op == "and" else [folded]
+    _model_cache.remember(frozenset(c.tid for c in conj), SAT, assignment)
+
+
 def clear_model_cache() -> None:
     _model_cache.models.clear()
     _model_cache.results.clear()
