@@ -1457,6 +1457,10 @@ class Optimize(Solver):
         super().__init__(config)
         self._minimize: List = []
         self._maximize: List = []
+        # True after check() iff EVERY objective was refined to a PROVEN
+        # optimum (callers use this to decide whether the model is safe to
+        # memoize budget-independently; a truncated refinement is not)
+        self.proven_optimal = True
 
     def minimize(self, expr) -> None:
         self._minimize.append(expr.raw if hasattr(expr, "raw") else expr)
@@ -1636,6 +1640,7 @@ class Optimize(Solver):
                 session.close()
             return status
         pins: List = []
+        self.proven_optimal = True
         try:
             # lexicographic: each objective's achievement is pinned before
             # the next — exactly (==) when proven optimal, as a bound
@@ -1646,6 +1651,7 @@ class Optimize(Solver):
                     conj, obj, asg, deadline, want_min,
                     session=session, obj_idx=i, pins=pins,
                 )
+                self.proven_optimal = self.proven_optimal and proven
                 achieved_val = evaluate([obj], asg)[obj]
                 achieved = terms.const(achieved_val, obj.width)
                 if proven:

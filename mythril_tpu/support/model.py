@@ -9,7 +9,6 @@ UnsatError on unsat/unknown.  Here the query routes to the probe/CDCL stack
 from __future__ import annotations
 
 import os
-from functools import lru_cache
 from typing import Optional, Sequence, Tuple
 
 from mythril_tpu.exceptions import UnsatError
@@ -35,13 +34,33 @@ def get_model(
     raws = tuple(c.raw if hasattr(c, "raw") else c for c in constraints)
     min_raws = tuple(m.raw if hasattr(m, "raw") else m for m in minimize)
     max_raws = tuple(m.raw if hasattr(m, "raw") else m for m in maximize)
-    return _get_model_cached(raws, min_raws, max_raws, timeout)
+    # the cache key must NOT include the timeout: it is derived from the
+    # REMAINING execution time, so it differs on every call and would
+    # fragment the cache into all-misses.  A SAT result is valid under any
+    # budget; UNSAT/UNKNOWN raise and are never cached.
+    key = (raws, min_raws, max_raws)
+    hit = _model_memo.get(key)
+    if hit is not None:
+        return hit
+    model, proven = _get_model_cached(raws, min_raws, max_raws, timeout)
+    if proven:
+        # only PROVEN-optimal (or objective-free) models memoize: a
+        # budget-truncated refinement must re-solve under a later, larger
+        # budget instead of serving its unrefined model forever
+        if len(_model_memo) >= 2**18:
+            _model_memo.pop(next(iter(_model_memo)))  # FIFO, not flush
+        _model_memo[key] = model
+    return model
 
 
-@lru_cache(maxsize=2**18)
-def _get_model_cached(raws: tuple, min_raws: tuple, max_raws: tuple, timeout: int) -> Model:
-    # lru_cache keyed by interned term tuples — the counterpart of the
-    # reference's 2**23-entry cache over z3 constraint tuples.
+_model_memo: dict = {}
+
+
+def _get_model_cached(
+    raws: tuple, min_raws: tuple, max_raws: tuple, timeout: int
+) -> Tuple[Model, bool]:
+    # (kept as a separate function so the memo layer above stays readable;
+    # ``cache_clear`` mirrors the old lru_cache surface for bench/tests)
     opt = Optimize(
         ProbeConfig(
             max_rounds=args.probe_rounds,
@@ -59,8 +78,12 @@ def _get_model_cached(raws: tuple, min_raws: tuple, max_raws: tuple, timeout: in
     status = opt.check()
     if status != SAT:
         raise UnsatError(f"no model found ({status})")
-    return opt.model()
+    return opt.model(), opt.proven_optimal
 
+
+# compatibility with the old lru_cache surface (bench/_clear_caches and the
+# recall-differential suite call _get_model_cached.cache_clear())
+_get_model_cached.cache_clear = _model_memo.clear
 
 _dump_counter = [0]
 
