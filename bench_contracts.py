@@ -194,20 +194,26 @@ def bectoken_like() -> bytes:
     a.op("SWAP1", "POP", "SWAP1", "SSTORE")  # balances[to] = c
     _return_one(a)
 
-    # ---- batchTransfer(receivers..., uint256 value) ----
+    # ---- batchTransfer(address[] receivers, uint256 value) ----
     # THE BUG (BECToken.sol:255-268): amount = cnt * value, UNCHECKED.
-    # Layout note: ``cnt`` is a direct head word (solc's `external`
-    # fixed-argument shape) rather than the dynamic-array head indirection
-    # (cnt = calldataload(4 + calldataload(4))) — one-level calldata
-    # indirection is a known probe/CDCL gap recorded in ROADMAP.md; the
-    # overflow arithmetic, SafeMath contrast, storage writes and the
-    # symbolic-length loop are unchanged.
+    # TRUE solc dynamic-array layout: the first head word holds the byte
+    # OFFSET of the array data region, so the length is read through one
+    # level of calldata indirection — ``cnt = calldataload(4 +
+    # calldataload(4))`` — and element i at ``ptr + 32 + 32*i``.  This is
+    # the CVE-2018-10299 shape as solc emits it (resolved by the solver's
+    # dynamic select hints / CDCL Ackermann congruence; ROADMAP.md item 1).
     a.label("batch")
     _when_not_paused(a, "batch")
-    _arg(a, 0)  # [cnt]
-    _arg(a, 1)  # [cnt, value]
+    # ptr = 4 + calldataload(4)   (array data region)
+    a.push(4).op("CALLDATALOAD")
+    a.push(4).op("ADD")  # [ptr]
+    # cnt = calldataload(ptr)     (array length, via indirection)
+    a.op("DUP1", "CALLDATALOAD")  # [ptr, cnt]
+    _arg(a, 1)  # [ptr, cnt, value]
     # amount = cnt * value   <-- unchecked multiply, SWC-101
-    a.op("DUP2", "DUP2", "MUL")  # [cnt, value, amount]
+    # (stack indices below are all relative to the top; ptr stays parked
+    # at the bottom of the frame until the loop body needs it)
+    a.op("DUP2", "DUP2", "MUL")  # [ptr, cnt, value, amount]
     # require(cnt > 0 && cnt <= 20)
     a.op("DUP3")
     a.push(0).op("LT")  # 0 < cnt
@@ -220,25 +226,26 @@ def bectoken_like() -> bytes:
     _require(a, "b_val")
     # require(balances[caller] >= amount)
     a.op("CALLER")
-    _mapping_slot(a, SLOT_BALANCES)  # [cnt, value, amount, slot_c]
-    a.op("DUP1", "SLOAD")  # [cnt, value, amount, slot_c, bal]
+    _mapping_slot(a, SLOT_BALANCES)  # [ptr, cnt, value, amount, slot_c]
+    a.op("DUP1", "SLOAD")  # [ptr, cnt, value, amount, slot_c, bal]
     a.op("DUP1", "DUP4", "GT", "ISZERO")  # not(amount > bal)
     _require(a, "b_bal")
     # balances[caller] = bal - amount
-    a.op("DUP3", "SWAP1", "SUB")  # [cnt, value, amount, slot_c, bal-amount]
-    a.op("SWAP1", "SSTORE")  # [cnt, value, amount]
-    a.op("POP")  # [cnt, value]
+    a.op("DUP3", "SWAP1", "SUB")  # [ptr, cnt, value, amount, slot_c, bal-amount]
+    a.op("SWAP1", "SSTORE")  # [ptr, cnt, value, amount]
+    a.op("POP")  # [ptr, cnt, value]
     # for (i = 0; i < cnt; i++) balances[receivers[i]] += value (checked)
-    a.push(0)  # [cnt, value, i]
+    a.push(0)  # [ptr, cnt, value, i]
     a.label("b_loop")
     a.op("DUP1", "DUP4", "GT")  # cnt > i
     a.op("ISZERO").jumpi("b_done")
-    # receiver = calldataload(68 + 32*i)  (elements after the two head words)
+    # receiver = calldataload(ptr + 32 + 32*i)  (element i of the array)
     a.op("DUP1")
     a.push(32).op("MUL")
-    a.push(68).op("ADD", "CALLDATALOAD")  # [cnt, value, i, receiver]
-    _mapping_slot(a, SLOT_BALANCES)  # [cnt, value, i, slot_r]
-    a.op("DUP1", "SLOAD")  # [cnt, value, i, slot_r, rb]
+    a.push(32).op("ADD")  # [ptr, cnt, value, i, 32+32*i]
+    a.op("DUP5", "ADD", "CALLDATALOAD")  # [ptr, cnt, value, i, receiver]
+    _mapping_slot(a, SLOT_BALANCES)  # [ptr, cnt, value, i, slot_r]
+    a.op("DUP1", "SLOAD")  # [ptr, cnt, value, i, slot_r, rb]
     a.op("DUP4", "DUP2", "ADD")  # [.., slot_r, rb, rb+value]
     a.op("DUP1", "DUP3", "GT", "ISZERO")  # rb <= rb+value (SafeMath add)
     _require(a, "b_add")

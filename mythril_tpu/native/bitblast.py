@@ -278,32 +278,79 @@ def _add_congruence(tape: _Tape, pairs: List[Tuple[List[int], int]]):
     """For every pair of sites: args equal => results equal."""
     for i in range(len(pairs)):
         for j in range(i + 1, len(pairs)):
-            args_i, var_i = pairs[i]
-            args_j, var_j = pairs[j]
-            eqs = [
-                tape.emit(OP_EQ, 1, x, y) for x, y in zip(args_i, args_j)
-            ]
-            all_eq = eqs[0]
-            for e in eqs[1:]:
-                all_eq = tape.emit(OP_AND, 1, all_eq, e)
-            out_eq = tape.emit(OP_EQ, 1, var_i, var_j)
-            na = tape.emit(OP_NOT, 1, all_eq)
-            tape.roots.append(tape.emit(OP_OR, 1, na, out_eq))
+            _add_congruence_pair(tape, pairs[i], pairs[j])
+
+
+def _add_congruence_pair(
+    tape: _Tape, a: Tuple[List[int], int], b: Tuple[List[int], int]
+):
+    args_i, var_i = a
+    args_j, var_j = b
+    eqs = [tape.emit(OP_EQ, 1, x, y) for x, y in zip(args_i, args_j)]
+    all_eq = eqs[0]
+    for e in eqs[1:]:
+        all_eq = tape.emit(OP_AND, 1, all_eq, e)
+    out_eq = tape.emit(OP_EQ, 1, var_i, var_j)
+    na = tape.emit(OP_NOT, 1, all_eq)
+    tape.roots.append(tape.emit(OP_OR, 1, na, out_eq))
+
+
+def _norm_idx(t: Term) -> Tuple[Optional[int], int]:
+    """(base term id, constant offset) so provably-distinct indices can skip
+    congruence: word reads are 32 selects at ``base + j`` — all C(32,2)
+    pairwise constraints are identically true and need no clauses."""
+    if t.is_const:
+        return (None, t.value)
+    if t.op == "bvadd":
+        a, b = t.args
+        if a.is_const:
+            return (b.tid, a.value)
+        if b.is_const:
+            return (a.tid, b.value)
+    return (t.tid, 0)
+
+
+def _provably_distinct(t1: Term, t2: Term) -> bool:
+    b1, o1 = _norm_idx(t1)
+    b2, o2 = _norm_idx(t2)
+    return b1 == b2 and o1 != o2
+
+
+def _add_select_congruence(tape: _Tape) -> None:
+    """Eager pairwise congruence for base-array selects, skipping pairs
+    whose indices can never collide (same symbolic base, different constant
+    offset — the dominant case for byte-addressed calldata/memory words)."""
+    for sites in tape.selects.values():
+        for i in range(len(sites)):
+            for j in range(i + 1, len(sites)):
+                idx_i, var_i, t_i = sites[i]
+                idx_j, var_j, t_j = sites[j]
+                if _provably_distinct(t_i, t_j):
+                    continue
+                _add_congruence_pair(tape, ([idx_i], var_i), ([idx_j], var_j))
 
 
 def serialize(
-    conjuncts: Sequence[Term], extra: Sequence[Term] = ()
+    conjuncts: Sequence[Term],
+    extra: Sequence[Term] = (),
+    lazy_selects: bool = False,
 ) -> _Tape:
     """Serialize ``conjuncts`` as roots; ``extra`` terms (e.g. optimization
-    objectives) are included in the DAG walk without being asserted."""
+    objectives) are included in the DAG walk without being asserted.
+
+    ``lazy_selects``: emit NO select-congruence constraints.  Dropping them
+    only ADDS behaviors, so UNSAT stays sound; SAT models may violate
+    congruence and must be refined (see ``solve``'s CEGAR loop).  Engine
+    queries carry hundreds of select sites whose eager O(n^2) pairs blow
+    the clause budget — refinement typically needs a handful of pairs."""
     tape = _Tape()
     for t in terms.topo_order(list(conjuncts) + list(extra)):
         node = _serialize_node(tape, t)
         if node is not None:
             tape.node_of[t.tid] = node
     tape.roots.extend(_node(tape, c) for c in conjuncts)
-    for sites in tape.selects.values():
-        _add_congruence(tape, [([idx], var) for idx, var, _ in sites])
+    if not lazy_selects:
+        _add_select_congruence(tape)
     if tape.keccaks:
         _add_congruence(tape, [([inp], var) for inp, var, _ in tape.keccaks])
     for sites in tape.applies.values():
@@ -316,13 +363,21 @@ def serialize(
 # ---------------------------------------------------------------------------
 
 
-def _rebuild_assignment(tape: _Tape, model: bytes) -> Assignment:
+def _rebuild_assignment(
+    tape: _Tape, model: bytes
+) -> Tuple[Assignment, List[Tuple[int, int, int]]]:
     """Parse packed VAR bits, then resolve array/UF sites in topo order.
 
     Tape order IS topo order of the original DAG, so by the time a select's
     value is installed every sub-select inside its index expression has
     already been written into the ArrayValue backing — concrete evaluation
     of the index under the partial assignment is exact.
+
+    Returns (assignment, violations) where violations lists select-site
+    pairs ``(arr_tid, site_i, site_j)`` that read the SAME concrete index
+    but were assigned DIFFERENT values — possible only under lazy
+    congruence (``serialize(..., lazy_selects=True)``); the CEGAR loop in
+    ``solve`` asserts exactly those pairs and re-solves.
     """
     values: List[int] = []
     off = 0
@@ -341,11 +396,22 @@ def _rebuild_assignment(tape: _Tape, model: bytes) -> Assignment:
             asg.scalars[t] = bool(value) if t.sort is terms.BOOL else value
         else:
             deferred.append((meta, value))
+    violations: List[Tuple[int, int, int]] = []
+    site_no: Dict[int, int] = {}
+    writer: Dict[Tuple[int, int], Tuple[int, int]] = {}
     for meta, value in deferred:
         kind = meta[0]
         if kind == "select":
             _, arr, idx_term = meta
+            si = site_no.get(arr.tid, 0)
+            site_no[arr.tid] = si + 1
             idx_val = evaluate([idx_term], asg)[idx_term]
+            prev = writer.get((arr.tid, idx_val))
+            if prev is not None:
+                if prev[1] != value:
+                    violations.append((arr.tid, prev[0], si))
+                continue  # first writer's value stands
+            writer[(arr.tid, idx_val)] = (si, value)
             asg.arrays.setdefault(arr, ArrayValue()).backing[idx_val] = value
         elif kind == "apply":
             t = meta[1]
@@ -353,7 +419,7 @@ def _rebuild_assignment(tape: _Tape, model: bytes) -> Assignment:
             asg.ufs[(t.aux, arg_vals)] = value
         # keccak: intentionally NOT installed — validation recomputes real
         # hashes; a model relying on a fake hash value must fail validation
-    return asg
+    return asg, violations
 
 
 # ---------------------------------------------------------------------------
@@ -361,23 +427,7 @@ def _rebuild_assignment(tape: _Tape, model: bytes) -> Assignment:
 # ---------------------------------------------------------------------------
 
 
-def solve(
-    conjuncts: Sequence[Term], timeout_s: float
-) -> Tuple[str, Optional[Assignment]]:
-    """Exact solve; returns (status, assignment-or-None).
-
-    SAT models are reconstructed but NOT validated here — the caller owns
-    validation (mythril_tpu/smt/solver.py re-checks with concrete_eval).
-    """
-    lib = _load()
-    if lib is None or timeout_s <= 0:
-        return UNKNOWN, None
-    try:
-        tape = serialize(conjuncts)
-    except Unsupported as e:
-        log.debug("native tier: %s", e)
-        return UNKNOWN, None
-
+def _run_solver(lib, tape: _Tape, timeout_s: float) -> Tuple[int, bytes]:
     rec = np.asarray(tape.records, dtype=np.int32).reshape(-1)
     consts = np.frombuffer(bytes(tape.consts) or b"\x00", dtype=np.uint8)
     roots = np.asarray(tape.roots, dtype=np.int32)
@@ -385,7 +435,6 @@ def solve(
         (w + 7) // 8 for op, w, *_ in tape.records if op == OP_VAR
     )
     model = np.zeros(max(1, model_size), dtype=np.uint8)
-
     status = lib.bb_solve(
         rec.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         len(tape.records),
@@ -397,15 +446,74 @@ def solve(
         model.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         len(model),
     )
-    if status == 0:
-        return UNSAT, None
-    if status != 1:
+    return status, model.tobytes()
+
+
+# Refinement rounds are cheap (a re-blast costs well under a second at
+# engine query sizes) and byte-addressed aliasing chains legitimately need
+# several: a wrapped-pointer UNSAT proof on the BECToken shape converges in
+# 5 rounds / ~4s where eager congruence exceeded the clause budget outright.
+_CEGAR_ROUNDS = 12
+
+
+def solve(
+    conjuncts: Sequence[Term], timeout_s: float
+) -> Tuple[str, Optional[Assignment]]:
+    """Exact solve; returns (status, assignment-or-None).
+
+    Select congruence is LAZY (CEGAR): the first blast asserts none of the
+    O(n^2) select-congruence pairs (sound for UNSAT — dropping constraints
+    only adds behaviors); when a SAT model assigns different values to two
+    selects whose indices evaluate equal, exactly those pairs are asserted
+    and the formula re-solved.  Engine-scale queries carry hundreds of
+    select sites; eager congruence used to exceed the clause budget, while
+    refinement virtually always needs zero or a handful of pairs.
+
+    SAT models are reconstructed but NOT validated here — the caller owns
+    validation (mythril_tpu/smt/solver.py re-checks with concrete_eval).
+    """
+    import time as _time
+
+    lib = _load()
+    if lib is None or timeout_s <= 0:
         return UNKNOWN, None
+    deadline = _time.time() + timeout_s
+    refine: List[Tuple[int, int, int]] = []
     try:
-        return SAT, _rebuild_assignment(tape, model.tobytes())
-    except Exception as e:  # reconstruction must never crash the solver
-        log.debug("native model reconstruction failed: %s", e)
+        # one serialization: the tape is append-only, so refinement rounds
+        # just add congruence pairs to the same records/roots
+        tape = serialize(conjuncts, lazy_selects=True)
+    except Unsupported as e:
+        log.debug("native tier: %s", e)
         return UNKNOWN, None
+    for _round in range(_CEGAR_ROUNDS):
+        for arr_tid, i, j in refine:
+            sites = tape.selects.get(arr_tid)
+            if sites is None or i >= len(sites) or j >= len(sites):
+                continue
+            idx_i, var_i, _ = sites[i]
+            idx_j, var_j, _ = sites[j]
+            _add_congruence_pair(tape, ([idx_i], var_i), ([idx_j], var_j))
+        refine = []
+        remaining = deadline - _time.time()
+        if remaining <= 0:
+            return UNKNOWN, None
+        status, model = _run_solver(lib, tape, remaining)
+        if status == 0:
+            return UNSAT, None
+        if status != 1:
+            return UNKNOWN, None
+        try:
+            asg, violations = _rebuild_assignment(tape, model)
+        except Exception as e:  # reconstruction must never crash the solver
+            log.debug("native model reconstruction failed: %s", e)
+            return UNKNOWN, None
+        if not violations:
+            return SAT, asg
+        # violated pairs are by construction not yet asserted (an asserted
+        # pair cannot be violated by a model of the CNF)
+        refine = violations
+    return UNKNOWN, None
 
 
 # ---------------------------------------------------------------------------
@@ -529,7 +637,9 @@ class OptimizeSession:
         if status != 1:
             return UNKNOWN, None
         try:
-            return SAT, _rebuild_assignment(self._tape, model.tobytes())
+            # eager (distinctness-filtered) congruence: no violations possible
+            asg, _violations = _rebuild_assignment(self._tape, model.tobytes())
+            return SAT, asg
         except Exception as e:
             log.debug("session model reconstruction failed: %s", e)
             return UNKNOWN, None

@@ -527,16 +527,43 @@ class Circuit {
   }
 
   BV mul(const BV& a, const BV& b) {
+    // Column-compression (Dadda-style) multiplier: bucket partial products
+    // by output column, 3:2 full-adder compression, one final ripple add.
+    // Versus row-ripple accumulation this emits ~1.5x fewer adders for
+    // zext'd operands (zero partial products fold away entirely) and a far
+    // shallower carry structure — the 512-bit overflow-predicate multiply
+    // (BVMulNoOverflow on 256-bit EVM words) is the motivating case.
     size_t w = a.size();
-    BV acc = constant(0, w);
+    std::vector<std::vector<Lit>> cols(w);
     for (size_t i = 0; i < w; i++) {
-      // addend = (a << i) masked by b[i]; truncated at w
       if (b[i] == LIT_FALSE) continue;
-      BV addend(w, LIT_FALSE);
-      for (size_t j = i; j < w; j++) addend[j] = lit_and(a[j - i], b[i]);
-      acc = add(acc, addend);
+      for (size_t j = 0; i + j < w; j++) {
+        Lit pp = lit_and(a[j], b[i]);
+        if (pp != LIT_FALSE) cols[i + j].push_back(pp);
+      }
     }
-    return acc;
+    BV row0(w, LIT_FALSE), row1(w, LIT_FALSE);
+    for (size_t k = 0; k < w; k++) {
+      auto& c = cols[k];
+      size_t head = 0;
+      while (c.size() - head >= 3) {
+        Lit x = c[head], y = c[head + 1], z = c[head + 2];
+        head += 3;
+        Lit xy = lit_xor(x, y);
+        c.push_back(lit_xor(xy, z));  // sum stays in this column
+        Lit carry = lit_or(lit_and(x, y), lit_and(z, xy));
+        if (k + 1 < w && carry != LIT_FALSE) cols[k + 1].push_back(carry);
+      }
+      if (c.size() - head == 2) {
+        // half-adder: defer the pairwise add to the final ripple rows
+        row0[k] = c[head];
+        row1[k] = c[head + 1];
+      } else if (c.size() - head == 1) {
+        row0[k] = c[head];
+      }
+      c.clear();
+    }
+    return add(row0, row1);
   }
 
   // q, r as fresh variables constrained by a == q*b + r (2w-bit), r < b;

@@ -24,19 +24,43 @@ from mythril_tpu.smt.terms import Term, mask, to_signed
 
 
 class ArrayValue:
-    """Concrete array interpretation: sparse backing + default."""
+    """Concrete array interpretation: sparse backing + default.
 
-    __slots__ = ("backing", "default")
+    ``salt`` (candidate diversification): when nonzero, reads of ABSENT keys
+    return a deterministic pseudo-random value derived from (salt, idx)
+    instead of ``default``.  All-zero defaults make distinct symbolic reads
+    collide (two array elements hashing to the same storage slot), hiding
+    models that need distinctness; salted candidates explore those.  The
+    function is pure, so validation under the same assignment is exact."""
 
-    def __init__(self, backing: Dict[int, int] | None = None, default: int = 0):
+    __slots__ = ("backing", "default", "salt", "range_bits")
+
+    def __init__(
+        self,
+        backing: Dict[int, int] | None = None,
+        default: int = 0,
+        salt: int = 0,
+        range_bits: int = 0,
+    ):
         self.backing = dict(backing or {})
         self.default = default
+        self.salt = salt
+        self.range_bits = range_bits
 
     def read(self, idx: int) -> int:
-        return self.backing.get(idx, self.default)
+        v = self.backing.get(idx)
+        if v is not None:
+            return v
+        if self.salt:
+            h = (idx * 0x9E3779B97F4A7C15 + self.salt * 0xBF58476D1CE4E5B9) & (
+                (1 << 64) - 1
+            )
+            h ^= h >> 31
+            return h & ((1 << self.range_bits) - 1 if self.range_bits else 0xFF)
+        return self.default
 
     def write(self, idx: int, val: int) -> "ArrayValue":
-        out = ArrayValue(self.backing, self.default)
+        out = ArrayValue(self.backing, self.default, self.salt, self.range_bits)
         out.backing[idx] = val
         return out
 

@@ -144,6 +144,15 @@ class _Seeder:
         self.bool_hints: Dict[Term, bool] = {}
         # (array_var term, concrete index) -> byte/word hints
         self.array_hints: Dict[Tuple[Term, int], int] = {}
+        # selects at COMPUTED indices (ABI dynamic-array head indirection:
+        # ``calldataload(4 + calldataload(4))``): (base array, index term,
+        # value); installed at candidate-build time by evaluating the index
+        # under the partial assignment (two passes = one indirection level)
+        self.dyn_array_hints: List[Tuple[Term, Term, int]] = []
+        # (base array, (lo, hi)) byte runs acting as data POINTERS inside a
+        # dyn index term; unconstrained ones are pre-seeded past the hinted
+        # head region so indirect writes never alias the pointer itself
+        self.dyn_preseed: List[Tuple[Term, Tuple[int, int]]] = []
         self.const_pool: List[int] = []
         # weak full-variable hints (inequality boundaries): max-combined so
         # e.g. repeated ``i < calldatasize`` reads push the size upward
@@ -165,6 +174,7 @@ class _Seeder:
         self._collect_groups = collect_groups
         self._harvest()
         self._propagate_all()
+        self._analyze_dyn_hints()
 
     def overlay_for(self, candidate_index: int) -> "_Seeder":
         """Base hints + one committed disjunct per or-group.
@@ -192,6 +202,8 @@ class _Seeder:
         clone.bool_hints = dict(self.bool_hints)
         clone.array_hints = dict(self.array_hints)
         clone.weak_vals = dict(self.weak_vals)
+        clone.dyn_array_hints = list(self.dyn_array_hints)
+        clone.dyn_preseed = list(self.dyn_preseed)
         clone.link_pairs = list(self.link_pairs)
         clone.order_pairs = list(self.order_pairs)
         clone.neq_pairs = list(self.neq_pairs)
@@ -287,11 +299,65 @@ class _Seeder:
                 # bound itself (and must not wrap for an all-ones bound)
                 bump = 1 if t.op in ("ult", "slt") else 0
                 self._propagate_value(b, mask(a.value + bump, b.width), weak=True)
+                if t.op in ("ult", "ule"):
+                    # repairable at build time too: the weak hint dies inside
+                    # non-invertible ops (``2^w <= mul(...)`` overflow bounds)
+                    self.order_pairs.append((a, b, bump))
             elif want and not a.is_const:
-                self._propagate_value(a, 0, weak=True)
-                if t.op in ("ult", "ule") and not b.is_const:
+                if b.is_const:
+                    self._propagate_value(a, 0, weak=True)
+                elif t.op in ("ult", "ule"):
                     # both sides symbolic: repairable ordering at build time
+                    # (no zero hint — ``idx < size`` bounds guards would
+                    # poison computed read indices that the repair machinery
+                    # satisfies by raising ``size`` instead)
                     self.order_pairs.append((a, b, 1 if t.op == "ult" else 0))
+                else:
+                    # signed orderings have no repair machinery: keep the
+                    # weak zero seed as candidate guidance
+                    self._propagate_value(a, 0, weak=True)
+
+    def _analyze_dyn_hints(self) -> None:
+        """Find pointer words inside computed-select index terms.
+
+        A dyn index like ``bvadd(calldataload(4), 4+j)`` embeds const-index
+        selects over the SAME array (the ABI head word holding the data
+        offset).  Maximal runs of consecutive const indices are recorded as
+        pointer words so candidate construction can pre-seed unconstrained
+        ones to a canonical non-aliasing offset (solc would emit 0x20)."""
+        if not self.dyn_array_hints:
+            return
+        seen_idx = set()
+        seen_runs = set()
+        for base, idx, _ in self.dyn_array_hints:
+            if idx.tid in seen_idx:
+                continue
+            seen_idx.add(idx.tid)
+            const_reads = set()
+            for t in terms.topo_order([idx]):
+                if t.op == "select" and t.args[1].is_const:
+                    b = t.args[0]
+                    while b.op == "store":
+                        b = b.args[0]
+                    if b is base:
+                        const_reads.add(t.args[1].value)
+            if not const_reads:
+                continue
+            ordered = sorted(const_reads)
+            start = prev = ordered[0]
+            runs = []
+            for v in ordered[1:]:
+                if v == prev + 1:
+                    prev = v
+                    continue
+                runs.append((start, prev))
+                start = prev = v
+            runs.append((start, prev))
+            for run in runs:
+                key = (base.tid, run)
+                if key not in seen_runs:
+                    seen_runs.add(key)
+                    self.dyn_preseed.append((base, run))
 
     def _propagate_value(self, t: Term, value: int, weak: bool = False):
         """Push ``t == value`` down into leaves where ops are invertible."""
@@ -320,10 +386,16 @@ class _Seeder:
             base = arr
             while base.op == "store":
                 base = base.args[0]
-            if base.op == "array_var" and idx.is_const:
-                # partial claims (e.g. a bit test through a mask) still make
-                # a useful hint: unclaimed bits default to zero
-                self.array_hints.setdefault((base, idx.value), value)
+            if base.op == "array_var":
+                if idx.is_const:
+                    # partial claims (e.g. a bit test through a mask) still
+                    # make a useful hint: unclaimed bits default to zero
+                    self.array_hints.setdefault((base, idx.value), value)
+                else:
+                    # computed index (Z3 array-theory territory, reference
+                    # mythril/laser/smt/array.py:45-72): resolved against
+                    # the partial assignment at candidate-build time
+                    self.dyn_array_hints.append((base, idx, value))
             return
         if t.op == "ite":
             # steer toward the then-branch (calldata/memory models guard
@@ -596,15 +668,93 @@ class CandidateGenerator:
                 asg.scalars[v] = hint.complete(mask(fill, v.width))
             else:
                 asg.scalars[v] = mask(fill, v.width)
-        for av in self.array_vars:
+        # every third candidate salts unhinted array reads: zero defaults
+        # collapse distinct symbolic reads onto one value (array elements
+        # hashing to the SAME storage slot), hiding distinctness models.
+        # The salted SUBSET rotates per candidate — salting calldata makes
+        # receiver keys distinct, while storage usually must keep its
+        # zero default (fresh balances) for the same model to validate.
+        salt_base = candidate_index + 1 if candidate_index % 3 == 1 else 0
+        for k, av in enumerate(self.array_vars):
             backing = {
                 idx: val for (a, idx), val in s.array_hints.items() if a is av
             }
-            asg.arrays[av] = ArrayValue(backing, default=0)
+            range_bits = av.sort[2] if len(av.sort) > 2 else 0
+            salted = (
+                salt_base
+                if salt_base and ((candidate_index >> (k % 6)) & 1)
+                else 0
+            )
+            asg.arrays[av] = ArrayValue(
+                backing, default=0, salt=salted, range_bits=range_bits
+            )
         self._apply_links(s, asg)
         self._apply_neq_pairs(s, asg)
+        self._preseed_pointers(s, asg)
         self._apply_order_pairs(s, asg)
+        self._apply_dyn_hints(s, asg)
+        if s.dyn_array_hints:
+            # indirect writes move evaluated indices (size guards, balance
+            # orderings): repair orderings once more against the final state
+            self._apply_order_pairs(s, asg)
         return asg
+
+    @staticmethod
+    def _preseed_pointers(s, asg: Assignment) -> None:
+        """Give unconstrained pointer words a canonical non-aliasing value.
+
+        For every pointer run found by ``_Seeder._analyze_dyn_hints``: if no
+        byte of the run carries a hint or backing yet, write the first
+        32-aligned offset past every hinted byte (big-endian into the run).
+        This is the ABI-canonical shape — the dynamic data region starts
+        after the argument head — and keeps the indirect write from landing
+        on the pointer itself (off=0 would alias ``cnt`` with ``off``)."""
+        if not s.dyn_preseed:
+            return
+        hi_water_by_arr: Dict[int, int] = {}
+        for (arr, k) in s.array_hints:
+            tid = arr.tid
+            hi_water_by_arr[tid] = max(hi_water_by_arr.get(tid, 0), k)
+        for base, (lo, hi) in s.dyn_preseed:
+            backing = asg.arrays.setdefault(base, ArrayValue()).backing
+            if any((base, k) in s.array_hints for k in range(lo, hi + 1)):
+                continue
+            if any(k in backing for k in range(lo, hi + 1)):
+                continue  # link/force-written bytes (even zeros) are pinned
+            hi_water = max(hi_water_by_arr.get(base.tid, 0), hi)
+            ptr = ((hi_water + 32) // 32) * 32
+            nbytes = hi - lo + 1
+            if ptr.bit_length() > 8 * nbytes:
+                continue
+            for i, byte in enumerate(int(ptr).to_bytes(nbytes, "big")):
+                backing.setdefault(lo + i, byte)
+
+    @staticmethod
+    def _apply_dyn_hints(s, asg: Assignment) -> None:
+        """Install computed-index select hints (one indirection level).
+
+        Each pass evaluates every index term under the current assignment
+        and writes the hinted value at the resolved index (first write
+        wins).  Two passes: pass one may move an index term's own inputs
+        (e.g. writing the array length that a later read's index depends
+        on), pass two lands the dependent hints."""
+        if not s.dyn_array_hints:
+            return
+        idx_terms = [idx for _, idx, _ in s.dyn_array_hints]
+        for _ in range(2):
+            try:
+                vals = evaluate(idx_terms, asg)
+            except NotImplementedError:
+                return
+            changed = False
+            for arr, idx, value in s.dyn_array_hints:
+                backing = asg.arrays.setdefault(arr, ArrayValue()).backing
+                iv = vals[idx]
+                if iv not in backing:
+                    backing[iv] = value
+                    changed = True
+            if not changed:
+                return
 
     def _apply_neq_pairs(self, s, asg: Assignment) -> None:
         """Repair violated disequalities by flipping the low bit of one side
@@ -635,6 +785,17 @@ class CandidateGenerator:
                 asg.scalars[v] = hint.complete(asg.scalars.get(v, 0) or 0)
         for (arr, idx), val in tmp.array_hints.items():
             asg.arrays.setdefault(arr, ArrayValue()).backing[idx] = val
+        if tmp.dyn_array_hints:
+            idx_terms = [idx for _, idx, _ in tmp.dyn_array_hints]
+            try:
+                vals = evaluate(idx_terms, asg)
+            except NotImplementedError:
+                vals = None
+            if vals is not None:
+                for arr, idx, val in tmp.dyn_array_hints:
+                    asg.arrays.setdefault(arr, ArrayValue()).backing[
+                        vals[idx]
+                    ] = val
         for v, bound in tmp.weak_vals.items():
             cur = asg.scalars.get(v, 0)
             if isinstance(cur, int) and cur < bound:
@@ -679,10 +840,10 @@ class CandidateGenerator:
             hi_max = (1 << hi.width) - 1
             target = self._dyn_target(hi)
             if target is not None and lo_v + bump <= hi_max:
-                self._dyn_write(target, lo_v + bump, asg)
+                self._dyn_write(target, lo_v + bump, asg, raise_only=True)
                 continue
             if (
-                hi.op == "mul"
+                hi.op == "bvmul"
                 and lo_v + bump <= hi_max
                 and self._raise_product(hi, lo_v + bump, asg)
             ):
@@ -714,9 +875,16 @@ class CandidateGenerator:
         if self.rng.random() < 0.5:
             x, y = y, x
         base = vals[y]
-        if base == 0:
-            self._force_value(y, 1, asg)
-            base = 1
+        # the bound may exceed what x alone can supply (both factors at 1
+        # for a 2^w overflow target): bump y to the SMALLEST value whose
+        # cofactor fits in x — e.g. cnt=2, value=2^(w-1), respecting a tight
+        # range constraint on y that a blunt 2^(w/2) split would violate
+        min_base = -(-target // ((1 << x.width) - 1))
+        if base < min_base:
+            if min_base.bit_length() > y.width:
+                return False
+            self._force_value(y, min_base, asg)
+            base = min_base
         need = -(-target // base)  # ceil
         if need.bit_length() > x.width:
             return False
@@ -724,17 +892,33 @@ class CandidateGenerator:
         return True
 
     @staticmethod
-    def _dyn_write(info, value: int, asg: Assignment) -> None:
+    def _dyn_write(
+        info, value: int, asg: Assignment, raise_only: bool = False
+    ) -> None:
+        """``raise_only``: keep a larger already-written value (a batch of
+        ``idx < size`` guards repaired in one sweep must leave ``size``
+        above the LARGEST index, not whichever pair happened to come last)."""
         if info[0] == "var":
+            cur = asg.scalars.get(info[1])
+            if raise_only and isinstance(cur, int) and cur >= value:
+                return
             asg.scalars[info[1]] = value
         elif info[0] == "sel":
-            asg.arrays.setdefault(info[1], ArrayValue()).backing[info[2]] = value
+            backing = asg.arrays.setdefault(info[1], ArrayValue()).backing
+            cur = backing.get(info[2])
+            if raise_only and isinstance(cur, int) and cur >= value:
+                return
+            backing[info[2]] = value
         else:  # dynsel: resolve the key against the current assignment
             try:
                 key_v = evaluate([info[2]], asg)[info[2]]
             except NotImplementedError:
                 return
-            asg.arrays.setdefault(info[1], ArrayValue()).backing[key_v] = value
+            backing = asg.arrays.setdefault(info[1], ArrayValue()).backing
+            cur = backing.get(key_v)
+            if raise_only and isinstance(cur, int) and cur >= value:
+                return
+            backing[key_v] = value
 
     def _apply_links(self, s, asg: Assignment) -> None:
         """Copy evaluated values across symbolic equalities (two passes).
@@ -1157,6 +1341,18 @@ def solve_conjunction(
     resolved, conjuncts, cache_key = _fast_path(conjuncts, use_cache, replay)
     if resolved is not None:
         return resolved
+
+    # tier 0.6: interval-bound refutation — exact UNSAT for range-impossible
+    # demands (a loop-exit path pinning cnt<=1 conjoined with an overflow
+    # demand cnt*value >= 2^256), at one linear DAG walk instead of seconds
+    # of 512-bit CDCL blasting
+    from mythril_tpu.smt.intervals import refute as _interval_refute
+
+    if _interval_refute(conjuncts):
+        if use_cache:
+            _model_cache.remember(cache_key, UNSAT, None)
+        stats.solver_time += time.time() - t0
+        return UNSAT, None
 
     # tier 0.75: independence split (reference independence_solver.py:86-152)
     # — disjoint-variable buckets solve separately and merge their models

@@ -1,0 +1,105 @@
+"""Computed-index array reads (ABI dynamic-array head indirection).
+
+Z3's array theory gives the reference this for free
+(mythril/laser/smt/array.py:45-72 Select over a symbolic index); this stack
+resolves it with dynamic select hints installed against the partial
+assignment (smt/solver.py _apply_dyn_hints) plus pointer-word pre-seeding,
+and exactly in the CDCL tier via Ackermann congruence.  The motivating shape
+is solc's ``address[]`` calldata layout — ``cnt = calldataload(4 +
+calldataload(4))`` — from BECToken's batchTransfer
+(solidity_examples/BECToken.sol:257-268, CVE-2018-10299).
+"""
+
+from mythril_tpu.core.state.calldata import SymbolicCalldata
+from mythril_tpu.smt import (
+    BVMulNoOverflow, Not, Solver, ULE, UGE, symbol_factory,
+    SAT, UNSAT,
+)
+from mythril_tpu.smt.solver import SolverStatistics
+
+
+def val(v, w=256):
+    return symbol_factory.BitVecVal(v, w)
+
+
+def _abi_words():
+    """off / cnt / value terms in the true dynamic-array layout."""
+    cd = SymbolicCalldata("1")
+    off = cd.get_word_at(4)
+    cnt = cd.get_word_at(val(4) + off)
+    value = cd.get_word_at(36)
+    return cd, off, cnt, value
+
+
+def test_one_level_indirection_probe_hit():
+    """The probe (not the CDCL fallback) must solve the true ABI shape."""
+    cd, off, cnt, value = _abi_words()
+    s = Solver()
+    s.add(UGE(cnt, val(1)))
+    s.add(ULE(cnt, val(20)))
+    s.add(UGE(value, val(1)))
+    s.add(Not(BVMulNoOverflow(cnt, value, signed=False)))
+    stats = SolverStatistics()
+    hits_before = stats.probe_hits
+    assert s.check() == SAT
+    assert stats.probe_hits == hits_before + 1, "expected a probe hit, not CDCL"
+    m = s.model()
+    cnt_v, value_v = int(m.eval(cnt)), int(m.eval(value))
+    assert 1 <= cnt_v <= 20
+    assert cnt_v * value_v >= 1 << 256, "product must wrap"
+    # the reified exploit calldata must be compact (ABI-shaped, not junk)
+    data = cd.concrete(m)
+    assert len(data) <= 512
+
+
+def test_indirect_read_equals_direct_head_value():
+    """cnt read through the pointer must match a directly pinned word."""
+    cd, off, cnt, _ = _abi_words()
+    s = Solver()
+    s.add(cnt == val(0xDEAD))
+    s.add(UGE(off, val(32)))  # keep the data region off the head
+    assert s.check() == SAT
+    m = s.model()
+    assert int(m.eval(cnt)) == 0xDEAD
+
+
+def test_wide_mul_unsat_exact():
+    """Bounded factors cannot overflow: the CDCL tier must prove UNSAT."""
+    cnt = symbol_factory.BitVecSym("cnt", 256)
+    value = symbol_factory.BitVecSym("value", 256)
+    s = Solver()
+    s.add(UGE(cnt, val(1)))
+    s.add(ULE(cnt, val(20)))
+    s.add(ULE(value, val(1 << 200)))
+    s.add(Not(BVMulNoOverflow(cnt, value, signed=False)))
+    assert s.check() == UNSAT
+
+
+def test_overflow_raise_with_range_pinned_factor():
+    """cnt is range-pinned small: the product raise must pick the minimal
+    cofactor split (cnt=2-ish, value~2^255), not a blunt 2^128 split."""
+    cnt = symbol_factory.BitVecSym("cnt2", 256)
+    value = symbol_factory.BitVecSym("value2", 256)
+    s = Solver()
+    s.add(UGE(cnt, val(2)))
+    s.add(ULE(cnt, val(3)))
+    s.add(Not(BVMulNoOverflow(cnt, value, signed=False)))
+    assert s.check() == SAT
+    m = s.model()
+    cnt_v, value_v = int(m.eval(cnt)), int(m.eval(value))
+    assert 2 <= cnt_v <= 3
+    assert cnt_v * value_v >= 1 << 256
+
+
+def test_guard_no_poison_size_raised():
+    """``idx < size`` guards must be satisfied by raising size, not by
+    zeroing the computed index through the pointer word."""
+    cd, off, cnt, _ = _abi_words()
+    s = Solver()
+    s.add(cnt == val(7))
+    assert s.check() == SAT
+    m = s.model()
+    size_v = int(m.eval(cd.calldatasize))
+    off_v = int(m.eval(off))
+    # data region must genuinely sit inside calldata
+    assert size_v >= 4 + off_v + 32
