@@ -454,20 +454,19 @@ def wl_corpus(production: bool):
     return states, wall, ttfe, (dev_delta if production else None)
 
 
-# (name, fn, unit, reps) — sub-minute workloads are dominated by scheduling
-# and solver jitter, so they run INTERLEAVED reps and report median rates
-# (the stabilization introduced in round 1); multi-minute workloads run once
+# (name, fn, unit, reps) — workloads run INTERLEAVED baseline/production
+# reps and report the median with min/max spread in the JSON.  Solver-bound
+# rows get >= 3 reps: their run-to-run variance is the dominant error term
+# (measured +/-20-40% in round 3), and a median-of-3 with reported spread is
+# the minimum honest quote.
 WORKLOADS = [
     ("suicide_1tx", wl_suicide, "states/sec", 3),
     ("killbilly_3tx", wl_killbilly, "states/sec", 3),
-    ("overflow_256bit", wl_overflow, "states/sec", 2),
-    ("wide_frontier", wl_wide_frontier, "states/sec", 2),
-    # single rep: the workload is dominated by multi-minute issue
-    # confirmation solving in BOTH configs and one interleaved pair already
-    # bounds the ratio; more reps would double the whole suite's wall time
-    ("bectoken_batch", wl_bectoken, "states/sec", 1),
+    ("overflow_256bit", wl_overflow, "states/sec", 3),
+    ("wide_frontier", wl_wide_frontier, "states/sec", 3),
+    ("bectoken_batch", wl_bectoken, "states/sec", 3),
     ("concolic_flip", wl_concolic, "flips/sec", 3),
-    ("corpus_sweep", wl_corpus, "states/sec", 2),
+    ("corpus_sweep", wl_corpus, "states/sec", 3),
 ]
 
 
@@ -559,9 +558,21 @@ def main() -> None:
             "speedup": round(rates["production"] / rates["baseline"], 3)
             if rates["baseline"]
             else None,
+            "reps": reps,
+            # per-row spread: the honest error bars round 3 lacked
+            "spread": {
+                tag: [round(min(vals), 2), round(max(vals), 2)]
+                for tag, vals in samples.items()
+                if vals
+            },
             "ttfe_s": {
                 tag: (round(v, 3) if v is not None else None)
                 for tag, v in med_ttfe.items()
+            },
+            "ttfe_spread_s": {
+                tag: [round(min(vals), 3), round(max(vals), 3)]
+                for tag, vals in ttfes.items()
+                if vals
             },
             "device_residency_pct": dev_pct,
         }

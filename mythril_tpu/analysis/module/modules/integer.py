@@ -202,37 +202,64 @@ class IntegerArithmetics(DetectionModule):
         self._register(state, self._collect(offset))
 
     def _register(self, state: GlobalState, annotations: List[OverUnderflowAnnotation]) -> None:
+        """Park EVERY overflow annotation riding the sink value.
+
+        The reference collects all of them into a set and reports each
+        satisfiable one (integer.py:211-259) — parking only the first would
+        make WHICH site gets reported depend on annotation ordering, i.e.
+        on scheduling (caught by the cooperative differential test)."""
         if not annotations:
             return
         if self._cache_key(state) in self.cache:
             return
-        annotation = annotations[0]
-        ostate = annotation.overflowing_state
-        title = (
-            "Integer Underflow"
-            if annotation.operator == "subtraction"
-            else "Integer Overflow"
-        )
-        potential_issue = PotentialIssue(
-            contract=ostate.environment.active_account.contract_name,
-            function_name=ostate.node.function_name if ostate.node else "unknown",
-            address=ostate.get_current_instruction()["address"],
-            swc_id=INTEGER_OVERFLOW_AND_UNDERFLOW,
-            title=title,
-            severity="High",
-            bytecode=ostate.environment.code.bytecode,
-            description_head=f"The arithmetic operator can {'underflow' if annotation.operator == 'subtraction' else 'overflow'}.",
-            description_tail=(
-                "It is possible to cause an integer overflow or underflow in the "
-                "arithmetic operation. Prevent this by constraining inputs using "
-                "the require() statement or use the OpenZeppelin SafeMath library "
-                "for integer arithmetic operations. Refer to the transaction "
-                "sequence to see how the overflow can be triggered."
-            ),
-            detector=self,
-            constraints=[annotation.constraint],
-        )
-        get_potential_issues_annotation(state).potential_issues.append(potential_issue)
+        parked = get_potential_issues_annotation(state)
+
+        def _ckey(constraints):
+            return tuple(
+                c.raw.tid if hasattr(c, "raw") else id(c) for c in constraints
+            )
+
+        # key includes the constraint identity: two parks of the same site
+        # from different overflowing states carry DIFFERENT predicates, and
+        # only one of them may be satisfiable — dropping by address alone
+        # could park the unsatisfiable variant forever
+        seen = {
+            (p.address, p.title, _ckey(p.constraints))
+            for p in parked.potential_issues
+            if p.detector is self
+        }
+        for annotation in annotations:
+            ostate = annotation.overflowing_state
+            address = ostate.get_current_instruction()["address"]
+            title = (
+                "Integer Underflow"
+                if annotation.operator == "subtraction"
+                else "Integer Overflow"
+            )
+            key = (address, title, _ckey([annotation.constraint]))
+            if key in seen:
+                continue
+            seen.add(key)
+            potential_issue = PotentialIssue(
+                contract=ostate.environment.active_account.contract_name,
+                function_name=ostate.node.function_name if ostate.node else "unknown",
+                address=address,
+                swc_id=INTEGER_OVERFLOW_AND_UNDERFLOW,
+                title=title,
+                severity="High",
+                bytecode=ostate.environment.code.bytecode,
+                description_head=f"The arithmetic operator can {'underflow' if annotation.operator == 'subtraction' else 'overflow'}.",
+                description_tail=(
+                    "It is possible to cause an integer overflow or underflow in the "
+                    "arithmetic operation. Prevent this by constraining inputs using "
+                    "the require() statement or use the OpenZeppelin SafeMath library "
+                    "for integer arithmetic operations. Refer to the transaction "
+                    "sequence to see how the overflow can be triggered."
+                ),
+                detector=self,
+                constraints=[annotation.constraint],
+            )
+            parked.potential_issues.append(potential_issue)
 
 
 detector = IntegerArithmetics

@@ -79,14 +79,31 @@ def check_potential_issues(global_state: GlobalState) -> None:
     merged dispatch resolve most), so the per-issue exploit synthesis
     (model + input minimization) is paid only for the satisfiable ones."""
     annotation = get_potential_issues_annotation(global_state)
+    # the detector's (address, bytecode-hash) cache is the reference's
+    # dedup discipline (module/base.py:70-95, checked at analyze time);
+    # multiple paths park the same program point before the first
+    # confirmation lands, so re-check here — each duplicate skipped is a
+    # full exploit-synthesis solve saved
+    pending: List[PotentialIssue] = []
+    for p in annotation.potential_issues:
+        key = (p.address, get_bytecode_hash(p.bytecode))
+        if key in p.detector.cache:
+            continue
+        pending.append(p)
     unsolved: List[PotentialIssue] = []
-    gate = _gate_issues(global_state, annotation.potential_issues)
-    for potential_issue, feasible in zip(annotation.potential_issues, gate):
+    gate = _gate_issues(global_state, pending)
+    for potential_issue, feasible in zip(pending, gate):
         if not feasible:
             # an UNKNOWN here degrades exactly like a failed solve below:
             # the issue stays parked and is retried at a later tx end
             unsolved.append(potential_issue)
             continue
+        key = (
+            potential_issue.address,
+            get_bytecode_hash(potential_issue.bytecode),
+        )
+        if key in potential_issue.detector.cache:
+            continue  # confirmed earlier in this same sweep
         try:
             transaction_sequence = get_transaction_sequence(
                 global_state,
@@ -125,19 +142,6 @@ def get_bytecode_hash(bytecode) -> str:
     return get_code_hash(bytecode) if bytecode is not None else ""
 
 
-def _has_wide_mul(raws) -> bool:
-    """True when a term DAG contains a multiply wider than the native word
-    (the zext-mul overflow encoding): its bit-blast exceeds the CDCL clause
-    budget, so such issues take the full per-issue solve path instead of
-    poisoning the shared session blast."""
-    from mythril_tpu.smt import terms as T
-
-    return any(
-        t.op == "bvmul" and T.is_bv_sort(t.sort) and t.width > 256
-        for t in T.topo_order(raws)
-    )
-
-
 def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
     """sat/unsat gate over all parked issues at FULL solver budget.
 
@@ -169,19 +173,41 @@ def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
     # one enable-guarded conjunct per issue (land folds multi-term lists)
     from mythril_tpu.smt import terms as T
 
-    guarded, members = [], []
-    for i, raws in enumerate(issue_raws):
-        folded = T.land(*raws) if raws else T.boolval(True)
-        if _has_wide_mul([folded]):
-            continue  # full solve path; do not poison the shared blast
-        guarded.append(folded)
-        members.append(i)
-    if len(members) < 2:
+    # wide-mul overflow encodings included: the session blasts select
+    # congruence lazily (bb_extend refinement), so the Dadda 512-bit
+    # multiply no longer exceeds the clause budget — SWC-101 confirmations,
+    # the most expensive class, now share the gate like everything else.
+    # Should the full blast STILL overflow a budget, retry without the
+    # wide-mul members rather than losing the gate for every issue.
+    def _wide_mul(t) -> bool:
+        return any(
+            x.op == "bvmul" and T.is_bv_sort(x.sort) and x.width > 256
+            for x in T.topo_order([t])
+        )
+
+    folded_all = [
+        T.land(*raws) if raws else T.boolval(True) for raws in issue_raws
+    ]
+    attempts = [list(range(len(folded_all)))]
+    narrow = [i for i in attempts[0] if not _wide_mul(folded_all[i])]
+    if len(narrow) < len(folded_all):
+        attempts.append(narrow)
+    session = None
+    members: List[int] = []
+    for candidate_members in attempts:
+        if len(candidate_members) < 2:
+            return gate
+        try:
+            session = bitblast.OptimizeSession(
+                path_raws, guarded=[folded_all[i] for i in candidate_members]
+            )
+            members = candidate_members
+            break
+        except bitblast.Unsupported:
+            continue
+    if session is None:
         return gate
-    try:
-        session = bitblast.OptimizeSession(path_raws, guarded=guarded)
-    except bitblast.Unsupported:
-        return gate
+    guarded = [folded_all[i] for i in members]
     try:
         for gi, i in enumerate(members):
             # the OVERALL analysis deadline is re-read per query: one hard

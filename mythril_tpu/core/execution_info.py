@@ -50,6 +50,16 @@ class SolverStatsInfo(ExecutionInfo):
         }
 
 
+class CalibrationInfo(ExecutionInfo):
+    """Measured dispatch RTT and the break-evens rescaled from it."""
+
+    def as_dict(self) -> Dict:
+        from mythril_tpu.support.calibration import telemetry
+
+        cal = telemetry()
+        return {"calibration": cal} if cal else {}
+
+
 class FrontierStatsInfo(ExecutionInfo):
     """Where device-resident execution stopped and why (parks by opcode
     prioritize the next device handlers; see frontier/stats.py)."""

@@ -177,12 +177,17 @@ class MythrilAnalyzer:
                 sym = self._sym_exec(contract)
                 issues = fire_lasers(sym, modules or self.cmd_args.modules)
                 from mythril_tpu.core.execution_info import (
+                    CalibrationInfo,
                     EngineStatsInfo,
                     FrontierStatsInfo,
                     SolverStatsInfo,
                 )
 
-                execution_info = [EngineStatsInfo(sym.laser), SolverStatsInfo()]
+                execution_info = [
+                    EngineStatsInfo(sym.laser),
+                    SolverStatsInfo(),
+                    CalibrationInfo(),
+                ]
                 if args.frontier:
                     execution_info.append(FrontierStatsInfo())
             except KeyboardInterrupt:

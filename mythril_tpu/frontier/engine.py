@@ -261,6 +261,10 @@ class FrontierEngine:
         (JUMPIs) and no prior narrow-bail verdict on their codes."""
         if args.frontier_force:
             return True
+        # scale the break-evens to the measured link (no-op after first call)
+        from mythril_tpu.support.calibration import calibrate
+
+        calibrate()
         if len(pairs) >= self.caps.MIN_LIVE:
             return True
         codes = {id(s.environment.code): s.environment.code for _, s in pairs}

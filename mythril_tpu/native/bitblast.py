@@ -98,6 +98,13 @@ def _load():
         ]
         lib.bb_close.restype = None
         lib.bb_close.argtypes = [ctypes.c_void_p]
+        lib.bb_extend.restype = ctypes.c_int32
+        lib.bb_extend.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
         _lib = lib
     except OSError as e:
         log.warning("native library failed to load: %s", e)
@@ -552,7 +559,15 @@ class OptimizeSession:
         lib = _load()
         if lib is None:
             raise Unsupported("native library unavailable")
-        tape = serialize(conjuncts, extra=list(objectives) + list(guarded))
+        # select congruence is lazy here too: engine-scale conjunctions
+        # (wide-mul overflow encodings + hundreds of select sites) exceed
+        # the clause budget eagerly; violated pairs are appended to the
+        # LIVE session via bb_extend, keeping all learned clauses
+        tape = serialize(
+            conjuncts,
+            extra=list(objectives) + list(guarded),
+            lazy_selects=True,
+        )
         self._conjuncts = list(conjuncts)
         self._controls = []  # per objective: (m_node, width, {op: en_node})
         for i, obj in enumerate(objectives):
@@ -608,10 +623,38 @@ class OptimizeSession:
         """Solve under objective bounds [(obj_index, 'le'|'ge'|'eq', value)]
         and with the given guarded terms enabled (indices into ``guarded``).
 
-        Returns (status, assignment-or-None); SAT models are unvalidated
-        (caller validates with the exact evaluator, as for ``solve``)."""
+        Congruence-violating models trigger in-place refinement (violated
+        pairs appended to the live session via bb_extend) and a re-solve
+        within the same timeout.  Returns (status, assignment-or-None); SAT
+        models are congruence-clean but otherwise unvalidated (caller
+        validates with the exact evaluator, as for ``solve``)."""
+        import time as _time
+
         if self._handle is None:
             return UNKNOWN, None
+        deadline = _time.time() + timeout_s
+        for _round in range(_CEGAR_ROUNDS):
+            remaining = deadline - _time.time()
+            if remaining <= 0:
+                return UNKNOWN, None
+            status, asg, violations = self._solve_once(
+                bounds, remaining, enable
+            )
+            if status != SAT or not violations:
+                return status, asg
+            ext = self._extend_pairs(violations)
+            if ext == 0:
+                return UNSAT, None  # pair constraints closed the formula
+            if ext != 1:
+                return UNKNOWN, None
+        return UNKNOWN, None
+
+    def _solve_once(
+        self,
+        bounds: Sequence[Tuple[int, str, int]],
+        timeout_s: float,
+        enable: Sequence[int],
+    ):
         assume: List[int] = []
         for gi in enable:
             assume.append((self._guards[gi] << 16) | 1)
@@ -633,16 +676,60 @@ class OptimizeSession:
             len(model),
         )
         if status == 0:
-            return UNSAT, None
+            return UNSAT, None, ()
         if status != 1:
-            return UNKNOWN, None
+            return UNKNOWN, None, ()
         try:
-            # eager (distinctness-filtered) congruence: no violations possible
-            asg, _violations = _rebuild_assignment(self._tape, model.tobytes())
-            return SAT, asg
+            asg, violations = _rebuild_assignment(self._tape, model.tobytes())
+            return SAT, asg, violations
         except Exception as e:
             log.debug("session model reconstruction failed: %s", e)
-            return UNKNOWN, None
+            return UNKNOWN, None, ()
+
+    def _extend_pairs(self, violations) -> int:
+        """Append congruence constraints for the violated pairs to the live
+        native session.  The tape is append-only; only the delta records and
+        delta roots cross the boundary (const offsets stay valid because the
+        pair circuits reference existing nodes only).  Returns the bb_extend
+        status: 1 ok, 0 formula now unsat, -1 unusable."""
+        rec_mark = len(self._tape.records)
+        root_mark = len(self._tape.roots)
+        try:
+            for arr_tid, i, j in violations:
+                sites = self._tape.selects.get(arr_tid)
+                if not sites or i >= len(sites) or j >= len(sites):
+                    continue
+                idx_i, var_i, _ = sites[i]
+                idx_j, var_j, _ = sites[j]
+                _add_congruence_pair(
+                    self._tape, ([idx_i], var_i), ([idx_j], var_j)
+                )
+        except Unsupported as e:
+            # tape cap reached mid-refinement: the callers treat -1 as
+            # UNKNOWN and degrade; an exception here would abort the whole
+            # transaction-end issue sweep
+            log.debug("session refinement hit tape cap: %s", e)
+            return -1
+        n_new = len(self._tape.records) - rec_mark
+        new_roots = self._tape.roots[root_mark:]
+        if n_new == 0 and not new_roots:
+            return -1
+        delta = np.asarray(
+            self._tape.records[rec_mark:], dtype=np.int32
+        ).reshape(-1)
+        consts = np.frombuffer(
+            bytes(self._tape.consts) or b"\x00", dtype=np.uint8
+        )
+        roots = np.asarray(new_roots, dtype=np.int32)
+        return self._lib.bb_extend(
+            self._handle,
+            delta.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_new,
+            consts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(consts),
+            roots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(roots),
+        )
 
     def close(self) -> None:
         if self._handle is not None:

@@ -111,6 +111,10 @@ class Solver {
     return ok_;
   }
 
+  // Return to decision level 0 so clauses can be added after a solve()
+  // left the trail at a satisfying (or partial) assignment.
+  void reset_root() { backtrack(0); }
+
   // status: 1 sat, 0 unsat (w.r.t. assumptions when given), -1 budget
   // exceeded.  Assumptions are decided first, MiniSat-style (each on its
   // own level; already-true ones get a dummy level) — learned clauses are
@@ -719,17 +723,24 @@ struct Blasted {
   int status = 1;  // 1 usable, 0 globally unsat, -1 unsupported
 };
 
-// Fills b.val / b.solver from the tape; returns 1 ok, 0 unsat, -1 unsupported.
-static int blast(Blasted& b, const int32_t* tape, int64_t n_nodes,
-                 const uint8_t* consts, const int32_t* roots, int64_t n_roots) {
+// Appends `n_new` records to b (argument indices may reference any node
+// below the new total) and asserts `roots`; returns 1 ok, 0 unsat, -1
+// unsupported.  Called with an empty Blasted this is the original full
+// blast; called again via bb_extend it grows an open session in place
+// (CEGAR congruence refinement) while keeping all learned clauses.
+static int blast_append(Blasted& b, const int32_t* tape, int64_t n_new,
+                        const uint8_t* consts, const int32_t* roots,
+                        int64_t n_roots) {
   Solver& solver = b.solver;
   Circuit cir(solver);
-  b.val.assign(n_nodes, {});
-  b.tape.assign(tape, tape + n_nodes * REC);
-  b.n_nodes = n_nodes;
+  const int64_t base = b.n_nodes;
+  b.val.resize(base + n_new);
+  b.tape.insert(b.tape.end(), tape, tape + n_new * REC);
+  b.n_nodes = base + n_new;
   std::vector<Circuit::BV>& val = b.val;
-  for (int64_t i = 0; i < n_nodes; i++) {
-    const int32_t* r = tape + i * REC;
+  for (int64_t ii = 0; ii < n_new; ii++) {
+    const int64_t i = base + ii;
+    const int32_t* r = tape + ii * REC;
     int32_t op = r[0], w = r[1], a0 = r[2], a1 = r[3], a2 = r[4], x0 = r[5],
             x1 = r[6];
     auto A = [&](int32_t k) -> const Circuit::BV& { return val[k]; };
@@ -860,6 +871,11 @@ static int blast(Blasted& b, const int32_t* tape, int64_t n_nodes,
   return 1;
 }
 
+static int blast(Blasted& b, const int32_t* tape, int64_t n_nodes,
+                 const uint8_t* consts, const int32_t* roots, int64_t n_roots) {
+  return blast_append(b, tape, n_nodes, consts, roots, n_roots);
+}
+
 // Pack VAR models in tape order; returns 1, or -1 if model_cap is short.
 static int pack_model(const Blasted& b, uint8_t* model_out, int64_t model_cap) {
   int64_t off = 0;
@@ -928,6 +944,24 @@ void* bb_open(const int32_t* tape, int64_t n_nodes, const uint8_t* consts,
     return nullptr;
   }
   return b;
+}
+
+// Grow an open session in place: append `n_new` records (congruence pairs
+// over EXISTING nodes; no OP_CONST/OP_VAR expected but both are handled)
+// and assert `roots`.  Learned clauses persist — they are consequences of
+// the original CNF and adding clauses cannot invalidate them.  Returns 1
+// ok, 0 formula now unsat, -1 unusable.
+int32_t bb_extend(void* handle, const int32_t* tape, int64_t n_new,
+                  const uint8_t* consts, int64_t consts_len,
+                  const int32_t* roots, int64_t n_roots) {
+  (void)consts_len;
+  Blasted* b = static_cast<Blasted*>(handle);
+  if (b == nullptr || b->status == -1) return -1;
+  if (b->status == 0) return 0;
+  b->solver.reset_root();
+  int st = blast_append(*b, tape, n_new, consts, roots, n_roots);
+  if (st != 1) b->status = st;
+  return st;
 }
 
 int32_t bb_solve_assume(void* handle, const int64_t* assume, int64_t n_assume,

@@ -536,6 +536,9 @@ def _device_worthwhile(conjuncts: Sequence[Term], n_candidates: int) -> bool:
     backend = getattr(global_args, "probe_backend", "auto")
     if backend == "jax":
         return True
+    from mythril_tpu.support.calibration import calibrate
+
+    calibrate()  # scale the threshold to the measured link (memoized)
     key = frozenset(c.tid for c in conjuncts)
     size = _topo_size_cache.get(key)
     if size is None:

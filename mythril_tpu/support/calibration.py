@@ -1,0 +1,102 @@
+"""Dispatch-RTT calibration: scale device break-evens to the actual link.
+
+The frontier/probe break-even constants were hand-tuned on a ~100ms-RTT
+tunneled TPU (ROADMAP round-3 note): ``device_probe_threshold`` (the
+DAG-size x candidates product above which a probe dispatch beats host
+evaluation) and the narrow-gate static-JUMPI floor both encode that link
+latency.  On an untunneled chip the round trip is ~50x cheaper and the same
+constants under-sell the device; on a slower link they over-dispatch.
+
+This module measures the real dispatch round trip ONCE (tiny jitted add,
+median of three timed runs after a warmup) the first time a device decision
+is taken, and rescales the defaults linearly in RTT around the tuned
+anchor.  User-overridden values are left alone.  The measurement is
+reported in the jsonv2 meta (``mythril_execution_info.calibration``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+# the link the hand-tuned constants were measured on
+_ANCHOR_RTT_MS = 100.0
+_ANCHOR_PROBE_THRESHOLD = 600_000
+_ANCHOR_MIN_STATIC_JUMPIS = 8
+
+_state: Dict = {"done": False, "rtt_ms": None, "applied": {}}
+
+
+def measure_dispatch_rtt_ms() -> Optional[float]:
+    """Median round trip of a tiny device dispatch, in milliseconds.
+
+    Returns None when no accelerator platform is configured (never
+    initializes a backend just to measure it)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not platforms.startswith(("tpu", "axon")):
+        return None
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.zeros((8,), jnp.int32)
+        f(x).block_until_ready()  # compile outside the timed runs
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            f(x).block_until_ready()
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        samples.sort()
+        return samples[1]
+    except Exception as e:  # pragma: no cover - device-env dependent
+        log.debug("RTT calibration failed: %s", e)
+        return None
+
+
+def calibrate() -> Dict:
+    """Measure once and rescale un-overridden break-evens; idempotent.
+
+    Returns the telemetry dict (empty when calibration did not run)."""
+    if _state["done"]:
+        return _state["applied"]
+    _state["done"] = True
+    rtt = measure_dispatch_rtt_ms()
+    _state["rtt_ms"] = rtt
+    if rtt is None:
+        return {}
+    from mythril_tpu.frontier import engine as frontier_engine
+    from mythril_tpu.support.support_args import args
+
+    scale = rtt / _ANCHOR_RTT_MS
+    applied: Dict = {"dispatch_rtt_ms": round(rtt, 2)}
+    if args.device_probe_threshold == _ANCHOR_PROBE_THRESHOLD:
+        new_threshold = int(
+            min(5_000_000, max(20_000, _ANCHOR_PROBE_THRESHOLD * scale))
+        )
+        args.device_probe_threshold = new_threshold
+        applied["device_probe_threshold"] = new_threshold
+    if frontier_engine._MIN_STATIC_JUMPIS == _ANCHOR_MIN_STATIC_JUMPIS:
+        new_jumpis = int(min(16, max(2, round(_ANCHOR_MIN_STATIC_JUMPIS * scale))))
+        frontier_engine._MIN_STATIC_JUMPIS = new_jumpis
+        applied["min_static_jumpis"] = new_jumpis
+    _state["applied"] = applied
+    log.info("device calibration: %s", applied)
+    return applied
+
+
+def telemetry() -> Dict:
+    """Calibration info for report meta (without forcing a measurement).
+
+    Empty both when calibration never ran AND when it ran without an
+    accelerator (rtt None) — a ``{"dispatch_rtt_ms": null}`` block would be
+    noise every consumer has to null-check."""
+    if not _state["done"] or _state["rtt_ms"] is None:
+        return {}
+    out = {"dispatch_rtt_ms": _state["rtt_ms"]}
+    out.update({k: v for k, v in _state["applied"].items() if k != "dispatch_rtt_ms"})
+    return out

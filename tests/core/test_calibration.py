@@ -1,0 +1,93 @@
+"""Dispatch-RTT calibration: scaling math, idempotence, override respect."""
+
+import mythril_tpu.support.calibration as cal
+from mythril_tpu.frontier import engine as frontier_engine
+from mythril_tpu.support.support_args import args
+
+
+def _fresh_state():
+    cal._state.clear()
+    cal._state.update({"done": False, "rtt_ms": None, "applied": {}})
+
+
+def _with_rtt(monkeypatch, rtt):
+    monkeypatch.setattr(cal, "measure_dispatch_rtt_ms", lambda: rtt)
+
+
+def test_no_platform_is_noop(monkeypatch):
+    _fresh_state()
+    _with_rtt(monkeypatch, None)
+    assert cal.calibrate() == {}
+    assert cal.telemetry() == {}
+
+
+def test_fast_link_lowers_breakevens(monkeypatch):
+    _fresh_state()
+    _with_rtt(monkeypatch, 2.0)  # local chip: ~2ms round trip
+    old_thresh = args.device_probe_threshold
+    old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    try:
+        applied = cal.calibrate()
+        assert applied["dispatch_rtt_ms"] == 2.0
+        # 600k * (2/100) = 12k, floored at 20k
+        assert applied["device_probe_threshold"] == 20_000
+        assert applied["min_static_jumpis"] == 2
+        assert args.device_probe_threshold == 20_000
+        assert frontier_engine._MIN_STATIC_JUMPIS == 2
+    finally:
+        args.device_probe_threshold = old_thresh
+        frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        _fresh_state()
+
+
+def test_anchor_link_keeps_defaults(monkeypatch):
+    _fresh_state()
+    _with_rtt(monkeypatch, 100.0)
+    old_thresh = args.device_probe_threshold
+    old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    try:
+        applied = cal.calibrate()
+        assert applied.get("device_probe_threshold") == 600_000
+        assert applied.get("min_static_jumpis") == 8
+    finally:
+        args.device_probe_threshold = old_thresh
+        frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        _fresh_state()
+
+
+def test_user_override_untouched(monkeypatch):
+    _fresh_state()
+    _with_rtt(monkeypatch, 2.0)
+    old_thresh = args.device_probe_threshold
+    old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    args.device_probe_threshold = 123_456  # user-set: must not be rescaled
+    try:
+        applied = cal.calibrate()
+        assert "device_probe_threshold" not in applied
+        assert args.device_probe_threshold == 123_456
+    finally:
+        args.device_probe_threshold = old_thresh
+        frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        _fresh_state()
+
+
+def test_idempotent(monkeypatch):
+    _fresh_state()
+    calls = []
+
+    def fake():
+        calls.append(1)
+        return 50.0
+
+    monkeypatch.setattr(cal, "measure_dispatch_rtt_ms", fake)
+    old_thresh = args.device_probe_threshold
+    old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    try:
+        first = cal.calibrate()
+        second = cal.calibrate()
+        assert first == second
+        assert len(calls) == 1
+    finally:
+        args.device_probe_threshold = old_thresh
+        frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        _fresh_state()
