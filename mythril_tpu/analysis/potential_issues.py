@@ -10,6 +10,7 @@ annotation's search_importance (10 x #issues) steers beam search (:61-62).
 from __future__ import annotations
 
 import logging
+from functools import lru_cache
 from typing import List
 
 from mythril_tpu.analysis.report import Issue
@@ -136,10 +137,21 @@ def check_potential_issues(global_state: GlobalState) -> None:
     annotation.potential_issues = unsolved
 
 
-def get_bytecode_hash(bytecode) -> str:
+@lru_cache(maxsize=512)
+def _code_hash_memo(bytecode) -> str:
     from mythril_tpu.support.support_utils import get_code_hash
 
-    return get_code_hash(bytecode) if bytecode is not None else ""
+    return get_code_hash(bytecode)
+
+
+def get_bytecode_hash(bytecode) -> str:
+    # every tx-end sweep keys each parked issue by this hash; keccak over
+    # the full runtime bytecode is far too expensive to recompute per issue
+    if bytecode is None:
+        return ""
+    return _code_hash_memo(
+        bytecode if isinstance(bytecode, (str, bytes)) else str(bytecode)
+    )
 
 
 def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):

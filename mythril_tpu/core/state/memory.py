@@ -59,6 +59,21 @@ class Memory:
             value = Extract(7, 0, value)
         self._memory[self._key(index)] = value
 
+    def __len__(self) -> int:
+        return len(self._memory)
+
+    def concrete_addresses(self):
+        """Sorted concrete byte addresses of every written byte, or None if
+        any index is symbolic (used by the frontier's mid-frame encoder to
+        decide whether this memory can be packed into device entries)."""
+        out = []
+        for key in self._memory:
+            if key.is_const:
+                out.append(key.value)
+            else:
+                return None
+        return sorted(out)
+
     def get_word_at(self, index) -> BitVec:
         """Big-endian 32-byte word at byte offset ``index``."""
         if isinstance(index, int):

@@ -262,12 +262,12 @@ class LaserEVM:
                 final_states.append(global_state)
             # nested frontier segments (SURVEY.md §7.4 item 4): inner
             # message-call frames pushed by the CALL-family handlers are
-            # fresh pc=0 seeds — periodically hand them to the device (the
-            # engine's own width gate decides whether a drain pays)
+            # fresh pc=0 seeds, and mid-frame states (resumed callers,
+            # earlier spills) re-enter via the engine's mid-frame encoder —
+            # periodically hand them to the device (the engine's own width
+            # gate decides whether a drain pays)
             iteration += 1
-            pending_seeds += sum(
-                1 for s in new_states if s.mstate.pc == 0 and not s.mstate.stack
-            )
+            pending_seeds += len(new_states)
             if frontier_live and pending_seeds and iteration % 8 == 0:
                 pending_seeds = 0
                 try:

@@ -158,25 +158,67 @@ def _beam_importance(gs) -> int:
         return 0
 
 
-def _eligible(gs) -> bool:
-    """Seed states the device can take: fresh message-call frames (pc 0,
-    empty stack).  This deliberately includes INNER call frames — the
-    nested-frontier drains in svm.exec rely on callee frames created by the
-    CALL-family handlers passing this predicate, and the walker resumes
-    their callers at E_TERMINAL replay (walker.py)."""
+def _frame_ok(gs) -> bool:
     from mythril_tpu.core.transaction.transaction_models import (
         MessageCallTransaction,
     )
 
+    return (
+        isinstance(gs.current_transaction, MessageCallTransaction)
+        and gs.environment.code is not None
+        and len(gs.environment.code.instruction_list) > 0
+        and not gs.environment.static
+    )
+
+
+def _is_fresh(gs) -> bool:
+    return gs.mstate.pc == 0 and not gs.mstate.stack
+
+
+# leave headroom below the device caps: an injected state that starts near a
+# cap would park within a few instructions and bounce host<->device
+_MID_STACK_MAX = Caps.STK - 12
+_MID_MEM_MAX = Caps.MEM - 12
+
+
+def _mid_eligible(gs) -> bool:
+    """Mid-frame states the device can RE-ENTER: pc > 0 with a bounded
+    stack and concretely-addressed memory — resumed callers after inner
+    calls, batch-full spills, timeout/arena bulk parks (reference engine
+    continues ANY state, svm.py:261-304; round-3 frontier only admitted
+    fresh frames so every park left the device permanently).
+
+    States the device parked for a SEMANTIC reason (symbolic memory
+    addressing, unsupported opcode, cap overflow mid-instruction) carry
+    ``_frontier_park_pc``; while still AT that pc they would re-park on the
+    first device step, so the host must advance them past it first."""
+    if getattr(gs, "_frontier_park_pc", None) == gs.mstate.pc:
+        return False
+    if len(gs.mstate.stack) > _MID_STACK_MAX:
+        return False
+    if gs.mstate.pc >= len(gs.environment.code.instruction_list):
+        return False
+    if len(gs.mstate.memory) > _MID_MEM_MAX * 32:
+        return False
+    addrs = gs.mstate.memory.concrete_addresses()
+    if addrs is None:
+        # symbolic memory addressing blocks the device AT this pc: stamp so
+        # every subsequent drain skips the O(M log M) memory walk until the
+        # host engine has advanced the state (fresh copies drop the stamp)
+        gs._frontier_park_pc = gs.mstate.pc
+        return False
+    return True
+
+
+def _eligible(gs) -> bool:
+    """Seed states the device can take: fresh message-call frames (pc 0,
+    empty stack) — including INNER call frames, which the nested-frontier
+    drains in svm.exec rely on — plus re-entrant mid-frame states (see
+    ``_mid_eligible``)."""
     try:
-        return (
-            gs.mstate.pc == 0
-            and not gs.mstate.stack
-            and isinstance(gs.current_transaction, MessageCallTransaction)
-            and gs.environment.code is not None
-            and len(gs.environment.code.instruction_list) > 0
-            and not gs.environment.static
-        )
+        if not _frame_ok(gs):
+            return False
+        return _is_fresh(gs) or _mid_eligible(gs)
     except Exception:
         return False
 
@@ -338,6 +380,61 @@ class FrontierEngine:
         st.code_id[slot] = code_idx
         st.score[slot] = score
 
+    def _encode_mid(self, arena: HostArena, gs) -> Optional[dict]:
+        """Pack a mid-frame host state for device re-entry, or None.
+
+        Stack words (symbolic included) become arena rows; concretely
+        addressed memory is regrouped into the device's disjoint 32-byte
+        word entries.  Loop counters start at zero — a re-entered path may
+        re-run up to loop_bound extra iterations before the device bound
+        trips (bounded, and the host bounded-loops strategy still applies
+        to whatever parks back).  Gas starts at zero on device: the walker
+        reports seed-relative totals via its per-seed gas_base."""
+        try:
+            stack_rows = [arena.encode(v.raw) for v in gs.mstate.stack]
+            addrs = gs.mstate.memory.concrete_addresses()
+            if addrs is None:
+                return None
+            windows = []
+            i, n = 0, len(addrs)
+            while i < n:
+                start = addrs[i]
+                if i + 32 > n or addrs[i : i + 32] != list(
+                    range(start, start + 32)
+                ):
+                    return None  # partial word: the entry model can't hold it
+                windows.append(start)
+                i += 32
+            if len(windows) > _MID_MEM_MAX:
+                return None
+            mem_pairs = [
+                (a, arena.encode(gs.mstate.memory.get_word_at(a).raw))
+                for a in windows
+            ]
+            return {
+                "pc": int(gs.mstate.pc),
+                "stack": stack_rows,
+                "mem": mem_pairs,
+                "mem_size": int(getattr(gs.mstate, "memory_size", 0) or 0),
+                "depth": int(getattr(gs.mstate, "depth", 0) or 0),
+            }
+        except Exception as e:
+            log.debug("mid-frame encode failed: %s", e)
+            return None
+
+    @staticmethod
+    def _apply_mid(st: FrontierState, slot: int, enc: dict) -> None:
+        st.pc[slot] = enc["pc"]
+        for k, row in enumerate(enc["stack"]):
+            st.stack[slot, k] = row
+        st.stack_len[slot] = len(enc["stack"])
+        for k, (addr, row) in enumerate(enc["mem"]):
+            st.mem_addr[slot, k] = addr
+            st.mem_val[slot, k] = row
+        st.mem_len[slot] = len(enc["mem"])
+        st.mem_size[slot] = enc["mem_size"]
+        st.depth[slot] = enc["depth"]
+
     # ------------------------------------------------------------------
 
     def _run(self, pairs: List[Tuple],
@@ -409,11 +506,32 @@ class FrontierEngine:
         # seed contexts (also fills the arena with env rows)
         ctxs = [self._seed_ctx(arena, gs, i) for i, gs in enumerate(seeds)]
 
+        # mid-frame seeds (resumed callers, earlier spills) are encoded up
+        # front; any the encoder rejects bounce straight back to their host
+        # work list (eligibility is a cheap pre-filter, the encoder decides)
+        mid_enc: List[Optional[dict]] = []
+        bounced = set()
+        for i, gs in enumerate(seeds):
+            if _is_fresh(gs):
+                mid_enc.append(None)
+                continue
+            enc = self._encode_mid(arena, gs)
+            mid_enc.append(enc)
+            if enc is None:
+                FrontierStatistics().mid_encode_failures += 1
+                # stamp so _mid_eligible stops re-offering this state at
+                # every drain while it sits at the same pc.  The work-list
+                # re-append happens at the END of the run: _drain_pairs'
+                # exception handler re-appends every pair, so appending here
+                # would duplicate the state if the run later failed.
+                gs._frontier_park_pc = gs.mstate.pc
+                bounced.add(i)
+
         walker = Walker(seed_lasers, arena,
                         [tables[ci] for ci in seed_code_idx], seeds)
         st = empty_state(caps, loops_cap)
         records: Dict[int, Optional[PathRecord]] = {i: None for i in range(caps.B)}
-        seed_queue = list(range(len(seeds)))
+        seed_queue = [i for i in range(len(seeds)) if i not in bounced]
         ev_seen = np.zeros(caps.B, np.int64)
 
         from mythril_tpu.frontier import step as step_mod
@@ -427,6 +545,9 @@ class FrontierEngine:
             si = seed_queue.pop(0)
             self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
                          _beam_importance(seeds[si]) if beam else 0)
+            if mid_enc[si] is not None:
+                self._apply_mid(st, slot, mid_enc[si])
+                FrontierStatistics().mid_injections += 1
             records[slot] = PathRecord(seed_idx=si)
             ev_seen[slot] = 0
 
@@ -544,6 +665,9 @@ class FrontierEngine:
                     si = seed_queue.pop(0)
                     self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
                                  _beam_importance(seeds[si]) if beam else 0)
+                    if mid_enc[si] is not None:
+                        self._apply_mid(st, slot, mid_enc[si])
+                        FrontierStatistics().mid_injections += 1
                     records[slot] = PathRecord(seed_idx=si)
                     ev_seen[slot] = 0
                 elif beam and rec is not None:
@@ -586,6 +710,8 @@ class FrontierEngine:
         visited_host = np.asarray(visited)
         for ci, (laser, code) in enumerate(zip(table_laser, table_code)):
             self._merge_coverage(visited_host[ci], tables[ci], code, laser)
+        for i in bounced:
+            seed_lasers[i].work_list.append(seeds[i])
         return executed
 
     @staticmethod
@@ -691,6 +817,10 @@ class FrontierEngine:
                 pc = int(rec.final["pc"])
                 names = walker.tables_for(rec).opcode_names
                 stats.record_park(names[pc] if pc < len(names) else "?")
+                # semantic park: re-injecting at this pc would immediately
+                # re-park — the walker stamps the carrier so _mid_eligible
+                # holds it host-side until the host steps past the pc
+                rec.final["semantic_park"] = True
             try:
                 walker.finish(rec)
             except Exception as e:  # pragma: no cover - diagnostics

@@ -28,6 +28,8 @@ class FrontierStatistics(metaclass=Singleton):
         self.segment_s = 0.0  # wall time in segment dispatch + state pull
         self.harvest_s = 0.0  # wall time in host-side harvest
         self.mesh_devices = 0  # >0: segments ran path-sharded over a mesh
+        self.mid_injections = 0  # mid-frame states re-entered on device
+        self.mid_encode_failures = 0  # mid-frame seeds bounced at encoding
 
     def record_park(self, opcode: str) -> None:
         self.parks_by_opcode[opcode] += 1
@@ -45,6 +47,8 @@ class FrontierStatistics(metaclass=Singleton):
             "mesh_devices": self.mesh_devices,
             "segment_s": round(self.segment_s, 3),
             "harvest_s": round(self.harvest_s, 3),
+            "mid_injections": self.mid_injections,
+            "mid_encode_failures": self.mid_encode_failures,
             "parks_by_opcode": dict(self.parks_by_opcode.most_common()),
             "parks_by_reason": dict(self.parks_by_reason.most_common()),
         }

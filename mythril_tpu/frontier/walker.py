@@ -338,6 +338,11 @@ class Walker:
             self._set_gas(carrier, rec.seed_idx, snap["gas_min"], snap["gas_max"])
             carrier.mstate.depth = snap["depth"]
             carrier.mstate.memory_size = snap["mem_size"]
+            if snap.get("semantic_park"):
+                # the device provably cannot execute THIS instruction:
+                # engine._mid_eligible keeps the state host-side until the
+                # host engine advances it past the parking pc
+                carrier._frontier_park_pc = snap["pc"]
             self.laser_for(rec).work_list.append(carrier)
             return
         log.warning("unhandled halt kind %d", halt)

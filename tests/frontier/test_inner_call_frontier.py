@@ -104,3 +104,9 @@ def test_inner_call_frame_runs_on_device_with_host_parity():
     # spill) and its terminal to resume the caller correctly
     assert any(i.swc_id == "106" for i in dev), "inner selfdestruct lost"
     assert stats["device_instructions"] > 0, "frontier never engaged"
+    # mid-frame re-entry: the RESUMED caller (pc past the CALL, stack and
+    # memory populated) must itself execute device instructions — round 3
+    # left every resumed/parked state host-side forever
+    assert stats["mid_injections"] > 0, (
+        f"no mid-frame state re-entered the device: {stats}"
+    )
