@@ -355,7 +355,24 @@ CORPUS_RECALL = {
     "ether_send.sol.o": "105",
     "origin.sol.o": "115",
     "exceptions.sol.o": "110",
+    # hand-assembled real exploit shapes (bench_contracts.py): the
+    # etherstore reentrancy window and rubixi's ownership-takeover drain
+    # run as ordinary corpus members in BOTH schedulings
+    "etherstore.asm": "107",
+    "rubixi.asm": "105",
 }
+
+
+def _assembled_corpus():
+    """Real-shape members assembled in-repo (no solc in the image):
+    (name, runtime bytecode) pairs matching the reference contracts at
+    /root/reference/solidity_examples/{etherstore,rubixi}.sol."""
+    from bench_contracts import etherstore_like, rubixi_like
+
+    return [
+        ("etherstore.asm", etherstore_like()),
+        ("rubixi.asm", rubixi_like()),
+    ]
 
 
 _corpus_warmed = False
@@ -388,6 +405,8 @@ def wl_corpus(production: bool):
 
         mine = shard_corpus([str(p) for p in corpus])
         jobs = [(Path(p).name, _read_runtime(Path(p))) for p in mine]
+        if shard_identity()[0] == 0:
+            jobs += _assembled_corpus()
         old_width = global_args.frontier_width
         global_args.frontier_width = 256
         try:
@@ -433,9 +452,19 @@ def wl_corpus(production: bool):
 
         t0 = time.time()
         results = run_corpus([str(p) for p in corpus], analyze_one)
+        findings = [(Path(p).name, res) for p, res in results]
+        # the assembled real shapes run sequentially here exactly like the
+        # file-backed members do (one contract at a time, the reference's
+        # corpus flow); shard 0 only, mirroring the production branch
+        assembled = _assembled_corpus() if shard_identity()[0] == 0 else []
+        for name, code in assembled:
+            _clear_caches()
+            sym, issues = _analyze(code, 0x0901D12E, 2, timeout=60)
+            totals["states"] += sym.laser.total_states
+            issue_lists[name] = issues
+            findings.append((name, {i.swc_id for i in issues}))
         wall = time.time() - t0
         states = totals["states"]
-        findings = [(Path(p).name, res) for p, res in results]
         all_issues = [i for iss in issue_lists.values() for i in iss]
 
     _idx, cnt = shard_identity()

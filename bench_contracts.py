@@ -44,7 +44,7 @@ class Asm:
         "CALLDATASIZE": 0x36, "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52,
         "SLOAD": 0x54, "SSTORE": 0x55, "JUMP": 0x56, "JUMPI": 0x57,
         "JUMPDEST": 0x5B, "GAS": 0x5A, "CALL": 0xF1, "RETURN": 0xF3,
-        "SELFDESTRUCT": 0xFF, "REVERT": 0xFD,
+        "SELFDESTRUCT": 0xFF, "REVERT": 0xFD, "TIMESTAMP": 0x42,
     }
 
     def __init__(self):
@@ -282,4 +282,130 @@ def bectoken_like() -> bytes:
     a.push(0).push(SLOT_PAUSED).op("SSTORE")
     _return_one(a)
 
+    return a.assemble()
+
+
+# ---------------------------------------------------------------------------
+# EtherStore: the canonical reentrancy shape
+# (/root/reference/solidity_examples/etherstore.sol, SWC-107)
+# ---------------------------------------------------------------------------
+
+ES_SLOT_LIMIT = 0  # withdrawalLimit
+ES_SLOT_LASTTIME = 1  # mapping(address => uint256) lastWithdrawTime
+ES_SLOT_BALANCES = 2  # mapping(address => uint256) balances
+
+SEL_DEPOSIT = selector("depositFunds()")
+SEL_WITHDRAW = selector("withdrawFunds(uint256)")
+
+
+def etherstore_like() -> bytes:
+    """EtherStore's withdrawFunds: three requires, then an external CALL to
+    ``msg.sender`` carrying value BEFORE the balance decrement — the
+    textbook reentrancy window (etherstore.sol:14-24).  Detected as
+    SWC-107 (external call to user-supplied address / state change after
+    call)."""
+    a = Asm()
+    a.push(0).op("CALLDATALOAD").push(0xE0).op("SHR")
+    for sel, lbl in ((SEL_DEPOSIT, "deposit"), (SEL_WITHDRAW, "withdraw")):
+        a.op("DUP1").push(sel).op("EQ").jumpi(lbl)
+    a.revert()
+
+    # ---- depositFunds(): balances[caller] += callvalue ----
+    a.label("deposit")
+    a.op("CALLER")
+    _mapping_slot(a, ES_SLOT_BALANCES)  # [slot_b]
+    a.op("DUP1", "SLOAD")  # [slot_b, bal]
+    a.op("CALLVALUE", "ADD")  # [slot_b, bal+value]  (0.5.0: unchecked +=)
+    a.op("SWAP1", "SSTORE")
+    a.op("STOP")
+
+    # ---- withdrawFunds(uint256 amt) ----
+    a.label("withdraw")
+    _arg(a, 0)  # [amt]
+    # require(balances[caller] >= amt)
+    a.op("CALLER")
+    _mapping_slot(a, ES_SLOT_BALANCES)  # [amt, slot_b]
+    a.op("DUP1", "SLOAD")  # [amt, slot_b, bal]
+    a.op("DUP3", "GT", "ISZERO")  # not(amt > bal)
+    _require(a, "w_bal")  # [amt, slot_b]
+    # require(amt <= withdrawalLimit)
+    a.push(ES_SLOT_LIMIT).op("SLOAD")  # [amt, slot_b, limit]
+    a.op("DUP3", "GT", "ISZERO")  # not(amt > limit)
+    _require(a, "w_lim")  # [amt, slot_b]
+    # require(now >= lastWithdrawTime[caller] + 1 weeks)
+    a.op("CALLER")
+    _mapping_slot(a, ES_SLOT_LASTTIME)
+    a.op("SLOAD")  # [amt, slot_b, last]
+    a.push(604800).op("ADD")  # [amt, slot_b, last+1w]
+    a.op("TIMESTAMP", "LT", "ISZERO")  # not(now < last+1w)
+    _require(a, "w_time")  # [amt, slot_b]
+    # caller.call.value(amt)("") — the reentrancy window
+    a.push(0).push(0).push(0).push(0)  # out_sz out_off in_sz in_off
+    a.op("DUP6")  # value = amt
+    a.op("CALLER", "GAS", "CALL")  # [amt, slot_b, success]
+    _require(a, "w_ok")  # [amt, slot_b]
+    # balances[caller] -= amt   (STATE CHANGE AFTER THE CALL)
+    a.op("DUP1", "SLOAD")  # [amt, slot_b, bal]
+    a.op("DUP3", "SWAP1", "SUB")  # [amt, slot_b, bal-amt]
+    a.op("DUP2", "SSTORE")  # [amt, slot_b]
+    # lastWithdrawTime[caller] = now
+    a.op("TIMESTAMP", "CALLER")  # [amt, slot_b, ts, caller]
+    _mapping_slot(a, ES_SLOT_LASTTIME)  # [amt, slot_b, ts, slot_t]
+    a.op("SSTORE")
+    a.op("STOP")
+    return a.assemble()
+
+
+# ---------------------------------------------------------------------------
+# Rubixi: the constructor-name ownership takeover
+# (/root/reference/solidity_examples/rubixi.sol, SWC-105 via dynamicPyramid)
+# ---------------------------------------------------------------------------
+
+RX_SLOT_FEES = 1  # collectedFees
+RX_SLOT_CREATOR = 5  # creator
+
+SEL_DYNAMIC_PYRAMID = selector("dynamicPyramid()")
+SEL_COLLECT_ALL = selector("collectAllFees()")
+
+
+def rubixi_like() -> bytes:
+    """Rubixi's famous bug: ``dynamicPyramid()`` was the constructor name
+    of an earlier revision, left public and unguarded (rubixi.sol:29-31) —
+    anyone calls it to become ``creator`` and then drains fees through
+    ``collectAllFees`` (rubixi.sol:36-40).  Detected as SWC-105
+    (unprotected ether withdrawal: 2-tx takeover then drain)."""
+    a = Asm()
+    a.push(0).op("CALLDATALOAD").push(0xE0).op("SHR")
+    for sel, lbl in (
+        (SEL_DYNAMIC_PYRAMID, "pyramid"),
+        (SEL_COLLECT_ALL, "collect"),
+    ):
+        a.op("DUP1").push(sel).op("EQ").jumpi(lbl)
+    # fallback: init() — collectedFees += callvalue / 10
+    a.push(RX_SLOT_FEES).op("SLOAD")  # [fees]
+    a.push(10).op("CALLVALUE", "DIV", "ADD")  # [fees + value/10]
+    a.push(RX_SLOT_FEES).op("SSTORE")
+    a.op("STOP")
+
+    # ---- dynamicPyramid(): creator = msg.sender  (NO GUARD — the bug) ----
+    a.label("pyramid")
+    a.op("CALLER")
+    a.push(RX_SLOT_CREATOR).op("SSTORE")
+    a.op("STOP")
+
+    # ---- collectAllFees() [onlyowner]: creator.transfer(collectedFees) ----
+    a.label("collect")
+    a.push(RX_SLOT_CREATOR).op("SLOAD", "CALLER", "EQ")
+    _require(a, "c_own")
+    a.push(RX_SLOT_FEES).op("SLOAD")  # [fees]
+    a.op("DUP1")
+    a.push(0).op("LT")  # 0 < fees
+    _require(a, "c_pos")  # [fees]
+    a.push(0).push(0).push(0).push(0)
+    a.op("DUP5")  # value = fees
+    a.push(RX_SLOT_CREATOR).op("SLOAD")  # to = creator
+    a.op("GAS", "CALL")  # [fees, success]
+    _require(a, "c_ok")
+    a.push(0).push(RX_SLOT_FEES).op("SSTORE")  # collectedFees = 0
+    a.op("STOP")
     return a.assemble()

@@ -52,8 +52,11 @@ class DetectionModule:
             self.cache.add((issue.address, issue.bytecode_hash))
 
     def _cache_key(self, state: GlobalState) -> Tuple[int, str]:
+        from mythril_tpu.analysis.potential_issues import get_bytecode_hash
+
         address = state.get_current_instruction()["address"]
-        code_hash = get_code_hash(state.environment.code.bytecode)
+        # memoized: hooks consult the cache once per hooked opcode per state
+        code_hash = get_bytecode_hash(state.environment.code.bytecode)
         return address, code_hash
 
     def execute(self, target) -> Optional[List[Issue]]:

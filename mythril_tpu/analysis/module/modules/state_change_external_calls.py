@@ -43,8 +43,13 @@ class StateChangeAfterCall(DetectionModule):
     pre_hooks = CALL_LIST + STATE_READ_WRITE_LIST
 
     def _execute(self, state: GlobalState) -> None:
-        if self._cache_key(state) in self.cache:
-            return None
+        # NO cache short-circuit here: this module is STATEFUL — the
+        # annotation marking (first-access bookkeeping) must run on every
+        # path even when the report for this address is already confirmed,
+        # or a later path reaches the NEXT access unmarked and reports it
+        # (a confirmation-timing-dependent extra issue; caught by the
+        # frontier/host differential on the etherstore shape).  The cache
+        # gates only report creation (_report).
         self._analyze_state(state)
         return None
 
@@ -71,6 +76,8 @@ class StateChangeAfterCall(DetectionModule):
             state.annotate(StateChangeCallsAnnotation(state, user_defined))
 
     def _report(self, state: GlobalState, annotation: StateChangeCallsAnnotation) -> None:
+        if self._cache_key(state) in self.cache:
+            return
         severity = "Medium" if annotation.user_defined_address else "Low"
         call_address = annotation.call_state.get_current_instruction()["address"]
         potential_issue = PotentialIssue(

@@ -268,7 +268,10 @@ class LaserEVM:
             # gate decides whether a drain pays)
             iteration += 1
             pending_seeds += len(new_states)
-            if frontier_live and pending_seeds and iteration % 8 == 0:
+            # attempt a drain only once enough seeds accumulated to clear
+            # the engine's own width gate — a handful would bail there
+            # anyway, and every attempt rescans the work list
+            if frontier_live and pending_seeds >= 8 and iteration % 8 == 0:
                 pending_seeds = 0
                 try:
                     from mythril_tpu.frontier import FrontierEngine

@@ -186,36 +186,43 @@ def _mid_eligible(gs) -> bool:
     stack and concretely-addressed memory — resumed callers after inner
     calls, batch-full spills, timeout/arena bulk parks (reference engine
     continues ANY state, svm.py:261-304; round-3 frontier only admitted
-    fresh frames so every park left the device permanently).
-
-    States the device parked for a SEMANTIC reason (symbolic memory
-    addressing, unsupported opcode, cap overflow mid-instruction) carry
-    ``_frontier_park_pc``; while still AT that pc they would re-park on the
-    first device step, so the host must advance them past it first."""
-    if getattr(gs, "_frontier_park_pc", None) == gs.mstate.pc:
-        return False
+    fresh frames so every park left the device permanently)."""
     if len(gs.mstate.stack) > _MID_STACK_MAX:
         return False
     if gs.mstate.pc >= len(gs.environment.code.instruction_list):
         return False
     if len(gs.mstate.memory) > _MID_MEM_MAX * 32:
         return False
+    # memoized per (pc, #writes): a state is immutable while it waits on
+    # the work list, and drains rescan the list every few instructions —
+    # the O(M log M) walk must not repeat per scan
+    memo_key = (gs.mstate.pc, len(gs.mstate.memory))
+    cached = getattr(gs, "_frontier_mem_ok", None)
+    if cached is not None and cached[0] == memo_key:
+        return cached[1]
     addrs = gs.mstate.memory.concrete_addresses()
-    if addrs is None:
+    ok = addrs is not None
+    gs._frontier_mem_ok = (memo_key, ok)
+    if not ok:
         # symbolic memory addressing blocks the device AT this pc: stamp so
-        # every subsequent drain skips the O(M log M) memory walk until the
-        # host engine has advanced the state (fresh copies drop the stamp)
+        # the cheap top-level guard skips this state until the host engine
+        # has advanced it (fresh copies drop the stamp)
         gs._frontier_park_pc = gs.mstate.pc
-        return False
-    return True
+    return ok
 
 
 def _eligible(gs) -> bool:
     """Seed states the device can take: fresh message-call frames (pc 0,
     empty stack) — including INNER call frames, which the nested-frontier
     drains in svm.exec rely on — plus re-entrant mid-frame states (see
-    ``_mid_eligible``)."""
+    ``_mid_eligible``).
+
+    States the device parked for a SEMANTIC reason carry
+    ``_frontier_park_pc``; while still AT that pc they would re-park on
+    the first device step (this covers fresh-looking pc=0 parks too)."""
     try:
+        if getattr(gs, "_frontier_park_pc", None) == gs.mstate.pc:
+            return False
         if not _frame_ok(gs):
             return False
         return _is_fresh(gs) or _mid_eligible(gs)
@@ -390,8 +397,11 @@ class FrontierEngine:
         trips (bounded, and the host bounded-loops strategy still applies
         to whatever parks back).  Gas starts at zero on device: the walker
         reports seed-relative totals via its per-seed gas_base."""
+        I32_MAX = (1 << 31) - 1
         try:
-            stack_rows = [arena.encode(v.raw) for v in gs.mstate.stack]
+            # validate memory FIRST: stack encoding appends arena rows, and
+            # rows for a seed bounced afterwards would leak into the shared
+            # arena (pulling the arena-full park forward)
             addrs = gs.mstate.memory.concrete_addresses()
             if addrs is None:
                 return None
@@ -403,20 +413,28 @@ class FrontierEngine:
                     range(start, start + 32)
                 ):
                     return None  # partial word: the entry model can't hold it
+                if start + 32 > I32_MAX:
+                    return None  # device addresses are i32
                 windows.append(start)
                 i += 32
             if len(windows) > _MID_MEM_MAX:
+                return None
+            pc = int(gs.mstate.pc)
+            mem_size = int(getattr(gs.mstate, "memory_size", 0) or 0)
+            depth = int(getattr(gs.mstate, "depth", 0) or 0)
+            if max(pc, mem_size, depth) > I32_MAX:
                 return None
             mem_pairs = [
                 (a, arena.encode(gs.mstate.memory.get_word_at(a).raw))
                 for a in windows
             ]
+            stack_rows = [arena.encode(v.raw) for v in gs.mstate.stack]
             return {
-                "pc": int(gs.mstate.pc),
+                "pc": pc,
                 "stack": stack_rows,
                 "mem": mem_pairs,
-                "mem_size": int(getattr(gs.mstate, "memory_size", 0) or 0),
-                "depth": int(getattr(gs.mstate, "depth", 0) or 0),
+                "mem_size": mem_size,
+                "depth": depth,
             }
         except Exception as e:
             log.debug("mid-frame encode failed: %s", e)

@@ -30,12 +30,15 @@ class Caps:
     MEM: int = 48  # word-granular memory entries
     STO: int = 32  # storage assoc entries (concrete-fold cache)
     CON: int = 96  # device-added path constraints
-    EVT: int = 192  # events per path PER SEGMENT (buffers are drained at
+    EVT: int = 576  # events per path PER SEGMENT (buffers are drained at
     # every harvest and rebuilt empty; solc code is MSTORE/JUMPI-dense and
     # every one is an event; mid-instruction overflow parks the path, a
-    # fork-site overflow just pends until the next segment)
+    # fork-site overflow just pends until the next segment).  Sized ~1.5x K
+    # so a long segment cannot starve an event-dense path.
     R: int = 4  # arena rows reserved per path per step
-    K: int = 128  # max steps per device segment
+    K: int = 384  # max steps per device segment: over a tunneled link every
+    # harvest costs a full round trip, so segments run as long as the event
+    # buffers allow (the while_loop still exits early when all paths halt)
     ARENA: int = 1 << 17
     # adaptive bail-out: if fewer than MIN_LIVE paths stay live for
     # NARROW_BAIL consecutive harvests, park everything to the host engine

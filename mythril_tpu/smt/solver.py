@@ -399,8 +399,16 @@ class _Seeder:
             return
         if t.op == "ite":
             # steer toward the then-branch (calldata/memory models guard
-            # every byte with a bounds check, ite(i < size, select, 0))
+            # every byte with a bounds check, ite(i < size, select, 0)) —
+            # EXCEPT for WEAK zero propagation that the else-branch already
+            # supplies (a zero byte behind an OOB guard): forcing such a
+            # guard true would drag its bound (calldatasize) past explicit
+            # caps like ``calldatasize <= 0x25``.  Strong claims keep full
+            # steering: a selector equality's zero high bits legitimately
+            # pin bytes AND their in-range guards.
             c, a, b = t.args
+            if weak and b.is_const and (b.value & claim) == value:
+                return
             self._propagate_bool(c, True)
             self._propagate_bits(a, value, claim, weak)
             return

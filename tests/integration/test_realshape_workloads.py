@@ -1,0 +1,86 @@
+"""Real exploit shapes beyond BECToken: etherstore reentrancy and rubixi
+ownership takeover, host/frontier differential (bench_contracts.py;
+reference shapes /root/reference/solidity_examples/etherstore.sol and
+rubixi.sol)."""
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))
+from bench_contracts import etherstore_like, rubixi_like  # noqa: E402
+from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.support.support_args import args as global_args
+
+
+def _analyze(code: bytes, frontier: bool, modules, timeout=90):
+    reset_callback_modules()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        if hasattr(m, "cache"):
+            m.cache.clear()
+    old = (global_args.frontier, global_args.frontier_force)
+    global_args.frontier = frontier
+    global_args.frontier_force = frontier
+    try:
+        sym = SymExecWrapper(
+            code,
+            address=0x0901D12E,
+            strategy="bfs",
+            transaction_count=2,
+            execution_timeout=timeout,
+            modules=modules,
+        )
+        return fire_lasers(sym, white_list=modules)
+    finally:
+        global_args.frontier, global_args.frontier_force = old
+
+
+def keys(issues):
+    return sorted({(i.swc_id, i.address) for i in issues})
+
+
+@pytest.mark.parametrize("frontier", [False, True])
+def test_etherstore_reentrancy_found(frontier):
+    """The withdrawFunds CALL-to-caller before the balance decrement must
+    be flagged SWC-107 (external call to user address / state change after
+    external call)."""
+    FrontierStatistics().reset()
+    issues = _analyze(
+        etherstore_like(), frontier,
+        ["ExternalCalls", "StateChangeAfterCall"],
+    )
+    assert any(i.swc_id == "107" for i in issues), (
+        f"reentrancy window not flagged: {keys(issues)}"
+    )
+    if frontier:
+        assert FrontierStatistics().device_instructions > 0
+
+
+@pytest.mark.parametrize("frontier", [False, True])
+def test_rubixi_ownership_drain_found(frontier):
+    """dynamicPyramid (tx1) then collectAllFees (tx2) drains fees to the
+    attacker: SWC-105 unprotected ether withdrawal."""
+    FrontierStatistics().reset()
+    issues = _analyze(rubixi_like(), frontier, ["EtherThief"])
+    assert any(i.swc_id == "105" for i in issues), (
+        f"ownership-takeover drain not flagged: {keys(issues)}"
+    )
+    if frontier:
+        assert FrontierStatistics().device_instructions > 0
+
+
+def test_frontier_host_parity_on_real_shapes():
+    for code, modules in (
+        (etherstore_like(), ["ExternalCalls", "StateChangeAfterCall"]),
+        (rubixi_like(), ["EtherThief"]),
+    ):
+        host = _analyze(code, False, modules)
+        dev = _analyze(code, True, modules)
+        assert keys(host) == keys(dev), (
+            f"host={keys(host)} dev={keys(dev)}"
+        )
