@@ -422,14 +422,16 @@ def wl_corpus(production: bool):
             from mythril_tpu.frontier.stats import FrontierStatistics
 
             dev_before = FrontierStatistics().device_instructions
+            har_before = FrontierStatistics().harvest_s
             t0 = time.time()
             issues_by_name, states = analyze_cooperative(
                 jobs, transaction_count=2, execution_timeout=60
             )
             wall = time.time() - t0
-            # residency measured around the TIMED run only (the one-time
-            # warm-up above also executes device instructions)
+            # residency/harvest measured around the TIMED run only (the
+            # one-time warm-up above also executes device instructions)
             dev_delta = FrontierStatistics().device_instructions - dev_before
+            har_delta = FrontierStatistics().harvest_s - har_before
         finally:
             global_args.frontier_width = old_width
         findings = [
@@ -480,7 +482,13 @@ def wl_corpus(production: bool):
     ttfe = _ttfe(
         [i for i in all_issues if i.swc_id in set(CORPUS_RECALL.values())], t0
     )
-    return states, wall, ttfe, (dev_delta if production else None)
+    return (
+        states,
+        wall,
+        ttfe,
+        (dev_delta if production else None),
+        (har_delta if production else None),
+    )
 
 
 # (name, fn, unit, reps) — workloads run INTERLEAVED baseline/production
@@ -542,9 +550,12 @@ def main() -> None:
         samples = {"baseline": [], "production": []}
         ttfes = {"baseline": [], "production": []}
         residency = []
+        harvest_shares = []
         for _rep in range(reps):
             for tag, production in (("baseline", False), ("production", True)):
-                dev_before = FrontierStatistics().device_instructions
+                fstats = FrontierStatistics()
+                dev_before = fstats.device_instructions
+                har_before = fstats.harvest_s
                 out = fn(production)
                 work, wall, ttfe = out[:3]
                 samples[tag].append(work / wall if wall > 0 else 0.0)
@@ -557,9 +568,21 @@ def main() -> None:
                     dev = (
                         out[3]
                         if len(out) > 3 and out[3] is not None
-                        else FrontierStatistics().device_instructions - dev_before
+                        else fstats.device_instructions - dev_before
                     )
                     residency.append(dev / work)
+                if production and wall > 0:
+                    # walker/harvest cost as a share of the workload wall —
+                    # the number that says whether host-side event replay
+                    # is the frontier's cost center.  A workload with an
+                    # internal warm-up supplies its own delta (out[4]),
+                    # mirroring the residency channel.
+                    har = (
+                        out[4]
+                        if len(out) > 4 and out[4] is not None
+                        else fstats.harvest_s - har_before
+                    )
+                    harvest_shares.append(har / wall)
         rates = {tag: sorted(vals)[len(vals) // 2] for tag, vals in samples.items()}
         med_ttfe = {
             tag: (sorted(vals)[len(vals) // 2] if vals else None)
@@ -604,6 +627,13 @@ def main() -> None:
                 if vals
             },
             "device_residency_pct": dev_pct,
+            "harvest_share_pct": (
+                round(
+                    100 * sorted(harvest_shares)[len(harvest_shares) // 2], 1
+                )
+                if harvest_shares
+                else None
+            ),
         }
 
     headline = table["corpus_sweep"]

@@ -14,7 +14,6 @@ from typing import List, Optional, Set, Tuple
 
 from mythril_tpu.analysis.report import Issue
 from mythril_tpu.core.state.global_state import GlobalState
-from mythril_tpu.support.support_utils import get_code_hash
 
 log = logging.getLogger(__name__)
 
@@ -52,10 +51,11 @@ class DetectionModule:
             self.cache.add((issue.address, issue.bytecode_hash))
 
     def _cache_key(self, state: GlobalState) -> Tuple[int, str]:
+        # local import breaks the potential_issues <-> base cycle; memoized
+        # because hooks consult the cache once per hooked opcode per state
         from mythril_tpu.analysis.potential_issues import get_bytecode_hash
 
         address = state.get_current_instruction()["address"]
-        # memoized: hooks consult the cache once per hooked opcode per state
         code_hash = get_bytecode_hash(state.environment.code.bytecode)
         return address, code_hash
 

@@ -398,6 +398,11 @@ class FrontierEngine:
         to whatever parks back).  Gas starts at zero on device: the walker
         reports seed-relative totals via its per-seed gas_base."""
         I32_MAX = (1 << 31) - 1
+        if arena.length > self.caps.ARENA * 9 // 10:
+            # near-capacity: an encode raising halfway would strand its
+            # already-appended rows (the arena has no rollback); the run is
+            # about to park on arena pressure anyway
+            return None
         try:
             # validate memory FIRST: stack encoding appends arena rows, and
             # rows for a seed bounced afterwards would leak into the shared
@@ -519,6 +524,7 @@ class FrontierEngine:
             row_zero=np.int32(row_zero),
             row_one=np.int32(row_one),
             sel_mode=np.int32(_sel_mode(laser0)),
+            k_limit=np.int32(caps.K),
         )
 
         # seed contexts (also fills the arena with env rows)
@@ -647,6 +653,16 @@ class FrontierEngine:
 
             stats = FrontierStatistics()
             t_seg = time.time()
+            # step-limit ramp (dynamic scalar, no recompile): early segments
+            # stay short so the first terminals harvest — and their exploits
+            # confirm — quickly; later segments run long to amortize the
+            # link round trip.  Keyed on the ANALYSIS-wide segment counter
+            # (reset per contract by the facade/bench), not a per-drain
+            # counter: periodic nested drains must not re-pay truncated
+            # segments long after the first exploit confirmed.
+            cfg = cfg._replace(
+                k_limit=np.int32(min(caps.K, 96 << min(stats.segments, 4)))
+            )
             st_dev = push_sharded(st) if mesh is not None else push_state(st)
             out_state, dev_arena, out_len, n_exec, seg_max_live, visited = (
                 segment(st_dev, dev_arena, arena_len, visited, code_dev, cfg)

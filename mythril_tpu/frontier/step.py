@@ -111,6 +111,11 @@ class CfgScalars(NamedTuple):
     # the host search strategies (SURVEY.md §7.2 item 5) — with free slots
     # every fork is granted and the mode is irrelevant
     sel_mode: jnp.ndarray
+    # per-segment step limit (<= caps.K), dynamic so the engine can ramp:
+    # short early segments harvest terminals quickly (time-to-first-exploit
+    # depends on the FIRST tx-end replay), long late segments amortize the
+    # link round trip once the frontier is warm
+    k_limit: jnp.ndarray = np.int32(1 << 30)  # default: caps.K governs
 
 
 # fork-grant selection modes (cfg.sel_mode)
@@ -1101,10 +1106,11 @@ def build_segment(caps: Caps):
                 code, cfg)
 
     def cond(carry):
-        state, _, arena_len, t, _n, _m, _v, _code, _cfg = carry
+        state, _, arena_len, t, _n, _m, _v, _code, cfg = carry
         running = (state.halt == O.H_RUNNING) & (state.seed >= 0)
         room = arena_len + running.sum() * R < caps.ARENA
-        return (t < caps.K) & running.any() & room
+        k = jnp.minimum(cfg.k_limit, caps.K)
+        return (t < k) & running.any() & room
 
     @jax.jit
     def segment(state: FrontierState, arena: ArenaDev, arena_len,

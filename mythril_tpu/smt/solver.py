@@ -307,10 +307,15 @@ class _Seeder:
                 if b.is_const:
                     self._propagate_value(a, 0, weak=True)
                 elif t.op in ("ult", "ule"):
-                    # both sides symbolic: repairable ordering at build time
-                    # (no zero hint — ``idx < size`` bounds guards would
-                    # poison computed read indices that the repair machinery
-                    # satisfies by raising ``size`` instead)
+                    # both sides symbolic: repairable ordering at build time.
+                    # Plain VARIABLES on the low side keep the weak zero
+                    # seed (call_value <= balance-chain constraints repair
+                    # trivially at zero); computed terms do not — a zero
+                    # hint through an ``idx < size`` bounds guard poisons
+                    # the read index the repair satisfies by raising
+                    # ``size`` instead.
+                    if a.op == "var":
+                        self._propagate_value(a, 0, weak=True)
                     self.order_pairs.append((a, b, 1 if t.op == "ult" else 0))
                 else:
                     # signed orderings have no repair machinery: keep the
