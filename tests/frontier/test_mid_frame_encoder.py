@@ -1,0 +1,99 @@
+"""Mid-frame encoder unit tests: host GlobalState -> device slot fields.
+
+The encoder (engine._encode_mid) packs a parked/resumed state for device
+re-entry; these tests pin the eligibility/encoding contract without a full
+engine run (the integration parity lives in test_inner_call_frontier).
+"""
+
+from mythril_tpu.core.state.account import Account
+from mythril_tpu.core.state.world_state import WorldState
+from mythril_tpu.core.transaction.transaction_models import MessageCallTransaction
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.frontier.arena import HostArena
+from mythril_tpu.frontier.engine import FrontierEngine, _eligible, _mid_eligible
+from mythril_tpu.frontier.state import Caps
+from mythril_tpu.smt import symbol_factory
+
+
+CODE = "6000356000525b600056"  # calldataload; mstore; jumpdest; jump loop
+
+
+def _state(pc=3):
+    ws = WorldState()
+    acct = Account("0x0901d12e", concrete_storage=True)
+    acct.code = Disassembly(CODE)
+    ws.put_account(acct)
+    tx = MessageCallTransaction(
+        world_state=ws,
+        gas_limit=10**6,
+        callee_account=acct,
+        caller=symbol_factory.BitVecVal(0xDEADBEEF, 256),
+    )
+    gs = tx.initial_global_state()
+    gs.transaction_stack.append((tx, None))
+    gs.mstate.pc = pc
+    return gs
+
+
+def test_fresh_state_not_mid():
+    gs = _state(pc=0)
+    assert _eligible(gs)
+    assert not gs.mstate.stack
+
+
+def test_encode_roundtrip_stack_and_memory():
+    gs = _state(pc=3)
+    gs.mstate.stack.append(symbol_factory.BitVecVal(42, 256))
+    gs.mstate.stack.append(symbol_factory.BitVecSym("sym_word", 256))
+    gs.mstate.memory.write_word_at(0, symbol_factory.BitVecVal(7, 256))
+    gs.mstate.memory.write_word_at(
+        64, symbol_factory.BitVecSym("mem_word", 256)
+    )
+    gs.mstate.memory_size = 96
+    assert _eligible(gs)
+    engine = FrontierEngine.__new__(FrontierEngine)
+    engine.caps = Caps()
+    arena = HostArena(Caps.ARENA)
+    enc = engine._encode_mid(arena, gs)
+    assert enc is not None
+    assert enc["pc"] == 3
+    assert enc["mem_size"] == 96
+    assert len(enc["stack"]) == 2
+    assert [a for a, _ in enc["mem"]] == [0, 64]
+    # rows decode back to the exact terms
+    assert arena.decode(enc["stack"][0]).value == 42
+    assert arena.decode(enc["stack"][1]).op == "var"
+    assert arena.decode(enc["mem"][0][1]).value == 7
+
+
+def test_partial_word_bounces():
+    gs = _state(pc=3)
+    gs.mstate.memory.set_byte(5, 0xAA)  # a lone byte, not a full word
+    engine = FrontierEngine.__new__(FrontierEngine)
+    engine.caps = Caps()
+    assert engine._encode_mid(HostArena(Caps.ARENA), gs) is None
+
+
+def test_symbolic_memory_index_ineligible_and_stamped():
+    gs = _state(pc=3)
+    gs.mstate.memory[symbol_factory.BitVecSym("symidx", 256)] = (
+        symbol_factory.BitVecVal(1, 8)
+    )
+    assert not _mid_eligible(gs)
+    # stamped: the next scan must short-circuit without re-walking memory
+    assert gs._frontier_park_pc == 3
+    assert not _eligible(gs)
+
+
+def test_park_stamp_blocks_fresh_looking_state():
+    gs = _state(pc=0)
+    gs._frontier_park_pc = 0  # semantic park AT pc 0
+    assert not _eligible(gs)
+
+
+def test_huge_address_bounces():
+    gs = _state(pc=3)
+    gs.mstate.memory.write_word_at(1 << 32, symbol_factory.BitVecVal(1, 256))
+    engine = FrontierEngine.__new__(FrontierEngine)
+    engine.caps = Caps()
+    assert engine._encode_mid(HostArena(Caps.ARENA), gs) is None

@@ -1,0 +1,114 @@
+"""Interval-bound refutation tier (smt/intervals.py): exact-UNSAT claims.
+
+Soundness bar: ``refute() == True`` must NEVER be wrong — a false
+refutation is a recall loss in every pruning call site.  Tests pair each
+refutation with a solver cross-check and fuzz small widths against brute
+force.
+"""
+
+import itertools
+import random
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import Assignment, evaluate
+from mythril_tpu.smt.intervals import refute
+
+
+def bv(name, w=256):
+    return terms.var(name, w)
+
+
+def c(v, w=256):
+    return terms.const(v, w)
+
+
+def test_range_impossible_product_refuted():
+    # the motivating shape: loop-exit pins cnt <= 1, overflow demands
+    # cnt * value >= 2^256 (512-bit zext-mul)
+    cnt, value = bv("cnt"), bv("value")
+    p = terms.mul(terms.zext(cnt, 256), terms.zext(value, 256))
+    conj = [
+        terms.ule(cnt, c(1)),
+        terms.ult(c((1 << 256) - 1, 512), p),
+    ]
+    assert refute(conj)
+
+
+def test_feasible_product_not_refuted():
+    cnt, value = bv("cnt2"), bv("value2")
+    p = terms.mul(terms.zext(cnt, 256), terms.zext(value, 256))
+    conj = [
+        terms.ule(cnt, c(20)),
+        terms.ult(c(1, 256), cnt),
+        terms.ult(c((1 << 256) - 1, 512), p),
+    ]
+    assert not refute(conj)  # cnt=2, value=2^255 satisfies
+
+
+def test_disjoint_eq_ranges_refuted():
+    x = bv("x3")
+    conj = [terms.ule(x, c(5)), terms.eq(x, c(9))]
+    assert refute(conj)
+
+
+def test_contradictory_bounds_refuted():
+    x = bv("x4")
+    conj = [terms.ule(x, c(3)), terms.ult(c(7), x)]
+    assert refute(conj)
+
+
+def test_add_bound_propagates():
+    # x <= 10 and y <= 10 make x + y > 100 impossible (no wrap at 256 bits)
+    x, y = bv("x5"), bv("y5")
+    conj = [
+        terms.ule(x, c(10)),
+        terms.ule(y, c(10)),
+        terms.ult(c(100), terms.add(x, y)),
+    ]
+    assert refute(conj)
+
+
+def test_wrapping_add_not_refuted():
+    # at full range, x + y wraps: the analysis must widen, not refute
+    x, y = bv("x6"), bv("y6")
+    conj = [terms.ult(c(100), terms.add(x, y))]
+    assert not refute(conj)
+
+
+def test_fuzz_no_false_refutation_width4():
+    """Brute-force oracle at width 4: every refuted conjunction must be
+    genuinely unsatisfiable."""
+    rng = random.Random(1234)
+    w = 4
+    names = ["a", "b"]
+
+    def rand_term(depth, vars_):
+        if depth == 0 or rng.random() < 0.35:
+            if rng.random() < 0.5:
+                return terms.const(rng.randrange(1 << w), w)
+            return vars_[rng.randrange(len(vars_))]
+        op = rng.choice([terms.add, terms.sub, terms.mul, terms.band, terms.bor])
+        return op(rand_term(depth - 1, vars_), rand_term(depth - 1, vars_))
+
+    refuted = 0
+    for _ in range(300):
+        vars_ = [terms.var(f"f{rng.randrange(10**9)}", w) for _ in range(2)]
+        conj = []
+        for _k in range(rng.randrange(1, 4)):
+            lhs, rhs = rand_term(2, vars_), rand_term(2, vars_)
+            cmp = rng.choice([terms.ult, terms.ule, terms.eq])
+            conj.append(cmp(lhs, rhs))
+        if not refute(conj):
+            continue
+        refuted += 1
+        # brute-force: no assignment may satisfy all conjuncts
+        for vals in itertools.product(range(1 << w), repeat=2):
+            asg = Assignment()
+            asg.scalars[vars_[0]] = vals[0]
+            asg.scalars[vars_[1]] = vals[1]
+            out = evaluate(conj, asg)
+            assert not all(out[x] for x in conj), (
+                f"FALSE refutation: {[str(x) for x in conj]} sat at {vals}"
+            )
+    # the fuzz must actually exercise refutations to mean anything
+    assert refuted >= 5, f"only {refuted} refutations generated"
