@@ -260,20 +260,24 @@ def wl_wide_frontier(production: bool):
         from mythril_tpu.frontier.stats import FrontierStatistics
 
         dev_before = FrontierStatistics().device_instructions
+        har_before = FrontierStatistics().harvest_s
         code = _wide_contract(10)  # 1024 concurrent paths
         t0 = time.time()
         sym, issues = _analyze(
             code, 0x0901D12E, 1, modules=["AccidentallyKillable"], timeout=300
         )
         wall = time.time() - t0
-        # residency over the TIMED run only (the warm-up above also runs)
+        # residency/harvest over the TIMED run only (the warm-up above
+        # also runs device segments and harvests)
         dev_delta = FrontierStatistics().device_instructions - dev_before
+        har_delta = FrontierStatistics().harvest_s - har_before
     finally:
         args.frontier_width = old_width
     assert any(i.swc_id == "106" for i in issues), "wide-frontier recall lost"
     return (
         sym.laser.total_states, wall, _ttfe(issues, t0, "106"),
         dev_delta if production else None,
+        har_delta if production else None,
     )
 
 
