@@ -18,6 +18,16 @@ SAT answers are therefore *candidates*: the caller validates the
 reconstructed model with the exact concrete evaluator before trusting it
 (solver.py does this), so keccak's abstraction can never produce a wrong SAT,
 and UNSAT of the abstraction implies UNSAT of the original formula.
+
+Keccak is additionally refined by CEGAR (the lazy analogue of the eager
+hash axioms the reference installs via keccak_function_manager,
+mythril/laser/ethereum/function_managers/keccak_function_manager.py): when
+a candidate model assigns a keccak site a value different from the REAL
+hash of its concretely-evaluated input, ``input == v => output ==
+keccak(v)`` is asserted and the formula re-solved — so queries whose
+verdict depends on hash semantics (hash-distinctness UNSAT proofs, models
+routing through storage slots) converge to exact answers instead of
+burning their budget on host-validation failures.
 """
 
 from __future__ import annotations
@@ -28,6 +38,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from mythril_tpu.ops.keccak import keccak256_int
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.concrete_eval import ArrayValue, Assignment, evaluate
 from mythril_tpu.smt.terms import Term
@@ -302,6 +313,19 @@ def _add_congruence_pair(
     tape.roots.append(tape.emit(OP_OR, 1, na, out_eq))
 
 
+def _add_keccak_value(tape: _Tape, site: int, inp_val: int, true_hash: int):
+    """Assert ``input == inp_val => output == keccak(inp_val)`` for one
+    keccak site — a tautology of the real hash function (sound to add), and
+    the lazy analogue of Z3's eager hash-value axioms: only the input values
+    an actual model proposes ever get their hash pinned."""
+    inp_node, var_node, inp_term = tape.keccaks[site]
+    eq_in = tape.emit(OP_EQ, 1, inp_node, tape.const(inp_val, _width(inp_term)))
+    eq_out = tape.emit(OP_EQ, 1, var_node, tape.const(true_hash, 256))
+    tape.roots.append(
+        tape.emit(OP_OR, 1, tape.emit(OP_NOT, 1, eq_in), eq_out)
+    )
+
+
 def _norm_idx(t: Term) -> Tuple[Optional[int], int]:
     """(base term id, constant offset) so provably-distinct indices can skip
     congruence: word reads are 32 selects at ``base + j`` — all C(32,2)
@@ -372,7 +396,7 @@ def serialize(
 
 def _rebuild_assignment(
     tape: _Tape, model: bytes
-) -> Tuple[Assignment, List[Tuple[int, int, int]]]:
+) -> Tuple[Assignment, List[Tuple[int, int, int]], List[Tuple[int, int, int]]]:
     """Parse packed VAR bits, then resolve array/UF sites in topo order.
 
     Tape order IS topo order of the original DAG, so by the time a select's
@@ -380,11 +404,22 @@ def _rebuild_assignment(
     already been written into the ArrayValue backing — concrete evaluation
     of the index under the partial assignment is exact.
 
-    Returns (assignment, violations) where violations lists select-site
-    pairs ``(arr_tid, site_i, site_j)`` that read the SAME concrete index
-    but were assigned DIFFERENT values — possible only under lazy
-    congruence (``serialize(..., lazy_selects=True)``); the CEGAR loop in
-    ``solve`` asserts exactly those pairs and re-solves.
+    Returns (assignment, violations, kec_mismatches):
+
+    * ``violations`` lists select-site pairs ``(arr_tid, site_i, site_j)``
+      that read the SAME concrete index but were assigned DIFFERENT values
+      — possible only under lazy congruence
+      (``serialize(..., lazy_selects=True)``); the CEGAR loop in ``solve``
+      asserts exactly those pairs and re-solves.
+    * ``kec_mismatches`` lists keccak sites ``(site, input_value, true_hash)``
+      whose input evaluates concretely under the assignment but whose model
+      value differs from the REAL keccak256 of that input.  The CEGAR loop
+      asserts ``input == value => output == keccak(value)`` — a fact of the
+      actual hash function, so soundness is untouched — and re-solves; the
+      refined model then carries real hash values (and hash-distinctness of
+      distinct concrete inputs follows for free), closing the queries whose
+      verdict depends on hash semantics instead of burning their budget on
+      host-validation failures.
     """
     values: List[int] = []
     off = 0
@@ -404,7 +439,9 @@ def _rebuild_assignment(
         else:
             deferred.append((meta, value))
     violations: List[Tuple[int, int, int]] = []
+    kec_mismatches: List[Tuple[int, int, int]] = []
     site_no: Dict[int, int] = {}
+    kec_site = 0
     writer: Dict[Tuple[int, int], Tuple[int, int]] = {}
     for meta, value in deferred:
         kind = meta[0]
@@ -424,9 +461,22 @@ def _rebuild_assignment(
             t = meta[1]
             arg_vals = tuple(evaluate([x], asg)[x] for x in t.args)
             asg.ufs[(t.aux, arg_vals)] = value
-        # keccak: intentionally NOT installed — validation recomputes real
-        # hashes; a model relying on a fake hash value must fail validation
-    return asg, violations
+        elif kind == "keccak":
+            # NOT installed in asg — validation recomputes real hashes.
+            # Instead, compare the model's value against the true hash of
+            # the concretely-evaluated input (evaluate() resolves nested
+            # keccaks to their REAL hashes, so chained sites converge in
+            # one refinement round each).
+            si, kec_site = kec_site, kec_site + 1
+            inp = meta[1]
+            try:
+                inp_val = evaluate([inp], asg)[inp]
+            except NotImplementedError:
+                continue
+            true_hash = keccak256_int(inp_val, _width(inp) // 8)
+            if value != true_hash:
+                kec_mismatches.append((si, inp_val, true_hash))
+    return asg, violations, kec_mismatches
 
 
 # ---------------------------------------------------------------------------
@@ -486,6 +536,8 @@ def solve(
         return UNKNOWN, None
     deadline = _time.time() + timeout_s
     refine: List[Tuple[int, int, int]] = []
+    kec_refine: List[Tuple[int, int, int]] = []
+    kec_done: set = set()
     try:
         # one serialization: the tape is append-only, so refinement rounds
         # just add congruence pairs to the same records/roots
@@ -494,14 +546,22 @@ def solve(
         log.debug("native tier: %s", e)
         return UNKNOWN, None
     for _round in range(_CEGAR_ROUNDS):
-        for arr_tid, i, j in refine:
-            sites = tape.selects.get(arr_tid)
-            if sites is None or i >= len(sites) or j >= len(sites):
-                continue
-            idx_i, var_i, _ = sites[i]
-            idx_j, var_j, _ = sites[j]
-            _add_congruence_pair(tape, ([idx_i], var_i), ([idx_j], var_j))
-        refine = []
+        try:
+            for arr_tid, i, j in refine:
+                sites = tape.selects.get(arr_tid)
+                if sites is None or i >= len(sites) or j >= len(sites):
+                    continue
+                idx_i, var_i, _ = sites[i]
+                idx_j, var_j, _ = sites[j]
+                _add_congruence_pair(tape, ([idx_i], var_i), ([idx_j], var_j))
+            for site, inp_val, true_hash in kec_refine:
+                _add_keccak_value(tape, site, inp_val, true_hash)
+        except Unsupported as e:
+            # tape cap reached mid-refinement: degrade instead of raising
+            # into the engine query (the session path does the same)
+            log.debug("refinement hit tape cap: %s", e)
+            return UNKNOWN, None
+        refine, kec_refine = [], []
         remaining = deadline - _time.time()
         if remaining <= 0:
             return UNKNOWN, None
@@ -511,15 +571,22 @@ def solve(
         if status != 1:
             return UNKNOWN, None
         try:
-            asg, violations = _rebuild_assignment(tape, model)
+            asg, violations, kec_mm = _rebuild_assignment(tape, model)
         except Exception as e:  # reconstruction must never crash the solver
             log.debug("native model reconstruction failed: %s", e)
             return UNKNOWN, None
-        if not violations:
+        # an already-asserted (site, input) pair cannot recur with a wrong
+        # value in a model of the CNF; the guard protects the loop anyway
+        kec_mm = [
+            m for m in kec_mm if (m[0], m[1]) not in kec_done
+        ]
+        if not violations and not kec_mm:
             return SAT, asg
         # violated pairs are by construction not yet asserted (an asserted
         # pair cannot be violated by a model of the CNF)
         refine = violations
+        kec_refine = kec_mm
+        kec_done.update((m[0], m[1]) for m in kec_mm)
     return UNKNOWN, None
 
 
@@ -633,18 +700,21 @@ class OptimizeSession:
         if self._handle is None:
             return UNKNOWN, None
         deadline = _time.time() + timeout_s
+        kec_done: set = set()
         for _round in range(_CEGAR_ROUNDS):
             remaining = deadline - _time.time()
             if remaining <= 0:
                 return UNKNOWN, None
-            status, asg, violations = self._solve_once(
+            status, asg, violations, kec_mm = self._solve_once(
                 bounds, remaining, enable
             )
-            if status != SAT or not violations:
+            kec_mm = [m for m in kec_mm if (m[0], m[1]) not in kec_done]
+            if status != SAT or (not violations and not kec_mm):
                 return status, asg
-            ext = self._extend_pairs(violations)
+            kec_done.update((m[0], m[1]) for m in kec_mm)
+            ext = self._extend_refinements(violations, kec_mm)
             if ext == 0:
-                return UNSAT, None  # pair constraints closed the formula
+                return UNSAT, None  # refinement constraints closed the formula
             if ext != 1:
                 return UNKNOWN, None
         return UNKNOWN, None
@@ -676,22 +746,25 @@ class OptimizeSession:
             len(model),
         )
         if status == 0:
-            return UNSAT, None, ()
+            return UNSAT, None, (), ()
         if status != 1:
-            return UNKNOWN, None, ()
+            return UNKNOWN, None, (), ()
         try:
-            asg, violations = _rebuild_assignment(self._tape, model.tobytes())
-            return SAT, asg, violations
+            asg, violations, kec_mm = _rebuild_assignment(
+                self._tape, model.tobytes()
+            )
+            return SAT, asg, violations, kec_mm
         except Exception as e:
             log.debug("session model reconstruction failed: %s", e)
-            return UNKNOWN, None, ()
+            return UNKNOWN, None, (), ()
 
-    def _extend_pairs(self, violations) -> int:
-        """Append congruence constraints for the violated pairs to the live
-        native session.  The tape is append-only; only the delta records and
-        delta roots cross the boundary (const offsets stay valid because the
-        pair circuits reference existing nodes only).  Returns the bb_extend
-        status: 1 ok, 0 formula now unsat, -1 unusable."""
+    def _extend_refinements(self, violations, kec_mm=()) -> int:
+        """Append refinement constraints (select-congruence pairs and/or
+        keccak value implications) to the live native session.  The tape is
+        append-only; only the delta records and delta roots cross the
+        boundary (const offsets are absolute into the full consts buffer,
+        which is re-passed).  Returns the bb_extend status: 1 ok, 0 formula
+        now unsat, -1 unusable."""
         rec_mark = len(self._tape.records)
         root_mark = len(self._tape.roots)
         try:
@@ -704,6 +777,8 @@ class OptimizeSession:
                 _add_congruence_pair(
                     self._tape, ([idx_i], var_i), ([idx_j], var_j)
                 )
+            for site, inp_val, true_hash in kec_mm:
+                _add_keccak_value(self._tape, site, inp_val, true_hash)
         except Unsupported as e:
             # tape cap reached mid-refinement: the callers treat -1 as
             # UNKNOWN and degrade; an exception here would abort the whole

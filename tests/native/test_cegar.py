@@ -105,3 +105,88 @@ def test_session_bound_queries_after_refinement():
         # obj <= 2 contradicts the path
         st3, _ = sess.solve([(0, "le", 2)], 30)
         assert st3 == bitblast.UNSAT
+
+
+# ---------------------------------------------------------------------------
+# Keccak value CEGAR: hash semantics converge to exact verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_keccak_concrete_input_sat_real_hash():
+    """keccak(x) == real_hash(5) with x == 5: the refined model must carry
+    the REAL hash (validation-clean), not a free-variable stand-in."""
+    from mythril_tpu.ops.keccak import keccak256_int
+
+    x = terms.var("kx1", 256)
+    h = keccak256_int(5, 32)
+    conj = [terms.eq(x, c(5)), terms.eq(terms.keccak(x), c(h))]
+    status, asg = bitblast.solve(conj, timeout_s=30)
+    assert status == bitblast.SAT
+    vals = evaluate(conj, asg)
+    assert all(vals[t] for t in conj)
+
+
+def test_keccak_wrong_value_unsat():
+    """keccak(5) pinned to the hash of a DIFFERENT value is UNSAT — only
+    provable by asserting the real hash of the proposed concrete input."""
+    from mythril_tpu.ops.keccak import keccak256_int
+
+    x = terms.var("kx2", 256)
+    wrong = keccak256_int(6, 32)
+    conj = [terms.eq(x, c(5)), terms.eq(terms.keccak(x), c(wrong))]
+    status, _ = bitblast.solve(conj, timeout_s=30)
+    assert status == bitblast.UNSAT
+
+
+def test_keccak_distinctness_unsat():
+    """Distinct concrete inputs force distinct hashes: keccak(5) ==
+    keccak(6) is UNSAT via the pinned real values (Ackermann congruence
+    alone cannot refute it)."""
+    x, y = terms.var("kx3", 256), terms.var("ky3", 256)
+    conj = [
+        terms.eq(x, c(5)),
+        terms.eq(y, c(6)),
+        terms.eq(terms.keccak(x), terms.keccak(y)),
+    ]
+    status, _ = bitblast.solve(conj, timeout_s=30)
+    assert status == bitblast.UNSAT
+
+
+def test_keccak_chain_refines():
+    """Nested hashing keccak(keccak(x)) with concrete x converges to the
+    real composed hash (mismatch detection evaluates inputs with REAL inner
+    hashes, so the chain refines in one round per site, not per round trip
+    of fake values)."""
+    from mythril_tpu.ops.keccak import keccak256_int
+
+    x = terms.var("kx4", 256)
+    inner = keccak256_int(9, 32)
+    outer = keccak256_int(inner, 32)
+    conj = [
+        terms.eq(x, c(9)),
+        terms.eq(terms.keccak(terms.keccak(x)), c(outer)),
+    ]
+    status, asg = bitblast.solve(conj, timeout_s=30)
+    assert status == bitblast.SAT
+    vals = evaluate(conj, asg)
+    assert all(vals[t] for t in conj)
+
+
+def test_session_keccak_refinement():
+    """OptimizeSession refines keccak values on the live handle: the slot
+    guard routed through a real storage-slot hash answers exactly, and a
+    contradictory guard is UNSAT from the same session."""
+    from mythril_tpu.ops.keccak import keccak256_int
+
+    x = terms.var("kx5", 256)
+    h5 = keccak256_int(5, 32)
+    path = [terms.eq(x, c(5))]
+    g_ok = terms.eq(terms.keccak(x), c(h5))
+    g_bad = terms.eq(terms.keccak(x), c(h5 ^ 1))
+    with bitblast.OptimizeSession(path, guarded=[g_ok, g_bad]) as sess:
+        st_ok, asg = sess.solve([], 30, enable=[0])
+        assert st_ok == bitblast.SAT
+        vals = evaluate(path + [g_ok], asg)
+        assert all(vals[t] for t in path + [g_ok])
+        st_bad, _ = sess.solve([], 30, enable=[1])
+        assert st_bad == bitblast.UNSAT
