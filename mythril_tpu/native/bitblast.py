@@ -512,6 +512,28 @@ def _run_solver(lib, tape: _Tape, timeout_s: float) -> Tuple[int, bytes]:
 # 5 rounds / ~4s where eager congruence exceeded the clause budget outright.
 _CEGAR_ROUNDS = 12
 
+# Keccak value refinement is value-ENUMERATING: a query whose hash demand
+# no input can meet proposes a fresh input every round, so it must be
+# bounded separately (distinctness proofs and chained hashes converge in
+# 1-2 rounds; past this cap the answer degrades to UNKNOWN exactly as the
+# pre-CEGAR code did after one round).
+_KECCAK_ROUNDS = 3
+
+
+def _model_validates(conjuncts: Sequence[Term], asg: Assignment) -> bool:
+    """Evaluate the conjunction under the model with REAL keccak semantics.
+
+    A model whose keccak CNF values are fake but whose real-hash evaluation
+    still satisfies every conjunct is a perfectly good model (the formula
+    never observed the fake values) — returning it immediately keeps the
+    pre-CEGAR fast path; refinement only runs when hash semantics actually
+    bite."""
+    try:
+        vals = evaluate(list(conjuncts), asg)
+        return all(vals[c] for c in conjuncts)
+    except Exception:
+        return False
+
 
 def solve(
     conjuncts: Sequence[Term], timeout_s: float
@@ -538,6 +560,7 @@ def solve(
     refine: List[Tuple[int, int, int]] = []
     kec_refine: List[Tuple[int, int, int]] = []
     kec_done: set = set()
+    kec_rounds = 0
     try:
         # one serialization: the tape is append-only, so refinement rounds
         # just add congruence pairs to the same records/roots
@@ -582,6 +605,15 @@ def solve(
         ]
         if not violations and not kec_mm:
             return SAT, asg
+        if not violations and kec_mm and _model_validates(conjuncts, asg):
+            return SAT, asg  # fake hash values were never observed
+        # the keccak cap counts only PURE keccak rounds: a round that also
+        # refines select congruence is productive regardless of whether the
+        # model proposed a fresh hash input alongside
+        if kec_mm and not violations:
+            kec_rounds += 1
+            if kec_rounds > _KECCAK_ROUNDS:
+                return UNKNOWN, None
         # violated pairs are by construction not yet asserted (an asserted
         # pair cannot be violated by a model of the CNF)
         refine = violations
@@ -636,6 +668,8 @@ class OptimizeSession:
             lazy_selects=True,
         )
         self._conjuncts = list(conjuncts)
+        self._objectives = list(objectives)
+        self._guarded = list(guarded)
         self._controls = []  # per objective: (m_node, width, {op: en_node})
         for i, obj in enumerate(objectives):
             w = obj.width
@@ -701,6 +735,7 @@ class OptimizeSession:
             return UNKNOWN, None
         deadline = _time.time() + timeout_s
         kec_done: set = set()
+        kec_rounds = 0
         for _round in range(_CEGAR_ROUNDS):
             remaining = deadline - _time.time()
             if remaining <= 0:
@@ -711,6 +746,16 @@ class OptimizeSession:
             kec_mm = [m for m in kec_mm if (m[0], m[1]) not in kec_done]
             if status != SAT or (not violations and not kec_mm):
                 return status, asg
+            if (
+                not violations
+                and kec_mm
+                and self._query_validates(asg, bounds, enable)
+            ):
+                return SAT, asg  # fake hash values were never observed
+            if kec_mm and not violations:  # pure keccak rounds only
+                kec_rounds += 1
+                if kec_rounds > _KECCAK_ROUNDS:
+                    return UNKNOWN, None
             kec_done.update((m[0], m[1]) for m in kec_mm)
             ext = self._extend_refinements(violations, kec_mm)
             if ext == 0:
@@ -718,6 +763,26 @@ class OptimizeSession:
             if ext != 1:
                 return UNKNOWN, None
         return UNKNOWN, None
+
+    def _query_validates(self, asg, bounds, enable) -> bool:
+        """Real-keccak validation of THIS query: base conjuncts, the enabled
+        guarded terms, and the assumed objective bounds must all hold."""
+        checks = list(self._conjuncts) + [self._guarded[i] for i in enable]
+        if not _model_validates(checks, asg):
+            return False
+        try:
+            for idx, op_name, value in bounds:
+                obj = self._objectives[idx]
+                got = evaluate([obj], asg)[obj]
+                if op_name == "le" and not got <= value:
+                    return False
+                if op_name == "ge" and not got >= value:
+                    return False
+                if op_name == "eq" and got != value:
+                    return False
+        except Exception:
+            return False
+        return True
 
     def _solve_once(
         self,
