@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import logging
 from enum import Enum
-from typing import List, Optional, Set, Tuple
+from types import MappingProxyType
+from typing import List, Mapping, Optional, Set, Tuple
 
 from mythril_tpu.analysis.report import Issue
 from mythril_tpu.core.state.global_state import GlobalState
@@ -37,6 +38,17 @@ class DetectionModule:
     # (SURVEY.md §7.2 item 7).  Declare ONLY when _execute provably returns
     # without observable effect for all-concrete operands.
     concrete_nop_hooks: frozenset = frozenset()
+    # taint-source hooks: opcode -> frontier taint bit.  Declares that this
+    # module's hook on the opcode does nothing but annotate the pushed
+    # result with the annotation class registered for the bit
+    # (frontier/taint.py) — the arena row graph reproduces that dataflow
+    # exactly, so the device emits NO event for the opcode at all (the
+    # engine seeds the bit on the source's env row and the walker
+    # synthesizes the annotation at sinks from the row's taint closure).
+    # Declare ONLY for hooks whose sole observable effect is that
+    # annotation.  (Immutable default: a mutation would otherwise write
+    # into a dict shared by every module class.)
+    taint_source_hooks: Mapping[str, int] = MappingProxyType({})
 
     def __init__(self):
         self.issues: List[Issue] = []

@@ -15,12 +15,20 @@ from mythril_tpu.analysis.solver import get_transaction_sequence
 from mythril_tpu.analysis.swc_data import TX_ORIGIN_USAGE
 from mythril_tpu.core.state.global_state import GlobalState
 from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.frontier import taint
 
 DESCRIPTION = "Check whether control flow decisions are influenced by tx.origin."
 
 
 class TxOriginAnnotation:
     """Taint marker set on the ORIGIN opcode's result."""
+
+
+taint.register(
+    taint.TAINT_ORIGIN,
+    TxOriginAnnotation,
+    lambda a: isinstance(a, TxOriginAnnotation),
+)
 
 
 class TxOrigin(DetectionModule):
@@ -30,6 +38,10 @@ class TxOrigin(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI"]
     post_hooks = ["ORIGIN"]
+    # the ORIGIN post-hook only annotates the pushed value; the frontier
+    # reproduces it from the seeded taint bit on the origin env row, so
+    # device-executed ORIGINs ship no event (frontier/taint.py)
+    taint_source_hooks = {"ORIGIN": taint.TAINT_ORIGIN}
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
         if self._cache_key(state) in self.cache:

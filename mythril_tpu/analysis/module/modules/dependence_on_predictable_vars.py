@@ -14,6 +14,7 @@ from mythril_tpu.analysis.solver import get_transaction_sequence
 from mythril_tpu.analysis.swc_data import TIMESTAMP_DEPENDENCE, WEAK_RANDOMNESS
 from mythril_tpu.core.state.global_state import GlobalState
 from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.frontier import taint
 
 DESCRIPTION = (
     "Check whether important control flow decisions are influenced by block.coinbase, "
@@ -35,6 +36,26 @@ class PredictablePathAnnotation:
         self.location = location
 
 
+# one taint bit per predictable source: the operation name feeds both the
+# issue text and the SWC id split (coinbase/blockhash -> weak randomness),
+# so the bit must round-trip to the exact operation
+_TAINT_OPS = {
+    "TIMESTAMP": (taint.TAINT_TIMESTAMP, "block.timestamp"),
+    "NUMBER": (taint.TAINT_NUMBER, "block.number"),
+    "COINBASE": (taint.TAINT_COINBASE, "block.coinbase"),
+    "GASLIMIT": (taint.TAINT_GASLIMIT, "block.gaslimit"),
+    "BLOCKHASH": (taint.TAINT_BLOCKHASH, "blockhash"),
+}
+
+for _bit, _op in _TAINT_OPS.values():
+    taint.register(
+        _bit,
+        (lambda op: lambda: PredictableValueAnnotation(op))(_op),
+        (lambda op: lambda a: isinstance(a, PredictableValueAnnotation)
+         and a.operation == op)(_op),
+    )
+
+
 class PredictableVariables(DetectionModule):
     name = "Control flow depends on a predictable environment variable"
     swc_id = f"{TIMESTAMP_DEPENDENCE}.{WEAK_RANDOMNESS}"
@@ -42,6 +63,13 @@ class PredictableVariables(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI", "BLOCKHASH"]
     post_hooks = ["BLOCKHASH"] + PREDICTABLE_OPS
+    # the post-hooks on the four block-attribute pushes only annotate the
+    # result; seeded taint bits on their env rows reproduce that, so the
+    # device ships no events for them.  BLOCKHASH stays undeclared: it has
+    # a pre-hook too and parks on device anyway.
+    taint_source_hooks = {
+        op: _TAINT_OPS[op][0] for op in PREDICTABLE_OPS
+    }
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
         if self._cache_key(state) in self.cache:

@@ -41,9 +41,15 @@ class HostArena:
         self.width = np.zeros(cap, np.int32)
         self.val = np.zeros((cap, LIMBS), np.uint32)
         self.isconst = np.zeros(cap, bool)
+        # host-only taint bitmask per row (frontier/taint.py): seeded on env
+        # source rows and on mid-frame re-entry rows; device rows stay 0 and
+        # inherit taint through the ref graph (walker._annos closure) — the
+        # device never reads or ships this column
+        self.taint = np.zeros(cap, np.int32)
         self.length = 0
 
         self._const_memo: Dict[tuple, int] = {}
+        self._taint_memo: Dict[tuple, int] = {}
         # var table: row id -> host Term (opaque encode / seed symbols)
         self._vars: List[T.Term] = []
         self._var_memo: Dict[T.Term, int] = {}
@@ -68,6 +74,12 @@ class HostArena:
         self.length += 1
         return i
 
+    def add_taint(self, row: int, bits: int) -> None:
+        """OR taint bits onto a row (rows are interned, so bits accumulate
+        — matching host annotation sets, which are shared and append-only)."""
+        if row >= 0 and bits:
+            self.taint[row] |= bits
+
     def const_row(self, value: int, width: int = 256) -> int:
         key = (value, width)
         row = self._const_memo.get(key)
@@ -80,17 +92,53 @@ class HostArena:
         """Opaque row bound to an arbitrary host term (totalizes encoding)."""
         row = self._var_memo.get(term)
         if row is None:
-            self._vars.append(term)
-            row = self._append(
-                O.A_VAR,
-                a=len(self._vars) - 1,
-                width=term.width if T.is_bv_sort(term.sort) else 0,
-            )
-            if term.is_const:
-                self.val[row] = from_ints(term.value, 256)
-                self.isconst[row] = True
+            row = self.fresh_var_row(term)
             self._var_memo[term] = row
-            self._decode_memo[row] = term
+        return row
+
+    def fresh_var_row(self, term: T.Term, no_fold: bool = False) -> int:
+        """A DEDICATED (non-interned) opaque row for a term.
+
+        Taint bits are per-row, but host taint is per-USE: the symbolic tx
+        driver sets ``origin = caller = sender_n`` (transaction/symbolic.py
+        seed_message_call), so seeding TAINT_ORIGIN on the interned row of
+        that term would taint every ``msg.sender`` comparison and fabricate
+        SWC-115s the host engine (which annotates only the wrapper the
+        ORIGIN opcode pushed) never reports.  Source ctx slots therefore
+        get their own row; it decodes to the same term, so solver and
+        report semantics are untouched.
+
+        ``no_fold``: leave the const payload off even for constant terms.
+        Device constant folds emit REF-LESS rows (a folded comparison
+        becomes the shared row_one/row_zero), which would cut a tainted
+        constant source (gaslimit) out of the walker's taint closure — on
+        the host the annotation survives folding because it rides the
+        wrapper.  A no-fold row keeps the dataflow edge; the decode still
+        yields the constant term, so every downstream fold happens exactly
+        at decode/solve time."""
+        self._vars.append(term)
+        row = self._append(
+            O.A_VAR,
+            a=len(self._vars) - 1,
+            width=term.width if T.is_bv_sort(term.sort) else 0,
+        )
+        if term.is_const and not no_fold:
+            self.val[row] = from_ints(term.value, 256)
+            self.isconst[row] = True
+        self._decode_memo[row] = term
+        return row
+
+    def tainted_row(self, term: T.Term, mask: int) -> int:
+        """Dedicated row carrying taint bits, memoized per (term, mask) so
+        repeated mid-frame re-entries of annotated values do not grow the
+        arena unboundedly (identical term + identical taint is semantically
+        the same use)."""
+        key = (term, mask)
+        row = self._taint_memo.get(key)
+        if row is None:
+            row = self.fresh_var_row(term, no_fold=True)
+            self.taint[row] |= mask
+            self._taint_memo[key] = row
         return row
 
     # ------------------------------------------------------------------

@@ -330,7 +330,14 @@ class FrontierEngine:
         An opcode is concrete-nop when EVERY hook on it (pre and post) is a
         bound method of a module that declares it in ``concrete_nop_hooks``
         — the device may then suppress its events for all-concrete operands
-        (the hook provably does nothing there)."""
+        (the hook provably does nothing there).
+
+        An opcode is dropped from the hooked set ENTIRELY when every hook
+        on it is a declared taint source (module ``taint_source_hooks``):
+        its only effect — annotating the pushed value — is reproduced by
+        the seeded taint bit on the source's env row plus the walker's
+        row-graph closure (frontier/taint.py), so device executions need
+        no event at all."""
         # defaultdict access creates empty entries; only real hooks count
         hooked = {
             op
@@ -347,7 +354,27 @@ class FrontierEngine:
                 for hook in reg.get(op, [])
             ):
                 conc_nop.add(op)
-        return hooked, conc_nop
+        from mythril_tpu.frontier import taint
+
+        def _declared_bit(hook, op):
+            decl = getattr(getattr(hook, "__self__", None),
+                           "taint_source_hooks", {})
+            return decl.get(op) if hasattr(decl, "get") else None
+
+        # drop only when every hook declares the op AND the declared bit is
+        # actually seeded + registered (taint.suppressible) — an undeclared
+        # or unseedable bit would silently disable the detector on device
+        taint_src = {
+            op
+            for op in hooked
+            if all(
+                (bit := _declared_bit(hook, op)) is not None
+                and taint.suppressible(bit)
+                for reg in (laser._pre_hooks, laser._post_hooks)
+                for hook in reg.get(op, [])
+            )
+        }
+        return hooked - taint_src, conc_nop
 
     def _seed_ctx(self, arena: HostArena, gs, seed_idx: int) -> np.ndarray:
         from mythril_tpu.smt import symbol_factory
@@ -355,7 +382,6 @@ class FrontierEngine:
         env = gs.environment
         ctx = np.full(16, -1, np.int32)
         ctx[CTX_CALLER] = arena.var_row(env.sender.raw)
-        ctx[CTX_ORIGIN] = arena.var_row(env.origin.raw)
         ctx[CTX_CALLVALUE] = arena.var_row(env.callvalue.raw)
         ctx[CTX_ADDRESS] = arena.var_row(env.address.raw)
         ctx[CTX_CDSIZE] = arena.var_row(env.calldata.calldatasize.raw)
@@ -364,18 +390,44 @@ class FrontierEngine:
             env.active_account.storage._array.raw
         )
         ctx[CTX_GASPRICE] = arena.var_row(env.gasprice.raw)
-        ctx[CTX_COINBASE] = arena.var_row(gs.new_bitvec("coinbase", 256).raw)
-        ctx[CTX_TIMESTAMP] = arena.var_row(
-            symbol_factory.BitVecSym("timestamp", 256).raw
-        )
-        ctx[CTX_NUMBER] = arena.var_row(env.block_number.raw)
         ctx[CTX_DIFFICULTY] = arena.var_row(
             gs.new_bitvec("block_difficulty", 256).raw
         )
-        ctx[CTX_GASLIMIT] = arena.const_row(gs.mstate.gas_limit, 256)
+        # taint-source slots use DEDICATED rows (arena.fresh_var_row): host
+        # taint is per-USE, and origin aliases the sender term / gaslimit is
+        # a constant a program literal could equal — tainting an interned
+        # row would leak the bit to non-source uses of the same term
+        from mythril_tpu.smt import terms as _T
+
+        # no_fold: a device constant fold emits a REF-LESS row, which would
+        # cut the tainted gaslimit constant out of the walker's closure —
+        # the host annotation survives folding on the wrapper, so the
+        # device must keep the dataflow edge (the branch forks symbolically
+        # and the infeasible side dies at the sibling check's decode fold)
+        ctx[CTX_GASLIMIT] = arena.fresh_var_row(
+            _T.const(gs.mstate.gas_limit, 256), no_fold=True
+        )
+        ctx[CTX_ORIGIN] = arena.fresh_var_row(env.origin.raw)
+        ctx[CTX_TIMESTAMP] = arena.fresh_var_row(
+            symbol_factory.BitVecSym("timestamp", 256).raw
+        )
+        ctx[CTX_NUMBER] = arena.fresh_var_row(env.block_number.raw)
+        ctx[CTX_COINBASE] = arena.fresh_var_row(
+            gs.new_bitvec("coinbase", 256).raw
+        )
         ctx[CTX_CHAINID] = arena.var_row(env.chainid.raw)
         ctx[CTX_BASEFEE] = arena.var_row(env.basefee.raw)
         ctx[CTX_SEED] = seed_idx
+        # taint-source seeding (frontier/taint.py): any row whose closure
+        # reaches one of these source rows carries the bit — the device-side
+        # form of the post-hook annotation on the source opcode's result
+        from mythril_tpu.frontier import taint
+
+        arena.add_taint(ctx[CTX_ORIGIN], taint.TAINT_ORIGIN)
+        arena.add_taint(ctx[CTX_TIMESTAMP], taint.TAINT_TIMESTAMP)
+        arena.add_taint(ctx[CTX_NUMBER], taint.TAINT_NUMBER)
+        arena.add_taint(ctx[CTX_COINBASE], taint.TAINT_COINBASE)
+        arena.add_taint(ctx[CTX_GASLIMIT], taint.TAINT_GASLIMIT)
         return ctx
 
     def _inject(self, st: FrontierState, slot: int, seed_idx: int,
@@ -429,11 +481,26 @@ class FrontierEngine:
             depth = int(getattr(gs.mstate, "depth", 0) or 0)
             if max(pc, mem_size, depth) > I32_MAX:
                 return None
+            # host-installed taint annotations must survive re-entry as row
+            # bits or the sink check would miss them (frontier/taint.py).
+            # A TAINTED wrapper gets a DEDICATED opaque row: tainting the
+            # interned/structural row would leak the bit to every other use
+            # of the same term (origin aliases the sender term — the exact
+            # false-SWC-115 fabrication fresh_var_row exists to prevent)
+            from mythril_tpu.frontier import taint
+
+            def enc(wrapper) -> int:
+                mask = taint.mask_for_annotations(
+                    getattr(wrapper, "annotations", ())
+                )
+                if not mask:
+                    return arena.encode(wrapper.raw)
+                return arena.tainted_row(wrapper.raw, mask)
+
             mem_pairs = [
-                (a, arena.encode(gs.mstate.memory.get_word_at(a).raw))
-                for a in windows
+                (a, enc(gs.mstate.memory.get_word_at(a))) for a in windows
             ]
-            stack_rows = [arena.encode(v.raw) for v in gs.mstate.stack]
+            stack_rows = [enc(v) for v in gs.mstate.stack]
             return {
                 "pc": pc,
                 "stack": stack_rows,

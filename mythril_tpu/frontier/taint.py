@@ -1,0 +1,102 @@
+"""Taint bits over the arena row graph: device-evaluated detector sources.
+
+Host taint is annotation objects on smt wrappers, installed by detector
+post-hooks on taint-source opcodes (reference
+mythril/analysis/module/modules/dependence_on_origin.py:60-66: ORIGIN's
+result is annotated, a JUMPI whose condition carries the annotation raises
+the issue).  On the device frontier, every value is an arena row and every
+row records the rows it was computed from — the ref graph IS an exact
+dataflow (taint) relation, computed for free by the segment.  So a
+taint-source hook needs NO device event and NO host replay: the engine
+seeds the source's env row with a taint bit (`HostArena.add_taint`), and
+the walker, when decoding any row at a sink (a JUMPI condition, a CALL
+argument), unions in the annotations synthesized from the taint bits
+reachable in the row's dependency closure — the same reachability the
+host's operator-level annotation unions compute.
+
+A detection module opts in by declaring ``taint_source_hooks`` (see
+analysis/module/base.py): a mapping from hooked opcode to the taint bit
+that reproduces its post-hook's only effect.  When EVERY hook on an opcode
+is so declared, the engine drops the opcode from the evented set entirely
+(frontier/engine._hook_info) — unlike ``concrete_nop_hooks``, which still
+events on symbolic operands, a taint-source opcode never ships an event.
+
+The registry below maps bits to annotation factories (used by the walker
+to synthesize instances) and matchers (used by the mid-frame encoder to
+map a host wrapper's annotations back to bits when a host-stepped state
+re-enters the device).  Modules register at import; unregistered bits
+synthesize nothing, so seeding is harmless when a module is disabled.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+TAINT_ORIGIN = 1 << 0
+TAINT_TIMESTAMP = 1 << 1
+TAINT_NUMBER = 1 << 2
+TAINT_COINBASE = 1 << 3
+TAINT_GASLIMIT = 1 << 4
+TAINT_BLOCKHASH = 1 << 5
+
+# bits the engine actually seeds on env source rows (_seed_ctx).  A module
+# declaring a taint_source_hook with a bit outside this set (or without a
+# registered factory) keeps its device events: suppressing them would
+# silently disable the detector on device paths, since nothing would ever
+# carry the bit.  BLOCKHASH is deliberately absent — it parks on device.
+SEEDED_BITS = frozenset(
+    {TAINT_ORIGIN, TAINT_TIMESTAMP, TAINT_NUMBER, TAINT_COINBASE,
+     TAINT_GASLIMIT}
+)
+
+
+def suppressible(bit: int) -> bool:
+    """True when dropping a source hook's device events is safe: the engine
+    seeds the bit and a registered factory can synthesize the annotation."""
+    return bit in SEEDED_BITS and bit in _factories
+
+# bit -> () -> annotation instance (singletons: annotations are inspected
+# by isinstance / attribute only, never mutated per-site)
+_factories: Dict[int, Callable[[], object]] = {}
+_singletons: Dict[int, object] = {}
+# (bit, annotation -> bool): reverse mapping for host->device re-entry
+_matchers: List[Tuple[int, Callable[[object], bool]]] = []
+
+
+def register(bit: int, factory: Callable[[], object],
+             matcher: Callable[[object], bool]) -> None:
+    """Bind a taint bit to its annotation class (idempotent per bit)."""
+    if bit in _factories:
+        return
+    _factories[bit] = factory
+    _matchers.append((bit, matcher))
+
+
+def annotations_for_mask(mask: int) -> Tuple[object, ...]:
+    """Synthesized annotation instances for a row taint mask, in ascending
+    bit order (deterministic: the first predictable-op annotation names the
+    operation in the issue text, so the order must not depend on dict or
+    scheduling state)."""
+    if not mask:
+        return ()
+    out = []
+    for bit in sorted(_factories):
+        if mask & bit:
+            inst = _singletons.get(bit)
+            if inst is None:
+                inst = _singletons[bit] = _factories[bit]()
+            out.append(inst)
+    return tuple(out)
+
+
+def mask_for_annotations(annotations) -> int:
+    """Taint bits equivalent to a host wrapper's annotations (mid-frame
+    device re-entry: a host-installed annotation must survive as a bit on
+    the encoded row or the sink check would miss it)."""
+    mask = 0
+    for a in annotations:
+        for bit, match in _matchers:
+            if match(a):
+                mask |= bit
+                break
+    return mask

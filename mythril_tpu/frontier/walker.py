@@ -96,6 +96,7 @@ class Walker:
         if got is not None:
             return got
         out: Set = set()
+        mask = 0
         stack = [int(row)]
         seen = set()
         while stack:
@@ -107,10 +108,18 @@ class Walker:
             if w is not None:
                 out.update(getattr(w, "annotations", ()))
             ar = self.arena
+            mask |= int(ar.taint[r])
             for ch in (ar.a[r], ar.b[r], ar.c[r]):
                 ch = int(ch)
                 if ch >= 0 and ar._row_has_term_arg(r, ch):
                     stack.append(ch)
+        if mask:
+            # taint-source bits reachable in the closure synthesize the
+            # annotations their post-hooks would have installed — those
+            # hooks' opcodes ship no device events at all (frontier/taint.py)
+            from mythril_tpu.frontier import taint
+
+            out.update(taint.annotations_for_mask(mask))
         result = frozenset(out)
         self._anno_memo[row] = result
         return result

@@ -1,0 +1,217 @@
+"""Device taint columns: taint-source hooks ship no events, sinks still fire.
+
+The ref graph of the arena is an exact dataflow relation, so a module that
+declares ``taint_source_hooks`` (its post-hook only annotates the result)
+needs no device event at all: the engine seeds a taint bit on the source's
+env row and the walker synthesizes the annotation at sinks from the row's
+dependency closure (frontier/taint.py).
+"""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.frontier import taint
+from mythril_tpu.frontier.arena import HostArena
+from mythril_tpu.smt import terms as T
+
+
+def test_hook_info_drops_taint_source_opcodes():
+    """ORIGIN (only TxOrigin's declared source hook) leaves the evented
+    set; JUMPI (a sink pre-hook) stays."""
+    from mythril_tpu.analysis.module.modules.dependence_on_origin import TxOrigin
+    from mythril_tpu.frontier.engine import FrontierEngine
+
+    mod = TxOrigin()
+
+    class FakeLaser:
+        _pre_hooks = {"JUMPI": [mod.execute]}
+        _post_hooks = {"ORIGIN": [mod.execute]}
+
+    hooked, conc_nop = FrontierEngine._hook_info(FakeLaser())
+    assert "ORIGIN" not in hooked
+    assert "JUMPI" in hooked
+
+
+def test_hook_info_keeps_op_with_undeclared_cohook():
+    """A second, undeclared hook on the same opcode blocks suppression."""
+    from mythril_tpu.analysis.module.modules.dependence_on_origin import TxOrigin
+    from mythril_tpu.frontier.engine import FrontierEngine
+
+    mod = TxOrigin()
+
+    def profiler_hook(state):
+        pass
+
+    class FakeLaser:
+        _pre_hooks = {}
+        _post_hooks = {"ORIGIN": [mod.execute, profiler_hook]}
+
+    hooked, _ = FrontierEngine._hook_info(FakeLaser())
+    assert "ORIGIN" in hooked
+
+
+def test_walker_synthesizes_annotations_from_taint_closure():
+    """A row computed FROM a tainted env row decodes with the synthesized
+    annotation, exactly as if the source post-hook had annotated it."""
+    from mythril_tpu.analysis.module.modules.dependence_on_origin import (
+        TxOriginAnnotation,
+    )
+    from mythril_tpu.analysis.module.modules.dependence_on_predictable_vars import (
+        PredictableValueAnnotation,
+    )
+    from mythril_tpu.frontier import ops as O
+    from mythril_tpu.frontier.walker import Walker
+
+    arena = HostArena(256)
+    origin_row = arena.var_row(T.var("origin_t", 256))
+    ts_row = arena.var_row(T.var("timestamp_t", 256))
+    caller_row = arena.var_row(T.var("caller_t", 256))
+    arena.add_taint(origin_row, taint.TAINT_ORIGIN)
+    arena.add_taint(ts_row, taint.TAINT_TIMESTAMP)
+
+    # cond = (origin == caller), like the tx.origin auth check
+    eq_row = arena._append(O.A_EQ, a=origin_row, b=caller_row, width=0)
+    # untainted sibling: caller-only comparison
+    clean_row = arena._append(
+        O.A_EQ, a=caller_row, b=arena.const_row(7, 256), width=0
+    )
+    # timestamp flows through arithmetic
+    ts_sum = arena._append(O.A_ADD, a=ts_row, b=arena.const_row(1, 256), width=256)
+
+    walker = Walker([], arena, [], [])
+    annos = walker.decode_wrapped(eq_row).annotations
+    assert any(isinstance(a, TxOriginAnnotation) for a in annos)
+    assert not any(isinstance(a, PredictableValueAnnotation) for a in annos)
+
+    annos_ts = walker.decode_wrapped(ts_sum).annotations
+    preds = [a for a in annos_ts if isinstance(a, PredictableValueAnnotation)]
+    assert preds and preds[0].operation == "block.timestamp"
+    assert not any(isinstance(a, TxOriginAnnotation) for a in annos_ts)
+
+    assert walker.decode_wrapped(clean_row).annotations == frozenset()
+
+
+def test_mask_round_trip_through_mid_frame_annotations():
+    """Host annotations -> bits -> synthesized annotations is identity on
+    the classes the registry knows."""
+    from mythril_tpu.analysis.module.modules.dependence_on_origin import (
+        TxOriginAnnotation,
+    )
+    from mythril_tpu.analysis.module.modules.dependence_on_predictable_vars import (
+        PredictableValueAnnotation,
+    )
+
+    annos = [TxOriginAnnotation(), PredictableValueAnnotation("block.number")]
+    mask = taint.mask_for_annotations(annos)
+    assert mask == taint.TAINT_ORIGIN | taint.TAINT_NUMBER
+    out = taint.annotations_for_mask(mask)
+    assert any(isinstance(a, TxOriginAnnotation) for a in out)
+    assert any(
+        isinstance(a, PredictableValueAnnotation)
+        and a.operation == "block.number"
+        for a in out
+    )
+    # unknown annotations map to no bits
+    assert taint.mask_for_annotations([object()]) == 0
+
+
+def test_device_run_ships_no_source_events():
+    """End-to-end: the tx.origin contract analyzed with the frontier emits
+    no ORIGIN hook events (the taint bit carries the information), and the
+    issue still fires at the JUMPI sink."""
+    import sys
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent))
+    from test_frontier_engine import DISPATCH, analyze
+
+    from mythril_tpu.frontier.code import CodeTables
+    from mythril_tpu.frontier.stats import FrontierStatistics
+
+    # 32 33 14 ... : ORIGIN CALLER EQ JUMPI
+    body = "323314601b5700" "5b00"
+    stats = FrontierStatistics()
+    stats.reset()
+    issues = analyze(DISPATCH + body, modules=["TxOrigin"], frontier=True)
+    assert len(issues) == 1 and issues[0].swc_id == "115"
+    assert stats.device_instructions > 0, "frontier did not run"
+
+    # and the dispatch tables the engine would build mark ORIGIN un-evented
+    from mythril_tpu.frontier.arena import HostArena as _HA
+    from mythril_tpu.frontend.disassembler import Disassembly
+
+    instrs = Disassembly(bytes.fromhex(DISPATCH + body)).instruction_list
+    tables = CodeTables(
+        instrs, _HA(1024), hooked_opcodes={"JUMPI"}  # ORIGIN dropped
+    )
+    origin_idx = [
+        i for i, ins in enumerate(instrs) if ins.opcode == "ORIGIN"
+    ]
+    assert origin_idx and not tables.event[origin_idx[0]]
+
+
+def test_origin_sender_aliasing_does_not_taint_caller():
+    """origin and caller are the SAME term (seed_message_call); taint seeded
+    on the dedicated origin row must not reach caller-only conditions —
+    regression for a fabricated SWC-115 on every msg.sender check."""
+    from mythril_tpu.analysis.module.modules.dependence_on_origin import (
+        TxOriginAnnotation,
+    )
+    from mythril_tpu.frontier import ops as O
+    from mythril_tpu.frontier.walker import Walker
+
+    arena = HostArena(256)
+    sender = T.var("sender_1", 256)
+    caller_row = arena.var_row(sender)
+    origin_row = arena.fresh_var_row(sender)  # same term, dedicated row
+    assert caller_row != origin_row
+    assert arena.decode(caller_row) is arena.decode(origin_row)
+    arena.add_taint(origin_row, taint.TAINT_ORIGIN)
+
+    owner_row = arena.const_row(0xAA, 256)
+    caller_check = arena._append(O.A_EQ, a=caller_row, b=owner_row, width=0)
+    origin_check = arena._append(O.A_EQ, a=origin_row, b=owner_row, width=0)
+
+    walker = Walker([], arena, [], [])
+    assert not any(
+        isinstance(a, TxOriginAnnotation)
+        for a in walker.decode_wrapped(caller_check).annotations
+    )
+    assert any(
+        isinstance(a, TxOriginAnnotation)
+        for a in walker.decode_wrapped(origin_check).annotations
+    )
+
+
+def test_differential_gaslimit_vs_literal():
+    """GASLIMIT compared against a literal: host folding keeps the
+    annotation on the wrapper, so the device must not erase the tainted
+    constant's dataflow edge with a ref-less fold (no_fold seed row) —
+    regression for a frontier-only SWC-116 miss."""
+    import sys
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent))
+    from test_frontier_engine import DISPATCH, analyze, issue_keys
+
+    # 45 GASLIMIT; PUSH4 0x01312d00 (20M); EQ; JUMPI -> STOP / JUMPDEST STOP
+    body = "456301312d0014601c57005b00"
+    host = analyze(DISPATCH + body, modules=["PredictableVariables"])
+    dev = analyze(
+        DISPATCH + body, modules=["PredictableVariables"], frontier=True
+    )
+    assert issue_keys(host) == issue_keys(dev)
+    assert any(i.swc_id == "116" for i in host)
+
+
+def test_tainted_row_memoized_per_term_and_mask():
+    """Mid-frame re-entry rows are bounded: same (term, mask) reuses the
+    dedicated row; a different mask gets its own."""
+    arena = HostArena(64)
+    t1 = T.var("w1", 256)
+    r1 = arena.tainted_row(t1, taint.TAINT_ORIGIN)
+    assert arena.tainted_row(t1, taint.TAINT_ORIGIN) == r1
+    r2 = arena.tainted_row(t1, taint.TAINT_TIMESTAMP)
+    assert r2 != r1
+    assert arena.taint[r1] == taint.TAINT_ORIGIN
+    assert arena.taint[r2] == taint.TAINT_TIMESTAMP
