@@ -45,6 +45,7 @@ class Asm:
         "SLOAD": 0x54, "SSTORE": 0x55, "JUMP": 0x56, "JUMPI": 0x57,
         "JUMPDEST": 0x5B, "GAS": 0x5A, "CALL": 0xF1, "RETURN": 0xF3,
         "SELFDESTRUCT": 0xFF, "REVERT": 0xFD, "TIMESTAMP": 0x42,
+        "STATICCALL": 0xFA, "ORIGIN": 0x32,
     }
 
     def __init__(self):

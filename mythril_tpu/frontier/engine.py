@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier import taint
 from mythril_tpu.frontier.arena import HostArena
 from mythril_tpu.frontier.code import (
     CTX_ADDRESS,
@@ -167,7 +168,9 @@ def _frame_ok(gs) -> bool:
         isinstance(gs.current_transaction, MessageCallTransaction)
         and gs.environment.code is not None
         and len(gs.environment.code.instruction_list) > 0
-        and not gs.environment.static
+        # static (STATICCALL) frames are eligible: the per-path static flag
+        # halts state-mutating ops as terminals whose replay raises the
+        # host WriteProtection (step.py write-violation override)
     )
 
 
@@ -354,8 +357,6 @@ class FrontierEngine:
                 for hook in reg.get(op, [])
             ):
                 conc_nop.add(op)
-        from mythril_tpu.frontier import taint
-
         def _declared_bit(hook, op):
             decl = getattr(getattr(hook, "__self__", None),
                            "taint_source_hooks", {})
@@ -418,26 +419,25 @@ class FrontierEngine:
         ctx[CTX_CHAINID] = arena.var_row(env.chainid.raw)
         ctx[CTX_BASEFEE] = arena.var_row(env.basefee.raw)
         ctx[CTX_SEED] = seed_idx
-        # taint-source seeding (frontier/taint.py): any row whose closure
-        # reaches one of these source rows carries the bit — the device-side
-        # form of the post-hook annotation on the source opcode's result
-        from mythril_tpu.frontier import taint
-
-        arena.add_taint(ctx[CTX_ORIGIN], taint.TAINT_ORIGIN)
-        arena.add_taint(ctx[CTX_TIMESTAMP], taint.TAINT_TIMESTAMP)
-        arena.add_taint(ctx[CTX_NUMBER], taint.TAINT_NUMBER)
-        arena.add_taint(ctx[CTX_COINBASE], taint.TAINT_COINBASE)
-        arena.add_taint(ctx[CTX_GASLIMIT], taint.TAINT_GASLIMIT)
+        # taint-source seeding: any row whose closure reaches one of these
+        # source rows carries the bit — the device-side form of the
+        # post-hook annotation.  ENV_SOURCE_SLOTS is the same table
+        # taint.suppressible consults, so a suppressible bit is always
+        # seeded here.
+        for bit, slot in taint.ENV_SOURCE_SLOTS.items():
+            arena.add_taint(ctx[slot], bit)
         return ctx
 
     def _inject(self, st: FrontierState, slot: int, seed_idx: int,
-                ctx: np.ndarray, code_idx: int, score: int = 0) -> None:
+                ctx: np.ndarray, code_idx: int, score: int = 0,
+                static: int = 0) -> None:
         clear_slot(st, slot)
         st.seed[slot] = seed_idx
         st.halt[slot] = O.H_RUNNING
         st.ctx[slot] = ctx
         st.code_id[slot] = code_idx
         st.score[slot] = score
+        st.static[slot] = static
 
     def _encode_mid(self, arena: HostArena, gs) -> Optional[dict]:
         """Pack a mid-frame host state for device re-entry, or None.
@@ -487,8 +487,6 @@ class FrontierEngine:
             # interned/structural row would leak the bit to every other use
             # of the same term (origin aliases the sender term — the exact
             # false-SWC-115 fabrication fresh_var_row exists to prevent)
-            from mythril_tpu.frontier import taint
-
             def enc(wrapper) -> int:
                 mask = taint.mask_for_annotations(
                     getattr(wrapper, "annotations", ())
@@ -629,13 +627,19 @@ class FrontierEngine:
 
         beam = _sel_mode(laser0) == step_mod.SEL_BEAM
 
+        statics = [
+            1 if getattr(gs.environment, "static", False) else 0
+            for gs in seeds
+        ]
+
         # initial fill
         for slot in range(caps.B):
             if not seed_queue:
                 break
             si = seed_queue.pop(0)
             self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
-                         _beam_importance(seeds[si]) if beam else 0)
+                         _beam_importance(seeds[si]) if beam else 0,
+                         static=statics[si])
             if mid_enc[si] is not None:
                 self._apply_mid(st, slot, mid_enc[si])
                 FrontierStatistics().mid_injections += 1
@@ -765,7 +769,8 @@ class FrontierEngine:
                 if rec is None and seed_queue:
                     si = seed_queue.pop(0)
                     self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
-                                 _beam_importance(seeds[si]) if beam else 0)
+                                 _beam_importance(seeds[si]) if beam else 0,
+                                 static=statics[si])
                     if mid_enc[si] is not None:
                         self._apply_mid(st, slot, mid_enc[si])
                         FrontierStatistics().mid_injections += 1

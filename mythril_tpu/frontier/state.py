@@ -79,6 +79,9 @@ class FrontierState(NamedTuple):
     gas_max: np.ndarray  # [B] i32
     depth: np.ndarray  # [B] i32 control-flow transfers (max_depth cap)
     loops: np.ndarray  # [B, n_loops] i32 per-JUMPDEST visit counts
+    static: np.ndarray  # [B] i32 STATICCALL write protection: state-mutating
+    # ops (SSTORE/LOG/SELFDESTRUCT) halt the path as a terminal whose replay
+    # raises the host WriteProtection (instructions.py StateTransition)
 
 
 def empty_state(caps: Caps, n_loops: int) -> FrontierState:
@@ -108,6 +111,7 @@ def empty_state(caps: Caps, n_loops: int) -> FrontierState:
         gas_max=np.zeros(B, np.int32),
         depth=np.zeros(B, np.int32),
         loops=np.zeros((B, n_loops), np.int32),
+        static=np.zeros(B, np.int32),
     )
 
 
@@ -136,3 +140,4 @@ def clear_slot(st: FrontierState, i: int) -> None:
     st.depth[i] = 0
     st.loops[i] = 0
     st.pc[i] = 0
+    st.static[i] = 0

@@ -792,6 +792,18 @@ def build_segment(caps: Caps):
 
         out = jax.lax.switch(jnp.clip(fam, 0, len(handlers) - 1), handlers, None)
 
+        # STATICCALL write protection: a state-mutating op in a static
+        # frame halts as a terminal; its E_TERMINAL replay re-executes the
+        # op on the host carrier, whose StateTransition raises the real
+        # WriteProtection (instructions.py is_state_mutation_instruction)
+        write_viol = (st.static != 0) & (
+            (fam == O.F_SSTORE) | (fam == O.F_LOG) | (fam == O.F_SELFDESTRUCT)
+        )
+        out = jax.tree.map(
+            lambda a, b: jnp.where(write_viol, a, b),
+            base_out(st._replace(halt=jnp.asarray(O.H_INVALID, I32))), out,
+        )
+
         # underflow: exceptional halt, path dies silently
         # (reference svm.py:289-295 -> _handle_vm_exception -> [])
         out = jax.tree.map(

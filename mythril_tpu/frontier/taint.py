@@ -39,20 +39,38 @@ TAINT_COINBASE = 1 << 3
 TAINT_GASLIMIT = 1 << 4
 TAINT_BLOCKHASH = 1 << 5
 
-# bits the engine actually seeds on env source rows (_seed_ctx).  A module
-# declaring a taint_source_hook with a bit outside this set (or without a
-# registered factory) keeps its device events: suppressing them would
-# silently disable the detector on device paths, since nothing would ever
-# carry the bit.  BLOCKHASH is deliberately absent — it parks on device.
-SEEDED_BITS = frozenset(
-    {TAINT_ORIGIN, TAINT_TIMESTAMP, TAINT_NUMBER, TAINT_COINBASE,
-     TAINT_GASLIMIT}
-)
+# THE table tying each seedable bit to the env ctx slot whose row carries
+# it: engine._seed_ctx iterates this to seed, and ``suppressible`` guards
+# event suppression with it — one source of truth, so a bit cannot be
+# declared suppressible without also being seeded.  The row at each slot
+# must be DEDICATED (arena.fresh_var_row), never interned — see
+# _seed_ctx's no_fold/aliasing comments.  BLOCKHASH is deliberately
+# absent: it parks on device, so its host hooks always run.
+ENV_SOURCE_SLOTS = {}  # populated below to avoid a circular import dance
+
+
+def _env_source_slots():
+    from mythril_tpu.frontier.code import (
+        CTX_COINBASE, CTX_GASLIMIT, CTX_NUMBER, CTX_ORIGIN, CTX_TIMESTAMP,
+    )
+
+    return {
+        TAINT_ORIGIN: CTX_ORIGIN,
+        TAINT_TIMESTAMP: CTX_TIMESTAMP,
+        TAINT_NUMBER: CTX_NUMBER,
+        TAINT_COINBASE: CTX_COINBASE,
+        TAINT_GASLIMIT: CTX_GASLIMIT,
+    }
+
+
+ENV_SOURCE_SLOTS = _env_source_slots()
+SEEDED_BITS = frozenset(ENV_SOURCE_SLOTS)
 
 
 def suppressible(bit: int) -> bool:
     """True when dropping a source hook's device events is safe: the engine
-    seeds the bit and a registered factory can synthesize the annotation."""
+    seeds the bit (ENV_SOURCE_SLOTS) and a registered factory can
+    synthesize the annotation."""
     return bit in SEEDED_BITS and bit in _factories
 
 # bit -> () -> annotation instance (singletons: annotations are inspected

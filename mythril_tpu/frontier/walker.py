@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier import taint
 from mythril_tpu.frontier.arena import HostArena
 from mythril_tpu.frontier.records import PathRecord
 from mythril_tpu.plugins.signals import PluginSkipState
@@ -117,8 +118,6 @@ class Walker:
             # taint-source bits reachable in the closure synthesize the
             # annotations their post-hooks would have installed — those
             # hooks' opcodes ship no device events at all (frontier/taint.py)
-            from mythril_tpu.frontier import taint
-
             out.update(taint.annotations_for_mask(mask))
         result = frozenset(out)
         self._anno_memo[row] = result

@@ -1,0 +1,173 @@
+"""STATICCALL callee frames on the device frontier (ROADMAP item 4).
+
+Static frames used to be excluded from eligibility entirely, so every
+STATICCALL-heavy view function host-stepped.  The per-path ``static`` flag
+lifts the exclusion: state-mutating ops (SSTORE/LOG/SELFDESTRUCT) halt the
+path as a terminal whose E_TERMINAL replay re-executes the op on the host
+carrier — whose StateTransition raises the real WriteProtection
+(mythril_tpu/core/instructions.py:114-117, reference
+mythril/laser/ethereum/instructions.py StateTransition.check_gas wrapper).
+"""
+
+import pathlib
+import sys
+
+from collections import namedtuple
+
+import jax
+import numpy as np
+import pytest
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.arena import HostArena
+from mythril_tpu.frontier.code import CodeTables, stacked_device_tables
+from mythril_tpu.frontier.state import Caps, empty_state
+from mythril_tpu.frontier.step import ArenaDev, CfgScalars, CodeDev, cached_segment
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))
+
+Ins = namedtuple("Ins", "opcode address arg_int")
+
+# PUSH1 1; PUSH1 0; SSTORE; STOP
+WRITE_PROGRAM = [
+    Ins("PUSH1", 0, 1),
+    Ins("PUSH1", 2, 0),
+    Ins("SSTORE", 4, None),
+    Ins("STOP", 5, None),
+]
+
+CAPS = Caps(B=2, K=8)
+
+
+def _run_write_program(static: int):
+    arena = HostArena(CAPS.ARENA)
+    row_zero = arena.const_row(0, 256)
+    row_one = arena.const_row(1, 256)
+    tables = CodeTables(WRITE_PROGRAM, arena)
+    instr_cap, addr_cap, loops_cap = tables.size_bucket()
+    segment = cached_segment(CAPS, 1, instr_cap, addr_cap, loops_cap)
+    code_dev = CodeDev(*[
+        jax.device_put(a)
+        for a in stacked_device_tables([tables], (1, instr_cap, addr_cap, loops_cap))
+    ])
+    cfg = CfgScalars(
+        max_depth=np.int32(128),
+        loop_bound=np.int32(0),
+        row_zero=np.int32(row_zero),
+        row_one=np.int32(row_one),
+        sel_mode=np.int32(0),
+    )
+    st = empty_state(CAPS, loops_cap)
+    st.seed[0] = 0
+    st.halt[0] = O.H_RUNNING
+    st.static[0] = static
+    # storage array row for ctx (SSTORE rewrites it)
+    from mythril_tpu.smt import terms as T
+
+    st.ctx[0] = arena.var_row(T.array_var("storage_t", 256, 256))
+    dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
+    visited = jax.device_put(np.zeros((1, instr_cap), bool))
+    out_state, _a, _l, n_exec, _m, _v = segment(
+        st, dev_arena, arena.length, visited, code_dev, cfg
+    )
+    return np.array(out_state.halt)[0], np.array(out_state.pc)[0], int(n_exec)
+
+
+def test_static_flag_halts_sstore_as_terminal():
+    halt, pc, _ = _run_write_program(static=1)
+    assert halt == O.H_INVALID
+    assert pc == 2  # still AT the SSTORE: the replay re-executes it on host
+
+
+def test_nonstatic_sstore_completes():
+    halt, _pc, n = _run_write_program(static=0)
+    assert halt == O.H_STOP
+    assert n == 4
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: STATICCALL into a view function with a tx.origin check
+# ---------------------------------------------------------------------------
+
+
+def _staticcall_contract() -> bytes:
+    """fn outer (byte 0x01): STATICCALLs fn view; SSTOREs the success flag.
+    fn view (byte 0x02): JUMPI on ORIGIN==CALLER (SWC-115 inside the static
+    frame); the taken branch attempts SSTORE (write-protected when called
+    via outer), the fall-through returns 1."""
+    from bench_contracts import Asm
+
+    a = Asm()
+    a.push(0).op("CALLDATALOAD").push(0xF8).op("SHR")
+    a.op("DUP1").push(0x01).op("EQ").jumpi("outer")
+    a.op("DUP1").push(0x02).op("EQ").jumpi("view")
+    a.revert()
+
+    a.label("outer")
+    # memory[0] = selector byte for view (0x02 << 248)
+    a.push(0x02).push(248).op("SHL").push(0).op("MSTORE")
+    # staticcall(gas, address(this), 0, 1, 32, 32)
+    a.push(32).push(32).push(1).push(0)
+    a.op("ADDRESS")
+    a.push(50000)
+    a.op("STATICCALL")
+    a.push(0).op("SSTORE")
+    a.op("STOP")
+
+    a.label("view")
+    a.op("ORIGIN", "CALLER", "EQ").jumpi("view_write")
+    a.push(1).push(0).op("MSTORE").push(32).push(0).op("RETURN")
+    a.label("view_write")
+    # write attempt inside the static frame: dies with WriteProtection
+    a.push(7).push(1).op("SSTORE")
+    a.op("STOP")
+    return a.assemble()
+
+
+def _analyze(code: bytes, frontier: bool):
+    from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.support.support_args import args as global_args
+
+    reset_callback_modules()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        if hasattr(m, "cache"):
+            m.cache.clear()
+    old = (global_args.frontier, global_args.frontier_force)
+    global_args.frontier = frontier
+    global_args.frontier_force = frontier
+    try:
+        sym = SymExecWrapper(
+            code,
+            address=0x0901D12E,
+            strategy="bfs",
+            transaction_count=1,
+            execution_timeout=60,
+            modules=["TxOrigin"],
+        )
+        return fire_lasers(sym, white_list=["TxOrigin"])
+    finally:
+        global_args.frontier, global_args.frontier_force = old
+
+
+def keys(issues):
+    return sorted((i.swc_id, i.address, i.function) for i in issues)
+
+
+def test_staticcall_view_frame_host_parity():
+    from mythril_tpu.frontier.stats import FrontierStatistics
+
+    code = _staticcall_contract()
+    host = _analyze(code, frontier=False)
+    FrontierStatistics().reset()
+    dev = _analyze(code, frontier=True)
+    stats = FrontierStatistics().as_dict()
+    assert keys(host) == keys(dev), (
+        f"static-frame issues diverged: host={keys(host)} dev={keys(dev)}"
+    )
+    # the ORIGIN JUMPI inside the view function must be reported (the
+    # direct-entry path at least; the static path reports the same key)
+    assert any(i.swc_id == "115" for i in dev), "view-frame SWC-115 lost"
+    assert stats["device_instructions"] > 0, "frontier never engaged"
