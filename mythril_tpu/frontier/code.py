@@ -29,9 +29,14 @@ from mythril_tpu.frontier import ops as O
 from mythril_tpu.frontier.arena import HostArena
 
 # ops that always record an event regardless of hooks: the walker needs them
-# to keep carrier memory/storage/constraints exact between hook sites
+# to keep carrier storage/constraints exact between hook sites.  MSTORE is
+# NOT here: carrier memory is rebuilt from the device's word table at
+# terminals/parks (records.snapshot_slot "mem" + walker._restore_memory),
+# so memory writes — the densest op class in solc output — only event when
+# a hook needs them (and the user_assertions panic gate suppresses even
+# those for concrete non-panic values, see ``value_gate_opcodes``).
 _ALWAYS_EVENT = {
-    "JUMPI", "SSTORE", "SLOAD", "MSTORE", "MSTORE8",
+    "JUMPI", "SSTORE", "SLOAD", "MSTORE8",
     "STOP", "RETURN", "REVERT", "SELFDESTRUCT", "INVALID", "ASSERT_FAIL",
 }
 
@@ -74,6 +79,7 @@ class CodeTables:
         hooked_opcodes: Optional[Iterable[str]] = None,
         code_size: Optional[int] = None,
         conc_nop_opcodes: Optional[Iterable[str]] = None,
+        value_gate_opcodes: Optional[Iterable[str]] = None,
     ):
         from mythril_tpu.support.opcodes import OPCODES
 
@@ -82,6 +88,10 @@ class CodeTables:
         # operands (module concrete_nop_hooks): evented, but the device
         # suppresses the event when operand concreteness proves the no-op
         conc_nop: Set[str] = set(conc_nop_opcodes or ()) - _ALWAYS_EVENT
+        # MSTORE panic gate (module value_gated_hooks): event only when the
+        # stored value is symbolic or its top 32 bits are the solc
+        # Panic(uint256) selector — the single case the hook observes
+        val_gate: Set[str] = set(value_gate_opcodes or ()) & {"MSTORE"}
         n = len(instruction_list)
         self.n = n
         self.instruction_list = instruction_list
@@ -92,6 +102,7 @@ class CodeTables:
         self.gmax = np.zeros(n + 1, np.int32)
         self.event = np.zeros(n + 1, bool)
         self.concskip = np.zeros(n + 1, bool)
+        self.valgate = np.zeros(n + 1, bool)
         self.addr = np.zeros(n + 1, np.int32)
         self.opcode_names: List[str] = []
 
@@ -110,6 +121,7 @@ class CodeTables:
                 self.arity[i], self.gmin[i], self.gmax[i] = arity, g0, g1
             self.event[i] = name in _ALWAYS_EVENT or name in hooked
             self.concskip[i] = name in conc_nop
+            self.valgate[i] = name in val_gate
             fam, aux = self._classify(ins, arena, code_size)
             self.fam[i], self.aux[i] = fam, aux
             if name == "JUMPDEST":
@@ -216,6 +228,7 @@ class CodeTables:
             pad1(self.jumpmap, addr_cap, -1),
             pad1(loop_id, instr_cap, -1),
             pad1(self.concskip, instr_cap, False),
+            pad1(self.valgate, instr_cap, False),
         )
 
 
@@ -278,7 +291,7 @@ def stacked_device_tables(tables: List["CodeTables"], bucket: tuple):
     code_cap, instr_cap, addr_cap, loops_cap = bucket
     per_code = [t.padded_device_tables((instr_cap, addr_cap, loops_cap))
                 for t in tables]
-    fills = (O.F_STOP, 0, 0, 0, 0, True, -1, -1, False)
+    fills = (O.F_STOP, 0, 0, 0, 0, True, -1, -1, False, False)
     out = []
     for col, fill in enumerate(fills):
         first = per_code[0][col]

@@ -327,8 +327,8 @@ class FrontierEngine:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _hook_info(laser) -> Tuple[set, set]:
-        """(hooked opcodes, concrete-nop opcodes) for this laser.
+    def _hook_info(laser) -> Tuple[set, set, set]:
+        """(hooked, concrete-nop, value-gated) opcode sets for this laser.
 
         An opcode is concrete-nop when EVERY hook on it (pre and post) is a
         bound method of a module that declares it in ``concrete_nop_hooks``
@@ -340,7 +340,11 @@ class FrontierEngine:
         its only effect — annotating the pushed value — is reproduced by
         the seeded taint bit on the source's env row plus the walker's
         row-graph closure (frontier/taint.py), so device executions need
-        no event at all."""
+        no event at all.
+
+        The value-gated set (module ``value_gated_hooks``) marks opcodes
+        whose events the device ships only when the value operand is
+        symbolic or carries the solc panic selector (the MSTORE gate)."""
         # defaultdict access creates empty entries; only real hooks count
         hooked = {
             op
@@ -375,7 +379,17 @@ class FrontierEngine:
                 for hook in reg.get(op, [])
             )
         }
-        return hooked - taint_src, conc_nop
+        val_gate = {
+            op
+            for op in hooked
+            if all(
+                op in getattr(getattr(hook, "__self__", None),
+                              "value_gated_hooks", ())
+                for reg in (laser._pre_hooks, laser._post_hooks)
+                for hook in reg.get(op, [])
+            )
+        }
+        return hooked - taint_src, conc_nop, val_gate
 
     def _seed_ctx(self, arena: HostArena, gs, seed_idx: int) -> np.ndarray:
         from mythril_tpu.smt import symbol_factory
@@ -556,7 +570,7 @@ class FrontierEngine:
             if ci is None:
                 ci = len(tables)
                 table_idx[key] = ci
-                hooked, conc_nop = self._hook_info(laser)
+                hooked, conc_nop, val_gate = self._hook_info(laser)
                 tables.append(
                     CodeTables(
                         code.instruction_list,
@@ -565,6 +579,7 @@ class FrontierEngine:
                         code_size=len(getattr(code, "bytecode", b"") or b"")
                         or None,
                         conc_nop_opcodes=conc_nop,
+                        value_gate_opcodes=val_gate,
                     )
                 )
                 table_laser.append(laser)

@@ -47,8 +47,11 @@ class PathRecord:
 
 def snapshot_slot(st, slot: int) -> dict:
     """Copy the per-slot device state (numpy mirror) for final processing."""
-    # carrier memory/storage/constraints are rebuilt from event replay
-    # (code.py _ALWAYS_EVENT), so only walker.finish's inputs are kept here
+    # carrier storage/constraints are rebuilt from event replay (code.py
+    # _ALWAYS_EVENT); memory is NOT — most MSTOREs ship no event, so the
+    # word table rides the snapshot and walker._restore_memory writes it
+    # into the carrier before the terminal replay / park resume
+    mem_len = int(st.mem_len[slot])
     return {
         "halt": int(st.halt[slot]),
         "pc": int(st.pc[slot]),
@@ -57,4 +60,10 @@ def snapshot_slot(st, slot: int) -> dict:
         "gas_max": int(st.gas_max[slot]),
         "depth": int(st.depth[slot]),
         "mem_size": int(st.mem_size[slot]),
+        "mem": list(
+            zip(
+                st.mem_addr[slot, :mem_len].tolist(),
+                st.mem_val[slot, :mem_len].tolist(),
+            )
+        ),
     }

@@ -39,6 +39,10 @@ TAINT_COINBASE = 1 << 3
 TAINT_GASLIMIT = 1 << 4
 TAINT_BLOCKHASH = 1 << 5
 
+from mythril_tpu.frontier.code import (
+    CTX_COINBASE, CTX_GASLIMIT, CTX_NUMBER, CTX_ORIGIN, CTX_TIMESTAMP,
+)
+
 # THE table tying each seedable bit to the env ctx slot whose row carries
 # it: engine._seed_ctx iterates this to seed, and ``suppressible`` guards
 # event suppression with it — one source of truth, so a bit cannot be
@@ -46,24 +50,13 @@ TAINT_BLOCKHASH = 1 << 5
 # must be DEDICATED (arena.fresh_var_row), never interned — see
 # _seed_ctx's no_fold/aliasing comments.  BLOCKHASH is deliberately
 # absent: it parks on device, so its host hooks always run.
-ENV_SOURCE_SLOTS = {}  # populated below to avoid a circular import dance
-
-
-def _env_source_slots():
-    from mythril_tpu.frontier.code import (
-        CTX_COINBASE, CTX_GASLIMIT, CTX_NUMBER, CTX_ORIGIN, CTX_TIMESTAMP,
-    )
-
-    return {
-        TAINT_ORIGIN: CTX_ORIGIN,
-        TAINT_TIMESTAMP: CTX_TIMESTAMP,
-        TAINT_NUMBER: CTX_NUMBER,
-        TAINT_COINBASE: CTX_COINBASE,
-        TAINT_GASLIMIT: CTX_GASLIMIT,
-    }
-
-
-ENV_SOURCE_SLOTS = _env_source_slots()
+ENV_SOURCE_SLOTS = {
+    TAINT_ORIGIN: CTX_ORIGIN,
+    TAINT_TIMESTAMP: CTX_TIMESTAMP,
+    TAINT_NUMBER: CTX_NUMBER,
+    TAINT_COINBASE: CTX_COINBASE,
+    TAINT_GASLIMIT: CTX_GASLIMIT,
+}
 SEEDED_BITS = frozenset(ENV_SOURCE_SLOTS)
 
 

@@ -209,6 +209,24 @@ class Walker:
         carrier.mstate.min_gas_used = base[0] + gmin
         carrier.mstate.max_gas_used = base[1] + gmax
 
+    def _restore_memory(self, rec: PathRecord) -> None:
+        """Write the device's word table into the carrier memory.
+
+        Most MSTOREs ship no event (code.py: MSTORE left _ALWAYS_EVENT;
+        the user_assertions panic gate suppresses hook events for concrete
+        non-panic values), so carrier memory is rebuilt wholesale from the
+        final snapshot — once per path instead of once per write.  Called
+        before the terminal event replays (RETURN/REVERT read their
+        payload from memory) and before a parked carrier resumes on the
+        host engine."""
+        final = rec.final
+        if final is None or rec.carrier is None:
+            return
+        for addr, row in final.get("mem", ()):
+            rec.carrier.mstate.memory.write_word_at(
+                int(addr), self.decode_wrapped(int(row))
+            )
+
     def _process_event(self, rec: PathRecord, ev: np.ndarray) -> None:
         carrier = rec.carrier
         kind = int(ev[O.EV_KIND])
@@ -217,6 +235,11 @@ class Walker:
         self._set_gas(carrier, rec.seed_idx, int(ev[O.EV_GMIN]), int(ev[O.EV_GMAX]))
 
         laser = self.laser_for(rec)
+        if kind == O.E_TERMINAL:
+            # the terminal instruction (RETURN/REVERT payload, LOG data)
+            # reads carrier memory, which per-write replay no longer keeps
+            # current — install the device word table first
+            self._restore_memory(rec)
         if kind in (O.E_HOOK, O.E_TERMINAL):
             self._set_stack_from_ops(carrier, ev)
             new_states, op_code = laser.execute_state(carrier)
@@ -339,6 +362,7 @@ class Walker:
             if carrier is None:
                 return
             snap = rec.final
+            self._restore_memory(rec)
             carrier.mstate.pc = snap["pc"]
             carrier.mstate.stack[:] = [
                 self.decode_wrapped(int(r)) for r in snap["stack"]

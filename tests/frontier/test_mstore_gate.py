@@ -1,0 +1,116 @@
+"""MSTORE value gate: memory writes stop shipping events.
+
+MSTORE left _ALWAYS_EVENT: carrier memory is rebuilt from the device word
+table at terminals/parks (walker._restore_memory), and the only MSTORE
+hook in the module set — UserAssertions' Panic(uint256) check — declares
+``value_gated_hooks``, so the device events only symbolic stores and
+concrete stores carrying the panic selector in their top 32 bits.
+"""
+
+from collections import namedtuple
+
+import jax
+import numpy as np
+import pytest
+
+from mythril_tpu.analysis.module.modules.user_assertions import PANIC_SELECTOR
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.arena import HostArena
+from mythril_tpu.frontier.code import CodeTables, stacked_device_tables
+from mythril_tpu.frontier.state import Caps, empty_state
+from mythril_tpu.frontier.step import ArenaDev, CfgScalars, CodeDev, cached_segment
+
+Ins = namedtuple("Ins", "opcode address arg_int")
+
+CAPS = Caps(B=2, K=16)
+
+
+def _run_mstore(value: int, gated: bool):
+    """PUSH32 value; PUSH1 0; MSTORE; STOP — returns final ev_len."""
+    program = [
+        Ins("PUSH32", 0, value),
+        Ins("PUSH1", 33, 0),
+        Ins("MSTORE", 35, None),
+        Ins("STOP", 36, None),
+    ]
+    arena = HostArena(CAPS.ARENA)
+    row_zero = arena.const_row(0, 256)
+    row_one = arena.const_row(1, 256)
+    tables = CodeTables(
+        program, arena,
+        hooked_opcodes={"MSTORE"},
+        value_gate_opcodes={"MSTORE"} if gated else None,
+    )
+    instr_cap, addr_cap, loops_cap = tables.size_bucket()
+    segment = cached_segment(CAPS, 1, instr_cap, addr_cap, loops_cap)
+    code_dev = CodeDev(*[
+        jax.device_put(a)
+        for a in stacked_device_tables([tables], (1, instr_cap, addr_cap, loops_cap))
+    ])
+    cfg = CfgScalars(
+        max_depth=np.int32(128), loop_bound=np.int32(0),
+        row_zero=np.int32(row_zero), row_one=np.int32(row_one),
+        sel_mode=np.int32(0),
+    )
+    st = empty_state(CAPS, loops_cap)
+    st.seed[0] = 0
+    st.halt[0] = O.H_RUNNING
+    dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
+    visited = jax.device_put(np.zeros((1, instr_cap), bool))
+    out_state, _a, _l, _n, _m, _v = segment(
+        st, dev_arena, arena.length, visited, code_dev, cfg
+    )
+    return int(np.array(out_state.ev_len)[0])
+
+
+def test_gated_nonpanic_store_ships_no_hook_event():
+    # only the STOP terminal events
+    assert _run_mstore(42, gated=True) == 1
+
+
+def test_gated_panic_store_still_events():
+    panic_word = (PANIC_SELECTOR << 224) | 0x11  # Panic(0x11): overflow
+    assert _run_mstore(panic_word, gated=True) == 2
+
+
+def test_ungated_store_events_as_before():
+    assert _run_mstore(42, gated=False) == 2
+
+
+def test_mstore_not_always_evented_without_hooks():
+    from mythril_tpu.frontier.code import _ALWAYS_EVENT
+
+    assert "MSTORE" not in _ALWAYS_EVENT
+
+
+def test_differential_panic_assertion_found():
+    """A reachable solc panic store must be flagged identically host vs
+    frontier (the gate must NOT suppress the panic event), and plain
+    memory traffic before it must not break the exploit report (carrier
+    memory restored from the word table)."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent))
+    from test_frontier_engine import analyze, issue_keys
+
+    # self-contained (Asm labels are absolute): scratch MSTOREs, then a
+    # branch on calldata whose taken side writes a Panic(uint256) payload
+    # to memory (the user_assertions pattern) and reverts
+    from bench_contracts import Asm
+
+    a = Asm()
+    a.push(0x60).push(0x40).op("MSTORE")          # scratch write (gated)
+    a.push(0).op("CALLDATALOAD")
+    a.push(1).op("AND").jumpi("panic")
+    a.op("STOP")
+    a.label("panic")
+    a.push(PANIC_SELECTOR << 224).push(0).op("MSTORE")
+    a.push(0x11).push(4).op("MSTORE")
+    a.push(0x24).push(0).op("REVERT")
+    code = a.assemble().hex()
+
+    host = analyze(code, modules=["UserAssertions"])
+    dev = analyze(code, modules=["UserAssertions"], frontier=True)
+    assert issue_keys(host) == issue_keys(dev)
+    assert any(i.swc_id == "110" for i in host), "panic assertion not found"

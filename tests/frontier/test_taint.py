@@ -27,7 +27,7 @@ def test_hook_info_drops_taint_source_opcodes():
         _pre_hooks = {"JUMPI": [mod.execute]}
         _post_hooks = {"ORIGIN": [mod.execute]}
 
-    hooked, conc_nop = FrontierEngine._hook_info(FakeLaser())
+    hooked, conc_nop, _vg = FrontierEngine._hook_info(FakeLaser())
     assert "ORIGIN" not in hooked
     assert "JUMPI" in hooked
 
@@ -46,7 +46,7 @@ def test_hook_info_keeps_op_with_undeclared_cohook():
         _pre_hooks = {}
         _post_hooks = {"ORIGIN": [mod.execute, profiler_hook]}
 
-    hooked, _ = FrontierEngine._hook_info(FakeLaser())
+    hooked, _cn, _vg = FrontierEngine._hook_info(FakeLaser())
     assert "ORIGIN" in hooked
 
 
