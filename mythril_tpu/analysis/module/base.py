@@ -50,11 +50,12 @@ class DetectionModule:
     # into a dict shared by every module class.)
     taint_source_hooks: Mapping[str, int] = MappingProxyType({})
     # value-gated hooks: the hook on this opcode is provably a NO-OP unless
-    # the value operand is symbolic or carries the solc Panic(uint256)
-    # selector in its top 32 bits (UserAssertions' MSTORE check).  The
-    # device then events only those stores — memory writes are the densest
-    # op class in solc output, and carrier memory is rebuilt from the
-    # device word table at terminals/parks instead of per-write replay.
+    # the value operand is CONCRETE with the solc Panic(uint256) selector
+    # in its top 32 bits (UserAssertions' MSTORE check — symbolic values
+    # no-op there too, value.value is None).  The device then events only
+    # those stores — memory writes are the densest op class in solc
+    # output, and carrier memory is rebuilt from the device word table at
+    # terminals/parks instead of per-write replay.
     value_gated_hooks: frozenset = frozenset()
 
     def __init__(self):

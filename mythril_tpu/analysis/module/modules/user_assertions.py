@@ -32,8 +32,9 @@ class UserAssertions(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["LOG1", "MSTORE"]
     # the MSTORE hook observes ONLY concrete values whose top 32 bits are
-    # the Panic(uint256) selector (line 51): the device may skip the event
-    # for every other concrete store (frontier/code.py value gate)
+    # the Panic(uint256) selector (line 51; symbolic values no-op too):
+    # the device may skip the event for every other store
+    # (frontier/code.py value gate)
     value_gated_hooks = frozenset({"MSTORE"})
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:

@@ -34,6 +34,11 @@ from mythril_tpu.support.time_handler import time_handler
 
 log = logging.getLogger(__name__)
 
+# host steps executed before the production frontier's first drain attempt
+# (multiple of the drain cadence 8): enough samples for host_step_rate, so
+# the engine's throughput bail starts informed instead of blind
+_FRONTIER_WARMUP_STEPS = 24
+
 LASER_HOOK_TYPES = (
     "start_sym_exec",
     "stop_sym_exec",
@@ -63,6 +68,10 @@ class LaserEVM:
         self.dynamic_loader = dynamic_loader
         self.open_states: List[WorldState] = []
         self.total_states = 0
+        # host stepping telemetry (exec loop): wall and count of host-side
+        # execute_state calls, consumed by the frontier's throughput bail
+        self._host_steps = 0
+        self._host_step_secs = 0.0
 
         self.work_list: List[GlobalState] = []
         self.strategy: BasicSearchStrategy = strategy(self.work_list, max_depth)
@@ -92,6 +101,13 @@ class LaserEVM:
 
         self.iprof = iprof
         self.executed_instruction_count = 0
+
+    def host_step_rate(self) -> Optional[float]:
+        """Measured host stepping rate (states/s) on this workload, or None
+        until enough samples exist to be meaningful."""
+        if self._host_steps < _FRONTIER_WARMUP_STEPS or self._host_step_secs <= 0:
+            return None
+        return self._host_steps / self._host_step_secs
 
     # ------------------------------------------------------------------
     # hook registration (reference svm.py:596-739)
@@ -225,10 +241,12 @@ class LaserEVM:
     def exec(self, create: bool = False, track_gas: bool = False) -> Optional[List[GlobalState]]:
         final_states: List[GlobalState] = []
         self._fire("start_exec")
-        if args.frontier and not create and not track_gas:
-            # batched device-resident frontier (SURVEY.md §7.1): eligible
-            # seeds execute on the TPU; parked paths fall through to the
-            # host loop below, which also handles anything frontier-ineligible
+        if args.frontier and args.frontier_force and not create and not track_gas:
+            # forced mode (tests, explicit override): engage the device
+            # before any host stepping.  The production path defers the
+            # first drain past a short host warmup (loop below) so the
+            # engine's throughput bail compares segment rates against the
+            # MEASURED host stepping rate instead of a blind floor.
             try:
                 from mythril_tpu.frontier import FrontierEngine
 
@@ -251,11 +269,20 @@ class LaserEVM:
             if time.time() > deadline or time_handler.time_remaining() <= 0:
                 log.info("%s timeout reached; halting exec loop", "create" if create else "execution")
                 break
+            t_step = time.time()
             new_states, op_code = self.execute_state(global_state)
             if self.requires_statespace:
                 self.manage_cfg(op_code, new_states)
             if not args.sparse_pruning:
                 new_states = self._prune_unsatisfiable(new_states)
+            # host stepping pace (states/s over the FULL iteration,
+            # including sibling pruning — the true wall cost of advancing
+            # one state on the host): the frontier's mid-run throughput
+            # bail compares device segment rates against it — the host's
+            # own pace on a workload spans 5..900 states/s, so no fixed
+            # floor can stand in for it
+            self._host_step_secs += time.time() - t_step
+            self._host_steps += 1
             self.work_list.extend(new_states)
             self.total_states += len(new_states)
             if track_gas and not new_states:
@@ -270,8 +297,17 @@ class LaserEVM:
             pending_seeds += len(new_states)
             # attempt a drain only once enough seeds accumulated to clear
             # the engine's own width gate — a handful would bail there
-            # anyway, and every attempt rescans the work list
-            if frontier_live and pending_seeds >= 8 and iteration % 8 == 0:
+            # anyway, and every attempt rescans the work list.  The FIRST
+            # attempt waits out a short host warmup (production mode): by
+            # then host_step_rate is measurable, so the engine's throughput
+            # bail starts informed; explorations shorter than the warmup
+            # are trivially host-fast and never engage the device at all.
+            if frontier_live and iteration % 8 == 0 and (
+                pending_seeds >= 8
+                or (iteration == _FRONTIER_WARMUP_STEPS and self.work_list)
+            ) and iteration >= (
+                0 if args.frontier_force else _FRONTIER_WARMUP_STEPS
+            ):
                 pending_seeds = 0
                 try:
                     from mythril_tpu.frontier import FrontierEngine

@@ -89,8 +89,9 @@ class CodeTables:
         # suppresses the event when operand concreteness proves the no-op
         conc_nop: Set[str] = set(conc_nop_opcodes or ()) - _ALWAYS_EVENT
         # MSTORE panic gate (module value_gated_hooks): event only when the
-        # stored value is symbolic or its top 32 bits are the solc
-        # Panic(uint256) selector — the single case the hook observes
+        # stored value is concrete with the solc Panic(uint256) selector in
+        # its top 32 bits — the single case the hook observes (symbolic
+        # values no-op there too)
         val_gate: Set[str] = set(value_gate_opcodes or ()) & {"MSTORE"}
         n = len(instruction_list)
         self.n = n

@@ -74,10 +74,36 @@ from mythril_tpu.support.time_handler import time_handler
 
 log = logging.getLogger(__name__)
 
-# codes a frontier run proved dynamically narrow (max live paths stayed under
-# caps.MIN_LIVE): later narrow drains skip the device for them a priori —
-# repeat tx rounds on a narrow contract must not re-pay the probe dispatches
+# codes a frontier run proved NOT WORTH the device on this link: either
+# dynamically narrow (max live paths stayed under caps.MIN_LIVE) or slow
+# (the mid-run throughput bail below) — later narrow drains skip the device
+# for them a priori; wide multi-code batches still admit them
 _NARROW_CODES: set = set()
+
+# mid-run throughput bail: consecutive post-warmup segments whose
+# (device instructions / SEGMENT-ONLY wall — dispatch + transfers, not
+# harvest, which is replay/confirmation work the host path pays too) fall
+# below the bail threshold hand the run to the host engine.  The only
+# correct baseline is the HOST's measured stepping rate on THIS workload
+# (laser.host_step_rate — it spans 5..900 states/s: heavy wide-mul term
+# construction vs light dispatch code), compared at a 0.7 safety factor.
+# Before enough host samples exist the floor below applies — LOW enough
+# that slow-host workloads (bectoken segments measure ~230 instr/s against
+# a 5 states/s host) are never bailed blind.  On an untunneled chip
+# segment walls shrink ~50x and the bail becomes unreachable.
+_SLOW_BAIL_FLOOR = 100.0
+_SLOW_BAIL_HOST_FACTOR = 0.7
+_SLOW_BAIL_SEGMENTS = 2
+
+# slow-segment counters persist ACROSS runs per code (short explorations
+# split into several 1-2 segment runs, so a per-run counter never reaches
+# the bail threshold); a fast segment resets its codes
+_SLOW_SEGMENTS: Dict[object, int] = {}
+
+# (caps, bucket) programs already dispatched once this process: their first
+# segment paid any XLA compile, so later runs' first segments count toward
+# the throughput bail
+_WARM_PROGRAMS: set = set()
 
 # static width hint: below this many JUMPIs across the seed codes a narrow
 # seed set cannot fan out wide enough to amortize segment dispatches
@@ -344,7 +370,8 @@ class FrontierEngine:
 
         The value-gated set (module ``value_gated_hooks``) marks opcodes
         whose events the device ships only when the value operand is
-        symbolic or carries the solc panic selector (the MSTORE gate)."""
+        CONCRETE with the solc panic selector in its top 32 bits (the
+        MSTORE gate; the hook no-ops on symbolic values too)."""
         # defaultdict access creates empty entries; only real hooks count
         hooked = {
             op
@@ -591,6 +618,9 @@ class FrontierEngine:
             bucket = tuple(max(b, f) for b, f in zip(bucket, bucket_floor))
         code_cap, instr_cap, addr_cap, loops_cap = bucket
         segment = cached_segment(caps, *bucket)
+        program_key = (caps, bucket)
+        program_warm = program_key in _WARM_PROGRAMS
+        _WARM_PROGRAMS.add(program_key)
         import jax
 
         # tables never change during the run: upload once, reuse per segment
@@ -728,6 +758,8 @@ class FrontierEngine:
         deadline = t_start + exec_timeout
         narrow_harvests = 0
         max_live = 0
+        run_segments = 0
+        slow_bailed = False
 
         width_verdict_valid = True  # False when the run was cut short
         while True:
@@ -765,7 +797,8 @@ class FrontierEngine:
             executed += n_exec_host
             stats.device_instructions += n_exec_host
             stats.segments += 1
-            stats.segment_s += time.time() - t_seg
+            seg_only = time.time() - t_seg
+            stats.segment_s += seg_only
 
             t_har = time.time()
             self._harvest(st, records, walker, ev_seen)
@@ -775,6 +808,54 @@ class FrontierEngine:
             # per-slot seen counters to match
             ev_seen.fill(0)
             stats.harvest_s += time.time() - t_har
+
+            # mid-run throughput accounting — BEFORE the exit checks below,
+            # so a run's final segment still counts (short explorations
+            # split into 1-2 segment drains would otherwise never
+            # accumulate a verdict): a run can stay live enough to dodge
+            # the narrow bail yet execute fewer instructions per second
+            # than the host engine steps (small programs over a high-RTT
+            # link).  Measured on SEGMENT wall only (dispatch + transfers)
+            # — harvest time is replay/confirmation work the host path
+            # pays too.  A run's first segment counts only when the
+            # program was already warm (else it may be paying the one-off
+            # XLA compile); counters persist across runs per code.
+            bail_now = False
+            if (run_segments > 0 or program_warm) and not args.frontier_force:
+                host_rates = [
+                    r for r in (
+                        getattr(laser, "host_step_rate", lambda: None)()
+                        for laser in lasers
+                    ) if r
+                ]
+                bail_rate = (
+                    _SLOW_BAIL_HOST_FACTOR * max(host_rates)
+                    if host_rates else _SLOW_BAIL_FLOOR
+                )
+                code_keys = [_code_key(c) for c in table_code]
+                if n_exec_host / max(seg_only, 1e-6) < bail_rate:
+                    counts = [_SLOW_SEGMENTS.get(k, 0) + 1 for k in code_keys]
+                    for k, c in zip(code_keys, counts):
+                        _SLOW_SEGMENTS[k] = c
+                    if max(counts) >= _SLOW_BAIL_SEGMENTS:
+                        log.info(
+                            "frontier: %d instructions in %.2fs (below "
+                            "%.0f/s); host engine takes over",
+                            n_exec_host, seg_only, bail_rate,
+                        )
+                        bail_now = True
+                else:
+                    for k in code_keys:
+                        _SLOW_SEGMENTS.pop(k, None)
+            run_segments += 1
+            if bail_now:
+                # BEFORE the refill below: injecting queued seeds just to
+                # park them straight back out would be a pure encode/park
+                # round trip per free slot
+                slow_bailed = True
+                width_verdict_valid = False
+                self._park_all(st, records, walker, reason="slow-bail")
+                break
 
             # refill free slots with queued seeds; under beam search
             # also refresh live slots' scores (a seed's shared annotation
@@ -827,12 +908,23 @@ class FrontierEngine:
             # process-wide.
             for code in table_code:
                 _NARROW_CODES.add(_code_key(code))
+        if slow_bailed:
+            # proven slower than host stepping ON THIS LINK: later narrow
+            # drains keep these codes host-side (wide multi-code batches
+            # still admit them — width amortizes the dispatch)
+            for code in table_code:
+                _NARROW_CODES.add(_code_key(code))
 
         visited_host = np.asarray(visited)
         for ci, (laser, code) in enumerate(zip(table_laser, table_code)):
             self._merge_coverage(visited_host[ci], tables[ci], code, laser)
         for i in bounced:
             seed_lasers[i].work_list.append(seeds[i])
+        # seeds still queued when a break path ended the loop (slow-bail,
+        # timeout, arena pressure) never occupied a slot: hand them back to
+        # their host work lists or their paths would silently vanish
+        for si in seed_queue:
+            seed_lasers[si].work_list.append(seeds[si])
         return executed
 
     @staticmethod

@@ -99,8 +99,8 @@ class CodeDev(NamedTuple):
     concskip: jnp.ndarray  # [C, N] bool — hooked-only event suppressible
     # when every popped operand is concrete (module concrete_nop_hooks)
     valgate: jnp.ndarray  # [C, N] bool — MSTORE panic gate (module
-    # value_gated_hooks): event only when the stored value is symbolic or
-    # carries the solc Panic(uint256) selector in its top 32 bits
+    # value_gated_hooks): event only when the stored value is concrete
+    # with the solc Panic(uint256) selector in its top 32 bits
 
 
 class CfgScalars(NamedTuple):
@@ -872,12 +872,13 @@ def build_segment(caps: Caps):
         all_conc = jnp.asarray(True)
         for j in range(7):
             all_conc = all_conc & ((arity <= j) | pop_c[j])
-        # MSTORE panic gate: the stored value (operand 1) is concrete and
-        # its top 32 bits are NOT the solc Panic(uint256) selector
-        # 0x4E487B71 — the declared hook provably ignores it (16-bit limbs:
-        # bits 224-239 are limb 14, 240-255 limb 15)
-        nonpanic = pop_c[1] & ~(
-            (pop_v[1][14] == 0x7B71) & (pop_v[1][15] == 0x4E48)
+        # MSTORE panic gate: the declared hook observes ONLY concrete
+        # values whose top 32 bits are the solc Panic(uint256) selector
+        # 0x4E487B71 (it no-ops on symbolic values too, value.value is
+        # None there) — suppress everything else (16-bit limbs: bits
+        # 224-239 are limb 14, 240-255 limb 15)
+        nonpanic = ~(
+            pop_c[1] & (pop_v[1][14] == 0x7B71) & (pop_v[1][15] == 0x4E48)
         )
         emit = (
             code.event[cid, pc]

@@ -41,15 +41,24 @@ def measure_dispatch_rtt_ms() -> Optional[float]:
         return None
     try:
         import jax
-        import jax.numpy as jnp
+        import numpy as np
 
         f = jax.jit(lambda x: x + 1)
-        x = jnp.zeros((8,), jnp.int32)
-        f(x).block_until_ready()  # compile outside the timed runs
+
+        def roundtrip():
+            # a FRESH host upload and a FORCED host readback: on tunneled
+            # backends, block_until_ready() alone completes on the local
+            # async completion signal (~0.05 ms measured against a ~120 ms
+            # link) and would mis-scale every break-even ~50x toward
+            # over-dispatching
+            y = f(jax.device_put(np.zeros((8,), np.int32)))
+            np.asarray(y)
+
+        roundtrip()  # compile + first-transfer setup outside the timed runs
         samples = []
         for _ in range(3):
             t0 = time.perf_counter()
-            f(x).block_until_ready()
+            roundtrip()
             samples.append((time.perf_counter() - t0) * 1000.0)
         samples.sort()
         return samples[1]

@@ -208,3 +208,88 @@ def test_differential_corpus_contracts(fixture, module, swc):
     dev = analyze(code, tx_count=2, modules=[module], frontier=True)
     assert issue_keys(host) == issue_keys(dev)
     assert any(i.swc_id == swc for i in dev)
+
+
+def test_slow_codes_gate_blocks_narrow_drains():
+    """A code marked not-worthwhile (narrow or the mid-run throughput
+    bail) is skipped by later narrow drains; wide seed sets still go
+    (width amortizes dispatch)."""
+    from mythril_tpu.frontier import engine as E
+
+    class _Code:
+        def __init__(self, bytecode):
+            self.bytecode = bytecode
+
+    class _Env:
+        def __init__(self, code):
+            self.code = code
+
+    class _GS:
+        def __init__(self, code):
+            self.environment = _Env(code)
+
+    code = _Code(b"\x60\x00" * 40)
+    eng = E.FrontierEngine.__new__(E.FrontierEngine)
+    eng.caps = E.Caps(B=64)
+    pairs = [(None, _GS(code))]
+    key = E._code_key(code)
+    old_force = E.args.frontier_force
+    E.args.frontier_force = False
+    try:
+        E._NARROW_CODES.add(key)
+        assert not eng._device_worthwhile(pairs)
+        # a wide seed set bypasses the per-code memo entirely
+        wide = [(None, _GS(code)) for _ in range(eng.caps.MIN_LIVE)]
+        assert eng._device_worthwhile(wide)
+    finally:
+        E._NARROW_CODES.discard(key)
+        E.args.frontier_force = old_force
+
+
+def test_break_paths_return_queued_seeds_to_work_list():
+    """Seeds queued beyond the batch width when a run ends on a break path
+    (slow-bail/timeout/arena) must land back on their laser's work list —
+    regression for silently vanished exploration states."""
+    from mythril_tpu.frontier import engine as E
+    from mythril_tpu.frontier.state import Caps
+
+    # a tiny batch (B=2) with 5 eligible fresh seeds and an immediate
+    # execution timeout: the loop breaks on the timeout path with seeds
+    # still queued
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+
+    reset_callback_modules()
+    old = (global_args.frontier, global_args.frontier_force)
+    global_args.frontier = False
+    global_args.frontier_force = True
+    try:
+        sym = SymExecWrapper(
+            bytes.fromhex(DISPATCH + "33ff"),
+            address=0x0901D12E,
+            strategy="dfs",
+            transaction_count=1,
+            execution_timeout=60,
+            modules=["AccidentallyKillable"],
+            defer_exec=True,
+        )
+        laser = sym.laser
+        from mythril_tpu.core.transaction.symbolic import seed_message_call
+
+        laser.open_states = [sym.deferred_world_state]
+        seed_message_call(laser, 0x0901D12E)
+        seed = laser.work_list[0]
+        import copy as _c
+
+        laser.work_list.extend(_c.copy(seed) for _ in range(4))
+        n_before = len(laser.work_list)
+        assert n_before == 5
+
+        engine = E.FrontierEngine(laser, Caps(B=2))
+        laser.execution_timeout = 0  # loop hits the timeout break instantly
+        engine.drain_work_list()
+        # every seed must be back (order/form may differ: parked carriers)
+        assert len(laser.work_list) == n_before, (
+            f"{n_before - len(laser.work_list)} seeds vanished"
+        )
+    finally:
+        global_args.frontier, global_args.frontier_force = old

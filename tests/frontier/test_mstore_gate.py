@@ -25,14 +25,8 @@ Ins = namedtuple("Ins", "opcode address arg_int")
 CAPS = Caps(B=2, K=16)
 
 
-def _run_mstore(value: int, gated: bool):
-    """PUSH32 value; PUSH1 0; MSTORE; STOP — returns final ev_len."""
-    program = [
-        Ins("PUSH32", 0, value),
-        Ins("PUSH1", 33, 0),
-        Ins("MSTORE", 35, None),
-        Ins("STOP", 36, None),
-    ]
+def _run_program(program, gated: bool, seed_ctx: bool = False) -> int:
+    """Run ``program`` as one device segment; returns final ev_len."""
     arena = HostArena(CAPS.ARENA)
     row_zero = arena.const_row(0, 256)
     row_one = arena.const_row(1, 256)
@@ -55,6 +49,10 @@ def _run_mstore(value: int, gated: bool):
     st = empty_state(CAPS, loops_cap)
     st.seed[0] = 0
     st.halt[0] = O.H_RUNNING
+    if seed_ctx:
+        from mythril_tpu.smt import terms as T
+
+        st.ctx[0] = arena.var_row(T.var("seed_ctx", 256))
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
     visited = jax.device_put(np.zeros((1, instr_cap), bool))
     out_state, _a, _l, _n, _m, _v = segment(
@@ -63,9 +61,35 @@ def _run_mstore(value: int, gated: bool):
     return int(np.array(out_state.ev_len)[0])
 
 
+def _run_mstore(value: int, gated: bool) -> int:
+    """PUSH32 value; PUSH1 0; MSTORE; STOP — returns final ev_len."""
+    return _run_program(
+        [
+            Ins("PUSH32", 0, value),
+            Ins("PUSH1", 33, 0),
+            Ins("MSTORE", 35, None),
+            Ins("STOP", 36, None),
+        ],
+        gated=gated,
+    )
+
+
 def test_gated_nonpanic_store_ships_no_hook_event():
     # only the STOP terminal events
     assert _run_mstore(42, gated=True) == 1
+
+
+def test_gated_symbolic_store_ships_no_hook_event():
+    """The hook no-ops on symbolic values too (value.value is None), so a
+    symbolic store — the common ABI-marshalling case — must not event."""
+    program = [
+        Ins("PUSH1", 0, 0),
+        Ins("CALLDATALOAD", 2, None),
+        Ins("PUSH1", 3, 0),
+        Ins("MSTORE", 5, None),
+        Ins("STOP", 6, None),
+    ]
+    assert _run_program(program, gated=True, seed_ctx=True) == 1
 
 
 def test_gated_panic_store_still_events():
