@@ -382,6 +382,31 @@ def _assembled_corpus():
 _corpus_warmed = False
 
 
+def _ttfr(per_name, t0: float) -> float:
+    """Time-to-FULL-recall: wall seconds until EVERY expected corpus
+    exploit has been discovered (max over contracts of the earliest
+    matching stamp).  First-exploit TTFE structurally favors the
+    sequential schedule (contract #1 confirms before contract #2 even
+    starts); full recall is what a corpus user actually waits for, and is
+    where the cooperative lockstep schedule can win."""
+    from mythril_tpu.analysis.report import StartTime
+
+    base = StartTime().global_start_time
+    latest = None
+    for name, swc in CORPUS_RECALL.items():
+        issues = per_name.get(name)
+        if issues is None:
+            continue  # contract lives on another shard
+        stamps = [i.discovery_time for i in issues if i.swc_id == swc]
+        if not stamps:
+            return float("nan")
+        first = min(stamps)
+        latest = first if latest is None else max(latest, first)
+    if latest is None:
+        return float("nan")
+    return max(0.0, base + latest - t0)
+
+
 def wl_corpus(production: bool):
     """THE HEADLINE: the whole reference corpus.  Baseline analyzes one
     contract at a time (the reference's corpus flow, mythril_analyzer.py:
@@ -486,12 +511,14 @@ def wl_corpus(production: bool):
     ttfe = _ttfe(
         [i for i in all_issues if i.swc_id in set(CORPUS_RECALL.values())], t0
     )
+    per_name = issues_by_name if production else issue_lists
     return (
         states,
         wall,
         ttfe,
         (dev_delta if production else None),
         (har_delta if production else None),
+        _ttfr(per_name, t0),
     )
 
 
@@ -553,6 +580,7 @@ def main() -> None:
     for name, fn, unit, reps in WORKLOADS:
         samples = {"baseline": [], "production": []}
         ttfes = {"baseline": [], "production": []}
+        ttfrs = {"baseline": [], "production": []}
         residency = []
         harvest_shares = []
         for _rep in range(reps):
@@ -565,6 +593,8 @@ def main() -> None:
                 samples[tag].append(work / wall if wall > 0 else 0.0)
                 if ttfe == ttfe:  # not NaN
                     ttfes[tag].append(ttfe)
+                if len(out) > 5 and out[5] == out[5]:  # time-to-full-recall
+                    ttfrs[tag].append(out[5])
                 # residency = device-executed instructions / states explored:
                 # meaningful only for state-counting workloads, and a
                 # workload that warms up internally supplies its own delta
@@ -630,6 +660,21 @@ def main() -> None:
                 for tag, vals in ttfes.items()
                 if vals
             },
+            # corpus only: time-to-FULL-recall — the metric the cooperative
+            # schedule optimizes (first-exploit TTFE structurally favors the
+            # sequential schedule, which confirms contract #1 before
+            # contract #2 even starts)
+            **(
+                {
+                    "ttfr_s": {
+                        tag: round(sorted(vals)[len(vals) // 2], 3)
+                        for tag, vals in ttfrs.items()
+                        if vals
+                    }
+                }
+                if any(ttfrs.values())
+                else {}
+            ),
             "device_residency_pct": dev_pct,
             "harvest_share_pct": (
                 round(

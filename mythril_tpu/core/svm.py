@@ -265,6 +265,7 @@ class LaserEVM:
         frontier_live = args.frontier and not create and not track_gas
         pending_seeds = 0  # fresh frames added since the last drain attempt
         iteration = 0
+        first_drain_attempted = False
         for global_state in self.strategy:
             if time.time() > deadline or time_handler.time_remaining() <= 0:
                 log.info("%s timeout reached; halting exec loop", "create" if create else "execution")
@@ -298,16 +299,17 @@ class LaserEVM:
             # attempt a drain only once enough seeds accumulated to clear
             # the engine's own width gate — a handful would bail there
             # anyway, and every attempt rescans the work list.  The FIRST
-            # attempt waits out a short host warmup (production mode): by
-            # then host_step_rate is measurable, so the engine's throughput
-            # bail starts informed; explorations shorter than the warmup
-            # are trivially host-fast and never engage the device at all.
-            if frontier_live and iteration % 8 == 0 and (
+            # attempt waits until host_step_rate is measurable (production
+            # mode) so the engine's throughput bail starts informed — the
+            # samples persist on the laser, so only the first transaction
+            # of an analysis ever pays the warmup; explorations shorter
+            # than it are trivially host-fast and never engage the device.
+            rate_ready = args.frontier_force or self.host_step_rate() is not None
+            if frontier_live and rate_ready and iteration % 8 == 0 and (
                 pending_seeds >= 8
-                or (iteration == _FRONTIER_WARMUP_STEPS and self.work_list)
-            ) and iteration >= (
-                0 if args.frontier_force else _FRONTIER_WARMUP_STEPS
+                or (not first_drain_attempted and self.work_list)
             ):
+                first_drain_attempted = True
                 pending_seeds = 0
                 try:
                     from mythril_tpu.frontier import FrontierEngine

@@ -620,7 +620,10 @@ class FrontierEngine:
         segment = cached_segment(caps, *bucket)
         program_key = (caps, bucket)
         program_warm = program_key in _WARM_PROGRAMS
-        _WARM_PROGRAMS.add(program_key)
+        # marked warm only AFTER a segment actually dispatches (loop below):
+        # a run that breaks before its first segment must not tag the still
+        # uncompiled program as warm, or the NEXT run's compile-paying first
+        # segment would count toward the slow-bail verdict
         import jax
 
         # tables never change during the run: upload once, reuse per segment
@@ -799,6 +802,7 @@ class FrontierEngine:
             stats.segments += 1
             seg_only = time.time() - t_seg
             stats.segment_s += seg_only
+            _WARM_PROGRAMS.add(program_key)  # a segment really dispatched
 
             t_har = time.time()
             self._harvest(st, records, walker, ev_seen)
@@ -828,8 +832,13 @@ class FrontierEngine:
                         for laser in lasers
                     ) if r
                 ]
+                # min over lasers: a multi-code batch may pair a fast-host
+                # contract with one whose host alternative is 100x slower
+                # (bectoken-style wide-mul terms) — bailing the batch, and
+                # blanket-marking its codes, must only happen when the
+                # device underruns even the SLOWEST host alternative
                 bail_rate = (
-                    _SLOW_BAIL_HOST_FACTOR * max(host_rates)
+                    _SLOW_BAIL_HOST_FACTOR * min(host_rates)
                     if host_rates else _SLOW_BAIL_FLOOR
                 )
                 code_keys = [_code_key(c) for c in table_code]
@@ -900,18 +909,12 @@ class FrontierEngine:
             else:
                 narrow_harvests = 0
 
-        if max_live < caps.MIN_LIVE and width_verdict_valid:
-            # dynamically narrow (bailed or just completed narrow): later
-            # narrow drains on these codes skip the device entirely.  A run
-            # cut short by timeout/arena pressure proves nothing about width
-            # — marking there would disable the device for a wide contract
-            # process-wide.
-            for code in table_code:
-                _NARROW_CODES.add(_code_key(code))
-        if slow_bailed:
-            # proven slower than host stepping ON THIS LINK: later narrow
-            # drains keep these codes host-side (wide multi-code batches
-            # still admit them — width amortizes the dispatch)
+        if slow_bailed or (max_live < caps.MIN_LIVE and width_verdict_valid):
+            # dynamically narrow (stayed under MIN_LIVE) or proven slower
+            # than host stepping ON THIS LINK: later narrow drains skip the
+            # device for these codes (wide multi-code batches still admit
+            # them — width amortizes the dispatch).  A run cut short by
+            # timeout/arena pressure proves nothing and marks nothing.
             for code in table_code:
                 _NARROW_CODES.add(_code_key(code))
 
