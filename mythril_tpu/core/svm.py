@@ -304,10 +304,11 @@ class LaserEVM:
             # samples persist on the laser, so only the first transaction
             # of an analysis ever pays the warmup; explorations shorter
             # than it are trivially host-fast and never engage the device.
-            rate_ready = args.frontier_force or self.host_step_rate() is not None
-            if frontier_live and rate_ready and iteration % 8 == 0 and (
+            if frontier_live and iteration % 8 == 0 and (
                 pending_seeds >= 8
                 or (not first_drain_attempted and self.work_list)
+            ) and (
+                args.frontier_force or self.host_step_rate() is not None
             ):
                 first_drain_attempted = True
                 pending_seeds = 0

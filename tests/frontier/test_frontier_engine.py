@@ -293,3 +293,20 @@ def test_break_paths_return_queued_seeds_to_work_list():
         )
     finally:
         global_args.frontier, global_args.frontier_force = old
+
+
+def test_host_step_rate_requires_samples():
+    """host_step_rate is None until the warmup sample count is reached,
+    then reports steps/sec over the accumulated iteration wall."""
+    from mythril_tpu.core import svm as svm_mod
+
+    class _L:
+        host_step_rate = svm_mod.LaserEVM.host_step_rate
+        _host_steps = 0
+        _host_step_secs = 0.0
+
+    laser = _L()
+    assert laser.host_step_rate() is None
+    laser._host_steps = svm_mod._FRONTIER_WARMUP_STEPS
+    laser._host_step_secs = float(svm_mod._FRONTIER_WARMUP_STEPS) / 500.0
+    assert abs(laser.host_step_rate() - 500.0) < 1e-6

@@ -1,0 +1,48 @@
+"""Bench metric helpers: time-to-full-recall semantics."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))
+
+
+class _Issue:
+    def __init__(self, swc_id, t):
+        self.swc_id = swc_id
+        self.discovery_time = t
+
+
+def test_ttfr_is_max_over_contracts_of_earliest_match(monkeypatch):
+    import bench
+    from mythril_tpu.analysis.report import StartTime
+
+    base = StartTime().global_start_time
+    t0 = base  # rebase to zero
+    monkeypatch.setattr(
+        bench, "CORPUS_RECALL", {"a": "106", "b": "101"}
+    )
+    per_name = {
+        "a": [_Issue("106", 5.0), _Issue("106", 9.0)],   # earliest 5
+        "b": [_Issue("110", 1.0), _Issue("101", 7.0)],   # earliest match 7
+    }
+    assert abs(bench._ttfr(per_name, t0) - 7.0) < 1e-6
+
+
+def test_ttfr_nan_when_recall_incomplete(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "CORPUS_RECALL", {"a": "106", "b": "101"})
+    per_name = {"a": [_Issue("106", 5.0)], "b": [_Issue("110", 1.0)]}
+    out = bench._ttfr(per_name, 0.0)
+    assert out != out  # NaN
+
+
+def test_ttfr_skips_other_shards(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "CORPUS_RECALL", {"a": "106", "b": "101"})
+    per_name = {"a": [_Issue("106", 3.0)]}  # "b" on another shard
+    from mythril_tpu.analysis.report import StartTime
+
+    base = StartTime().global_start_time
+    assert abs(bench._ttfr(per_name, base) - 3.0) < 1e-6
