@@ -266,6 +266,7 @@ class LaserEVM:
         pending_seeds = 0  # fresh frames added since the last drain attempt
         iteration = 0
         first_drain_attempted = False
+        zero_drains = 0  # consecutive drain attempts that executed nothing
         for global_state in self.strategy:
             if time.time() > deadline or time_handler.time_remaining() <= 0:
                 log.info("%s timeout reached; halting exec loop", "create" if create else "execution")
@@ -315,7 +316,14 @@ class LaserEVM:
                 try:
                     from mythril_tpu.frontier import FrontierEngine
 
-                    FrontierEngine(self).drain_work_list()
+                    executed = FrontierEngine(self).drain_work_list()
+                    # three consecutive no-op attempts mean the engine's
+                    # gates (width / verdict memos) reject this workload:
+                    # stop paying the per-attempt work-list rescan for the
+                    # rest of this transaction
+                    zero_drains = zero_drains + 1 if executed == 0 else 0
+                    if zero_drains >= 3:
+                        frontier_live = False
                 except Exception as e:  # graceful degradation
                     log.warning(
                         "nested frontier drain failed; host continues: %s", e,

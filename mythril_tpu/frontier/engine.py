@@ -74,11 +74,17 @@ from mythril_tpu.support.time_handler import time_handler
 
 log = logging.getLogger(__name__)
 
-# codes a frontier run proved NOT WORTH the device on this link: either
-# dynamically narrow (max live paths stayed under caps.MIN_LIVE) or slow
-# (the mid-run throughput bail below) — later narrow drains skip the device
-# for them a priori; wide multi-code batches still admit them
+# codes a frontier run proved dynamically NARROW (max live paths stayed
+# under caps.MIN_LIVE): later narrow drains skip the device for them a
+# priori.  A WIDE seed set still admits them — their width comes from many
+# seeds, not fanout, and lanes amortize the dispatch regardless.
 _NARROW_CODES: set = set()
+
+# codes the throughput bail proved SLOWER THAN THE HOST at whatever width
+# they actually reached: unlike the narrow verdict, a wide re-drain of the
+# same code just re-pays the proven loss, so this memo outranks the width
+# bypass (mixed batches with any unmarked code still go)
+_SLOW_CODES: set = set()
 
 # mid-run throughput bail: consecutive post-warmup segments whose
 # (device instructions / SEGMENT-ONLY wall — dispatch + transfers, not
@@ -343,10 +349,16 @@ class FrontierEngine:
         from mythril_tpu.support.calibration import calibrate
 
         calibrate()
+        codes = {id(s.environment.code): s.environment.code for _, s in pairs}
+        # the slow verdict outranks the width bypass (see _SLOW_CODES)
+        if all(_code_key(c) in _SLOW_CODES for c in codes.values()):
+            return False
         if len(pairs) >= self.caps.MIN_LIVE:
             return True
-        codes = {id(s.environment.code): s.environment.code for _, s in pairs}
-        if all(_code_key(c) in _NARROW_CODES for c in codes.values()):
+        if all(
+            _code_key(c) in _NARROW_CODES or _code_key(c) in _SLOW_CODES
+            for c in codes.values()
+        ):
             return False
         return sum(_jumpi_count(c) for c in codes.values()) >= _MIN_STATIC_JUMPIS
 
@@ -910,13 +922,13 @@ class FrontierEngine:
                 narrow_harvests = 0
 
         if slow_bailed or (max_live < caps.MIN_LIVE and width_verdict_valid):
-            # dynamically narrow (stayed under MIN_LIVE) or proven slower
-            # than host stepping ON THIS LINK: later narrow drains skip the
-            # device for these codes (wide multi-code batches still admit
-            # them — width amortizes the dispatch).  A run cut short by
-            # timeout/arena pressure proves nothing and marks nothing.
+            # slow: proven slower than host stepping on this link (absolute
+            # verdict).  Narrow: stayed under MIN_LIVE (skipped for narrow
+            # drains, still admitted by wide seed sets).  A run cut short
+            # by timeout/arena pressure proves nothing and marks nothing.
+            memo = _SLOW_CODES if slow_bailed else _NARROW_CODES
             for code in table_code:
-                _NARROW_CODES.add(_code_key(code))
+                memo.add(_code_key(code))
 
         visited_host = np.asarray(visited)
         for ci, (laser, code) in enumerate(zip(table_laser, table_code)):

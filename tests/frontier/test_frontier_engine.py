@@ -210,10 +210,11 @@ def test_differential_corpus_contracts(fixture, module, swc):
     assert any(i.swc_id == swc for i in dev)
 
 
-def test_slow_codes_gate_blocks_narrow_drains():
-    """A code marked not-worthwhile (narrow or the mid-run throughput
-    bail) is skipped by later narrow drains; wide seed sets still go
-    (width amortizes dispatch)."""
+def test_verdict_memos_gate_device_entry():
+    """Narrow-marked codes skip narrow drains but a wide seed set still
+    goes (width comes from many seeds); SLOW-marked codes (throughput
+    bail) are skipped even wide — re-draining just re-pays a proven
+    loss."""
     from mythril_tpu.frontier import engine as E
 
     class _Code:
@@ -232,17 +233,24 @@ def test_slow_codes_gate_blocks_narrow_drains():
     eng = E.FrontierEngine.__new__(E.FrontierEngine)
     eng.caps = E.Caps(B=64)
     pairs = [(None, _GS(code))]
+    wide = [(None, _GS(code)) for _ in range(eng.caps.MIN_LIVE)]
     key = E._code_key(code)
     old_force = E.args.frontier_force
     E.args.frontier_force = False
     try:
         E._NARROW_CODES.add(key)
         assert not eng._device_worthwhile(pairs)
-        # a wide seed set bypasses the per-code memo entirely
-        wide = [(None, _GS(code)) for _ in range(eng.caps.MIN_LIVE)]
-        assert eng._device_worthwhile(wide)
+        assert eng._device_worthwhile(wide)  # width bypasses NARROW
+        E._NARROW_CODES.discard(key)
+        E._SLOW_CODES.add(key)
+        assert not eng._device_worthwhile(pairs)
+        assert not eng._device_worthwhile(wide)  # SLOW outranks width
+        # a mixed batch with an unmarked member still goes
+        other = _Code(b"\x60\x01" * 40)
+        assert eng._device_worthwhile(wide + [(None, _GS(other))])
     finally:
         E._NARROW_CODES.discard(key)
+        E._SLOW_CODES.discard(key)
         E.args.frontier_force = old_force
 
 
