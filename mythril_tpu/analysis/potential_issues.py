@@ -92,48 +92,65 @@ def check_potential_issues(global_state: GlobalState) -> None:
             continue
         pending.append(p)
     unsolved: List[PotentialIssue] = []
-    gate = _gate_issues(global_state, pending)
-    for potential_issue, feasible in zip(pending, gate):
-        if not feasible:
-            # an UNKNOWN here degrades exactly like a failed solve below:
-            # the issue stays parked and is retried at a later tx end
-            unsolved.append(potential_issue)
-            continue
-        key = (
-            potential_issue.address,
-            get_bytecode_hash(potential_issue.bytecode),
-        )
-        if key in potential_issue.detector.cache:
-            continue  # confirmed earlier in this same sweep
-        try:
-            transaction_sequence = get_transaction_sequence(
-                global_state,
-                global_state.world_state.constraints + potential_issue.constraints,
+    gate, session, enable_map = _gate_issues(global_state, pending)
+    try:
+        for idx, (potential_issue, feasible) in enumerate(zip(pending, gate)):
+            if not feasible:
+                # an UNKNOWN here degrades exactly like a failed solve
+                # below: the issue stays parked, retried at a later tx end
+                unsolved.append(potential_issue)
+                continue
+            key = (
+                potential_issue.address,
+                get_bytecode_hash(potential_issue.bytecode),
             )
-        except UnsatError:
-            unsolved.append(potential_issue)
-            continue
-        potential_issue.detector.cache.add(
-            (potential_issue.address, get_bytecode_hash(potential_issue.bytecode))
-        )
-        potential_issue.detector.issues.append(
-            Issue(
-                contract=potential_issue.contract,
-                function_name=potential_issue.function_name,
-                address=potential_issue.address,
-                title=potential_issue.title,
-                bytecode=potential_issue.bytecode,
-                swc_id=potential_issue.swc_id,
-                gas_used=(
-                    global_state.mstate.min_gas_used,
-                    global_state.mstate.max_gas_used,
-                ),
-                description_head=potential_issue.description_head,
-                description_tail=potential_issue.description_tail,
-                severity=potential_issue.severity,
-                transaction_sequence=transaction_sequence,
+            if key in potential_issue.detector.cache:
+                continue  # confirmed earlier in this same sweep
+            # confirmation pipelining: gate members answer their exploit
+            # synthesis (initial solve + every minimization bound query)
+            # under assumptions on the gate's live session — the path
+            # condition is blasted ONCE per tx-end sweep, not once per
+            # issue (the round-4 double payment; cf. reference
+            # analysis/solver.py:51-101, one Optimize per issue)
+            gi = enable_map.get(idx) if session is not None else None
+            try:
+                transaction_sequence = get_transaction_sequence(
+                    global_state,
+                    global_state.world_state.constraints
+                    + potential_issue.constraints,
+                    session=session if gi is not None else None,
+                    session_enable=(gi,) if gi is not None else (),
+                )
+            except UnsatError:
+                unsolved.append(potential_issue)
+                continue
+            potential_issue.detector.cache.add(
+                (
+                    potential_issue.address,
+                    get_bytecode_hash(potential_issue.bytecode),
+                )
             )
-        )
+            potential_issue.detector.issues.append(
+                Issue(
+                    contract=potential_issue.contract,
+                    function_name=potential_issue.function_name,
+                    address=potential_issue.address,
+                    title=potential_issue.title,
+                    bytecode=potential_issue.bytecode,
+                    swc_id=potential_issue.swc_id,
+                    gas_used=(
+                        global_state.mstate.min_gas_used,
+                        global_state.mstate.max_gas_used,
+                    ),
+                    description_head=potential_issue.description_head,
+                    description_tail=potential_issue.description_tail,
+                    severity=potential_issue.severity,
+                    transaction_sequence=transaction_sequence,
+                )
+            )
+    finally:
+        if session is not None:
+            session.close()
     annotation.potential_issues = unsolved
 
 
@@ -158,17 +175,27 @@ def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
     """sat/unsat gate over all parked issues at FULL solver budget.
 
     All issues at one transaction end share the whole path prefix, so the
-    gate blasts ``path ∪ all issue constraints`` ONCE into an incremental
-    CDCL session with per-issue enable literals and answers each issue as a
-    solve-under-assumptions (learned clauses shared).  Exact UNSATs skip
-    the expensive exploit synthesis; SAT models are validated exactly;
-    anything undecidable here (UNKNOWN, unsupported structure, wide-mul
-    overflow encodings, no native library) passes through True to the full
-    per-issue solve — the gate can only SAVE work, never lose recall beyond
-    what the full solve itself would."""
+    gate blasts ``path ∪ sanity bounds ∪ all issue constraints`` ONCE into
+    an incremental CDCL session with per-issue enable literals and answers
+    each issue as a solve-under-assumptions (learned clauses shared).
+    Exact UNSATs skip the expensive exploit synthesis; SAT models are
+    validated exactly; anything undecidable here (UNKNOWN, unsupported
+    structure, wide-mul overflow encodings, no native library) passes
+    through True to the full per-issue solve — the gate can only SAVE
+    work, never lose recall beyond what the full solve itself would.
+
+    Returns ``(gate, session, enable_map)``: the session is the LIVE
+    blasted formula (or None), built with the exploit-synthesis sanity
+    bounds in its base and the minimization objectives registered in
+    get_transaction_sequence's exact order, so each feasible member's
+    confirmation runs on it under assumptions instead of re-blasting.
+    The CALLER owns (and must close) the returned session."""
     gate = [True] * len(issues)
     if len(issues) < 2:
-        return gate
+        # a lone issue keeps the classic path: its confirmation solve
+        # builds (at most) one session itself, and the cheap tiers may
+        # answer it with no blast at all
+        return gate, None, {}
     from mythril_tpu.native import bitblast
     from mythril_tpu.smt.concrete_eval import evaluate
     from mythril_tpu.smt.solver import SolverStatistics
@@ -176,8 +203,27 @@ def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
     from mythril_tpu.support.time_handler import time_handler
 
     if not bitblast.available():
-        return gate
+        return gate, None, {}
+    from mythril_tpu.analysis.solver import _set_minimisation_constraints
+    from mythril_tpu.core.state.constraints import Constraints
+
     path_raws = list(global_state.world_state.constraints.get_all_raw())
+    # the confirmation solve operates under calldata-size/callvalue sanity
+    # bounds and minimizes (calldatasize, callvalue) per transaction
+    # (analysis/solver.py) — bake BOTH into the shared session so bound
+    # queries are pure assumptions.  Gating under the same sanity bounds is
+    # consistent: an issue satisfiable only beyond them would fail its full
+    # confirmation solve anyway (which always adds them).
+    sanity, minimize = _set_minimisation_constraints(
+        global_state.world_state.transaction_sequence,
+        Constraints(),
+        [],
+        5000,
+        global_state.world_state,
+    )
+    sanity_raws = [c.raw if hasattr(c, "raw") else c for c in sanity]
+    objective_raws = [m.raw if hasattr(m, "raw") else m for m in minimize]
+    path_raws = path_raws + sanity_raws
     issue_raws = [
         [c.raw if hasattr(c, "raw") else c for c in p.constraints]
         for p in issues
@@ -208,18 +254,21 @@ def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
     members: List[int] = []
     for candidate_members in attempts:
         if len(candidate_members) < 2:
-            return gate
+            return gate, None, {}
         try:
             session = bitblast.OptimizeSession(
-                path_raws, guarded=[folded_all[i] for i in candidate_members]
+                path_raws,
+                objectives=objective_raws,
+                guarded=[folded_all[i] for i in candidate_members],
             )
             members = candidate_members
             break
         except bitblast.Unsupported:
             continue
     if session is None:
-        return gate
+        return gate, None, {}
     guarded = [folded_all[i] for i in members]
+    enable_map = {i: gi for gi, i in enumerate(members)}
     try:
         for gi, i in enumerate(members):
             # the OVERALL analysis deadline is re-read per query: one hard
@@ -244,6 +293,7 @@ def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
                         remember_model(conj, asg)
                 except Exception:
                     pass  # full solve decides from scratch
-    finally:
+    except Exception:
         session.close()
-    return gate
+        raise
+    return gate, session, enable_map

@@ -1665,10 +1665,23 @@ class Optimize(Solver):
     # bounding pathological 256-bit searches
     MAX_BOUND_STEPS = 48
 
-    def __init__(self, config: Optional[ProbeConfig] = None):
+    def __init__(
+        self,
+        config: Optional[ProbeConfig] = None,
+        session=None,
+        session_enable: Sequence[int] = (),
+    ):
+        """``session``/``session_enable``: an externally-owned live native
+        OptimizeSession (e.g. the transaction-end issue gate's, which has
+        already blasted the shared path prefix with per-issue enable
+        literals and THESE objectives in THIS order) answers every query
+        under assumptions instead of paying a fresh blast.  The caller
+        keeps ownership: check() never closes an external session."""
         super().__init__(config)
         self._minimize: List = []
         self._maximize: List = []
+        self._ext_session = session
+        self._ext_enable = tuple(session_enable)
         # True after check() iff EVERY objective was refined to a PROVEN
         # optimum (callers use this to decide whether the model is safe to
         # memoize budget-independently; a truncated refinement is not)
@@ -1723,7 +1736,9 @@ class Optimize(Solver):
                     self.config.timeout_ms / 4000.0, deadline - time.time()
                 ))
                 st, a2 = session.solve(
-                    list(pins) + [(obj_idx, op, v)], budget
+                    list(pins) + [(obj_idx, op, v)], budget,
+                    enable=self._ext_enable if session is self._ext_session
+                    else (),
                 )
                 if st == UNSAT:
                     return UNSAT, None
@@ -1819,22 +1834,30 @@ class Optimize(Solver):
                 self._model = None
                 return status
         session = None
+        owns_session = True
         if status != UNSAT and objectives:
-            try:
-                from mythril_tpu.native import bitblast
+            if self._ext_session is not None:
+                # the caller's live session (issue gate) already blasted
+                # this formula family — reuse it, learned clauses and all
+                session = self._ext_session
+                owns_session = False
+            else:
+                try:
+                    from mythril_tpu.native import bitblast
 
-                if bitblast.available():
-                    session = bitblast.OptimizeSession(
-                        conj, [obj for obj, _ in objectives]
-                    )
-            except Exception as e:
-                log.debug("optimize session unavailable: %s", e)
-                session = None
+                    if bitblast.available():
+                        session = bitblast.OptimizeSession(
+                            conj, [obj for obj, _ in objectives]
+                        )
+                except Exception as e:
+                    log.debug("optimize session unavailable: %s", e)
+                    session = None
         if status == UNKNOWN and session is not None:
             SolverStatistics().cdcl_calls += 1
             st, a = session.solve(
                 [], max(0.05, min(self.config.timeout_ms / 2000.0,
-                                  deadline - time.time()))
+                                  deadline - time.time())),
+                enable=self._ext_enable if not owns_session else (),
             )
             if st == UNSAT:
                 _model_cache.remember(cache_key, UNSAT, None)
@@ -1848,7 +1871,7 @@ class Optimize(Solver):
             status, asg = solve_conjunction(conj, self.config)
         if status != SAT or asg is None:
             self._model = None
-            if session is not None:
+            if session is not None and owns_session:
                 session.close()
             return status
         pins: List = []
@@ -1876,7 +1899,7 @@ class Optimize(Solver):
                     conj = conj + [terms.uge(obj, achieved)]
                     pins.append((i, "ge", achieved_val))
         finally:
-            if session is not None:
+            if session is not None and owns_session:
                 session.close()
         self._model = Model(asg)
         return SAT

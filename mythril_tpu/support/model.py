@@ -23,8 +23,15 @@ def get_model(
     maximize=(),
     enforce_execution_time: bool = True,
     solver_timeout: Optional[int] = None,
+    session=None,
+    session_enable: Sequence[int] = (),
 ) -> Model:
-    """Solve ``constraints``; return a Model or raise UnsatError."""
+    """Solve ``constraints``; return a Model or raise UnsatError.
+
+    ``session``/``session_enable``: an externally-owned live CDCL session
+    (the tx-end issue gate's) that has already blasted this formula family —
+    the Optimize answers its initial solve and every bound query under
+    assumptions against it instead of re-blasting (caller keeps ownership)."""
     timeout = solver_timeout if solver_timeout is not None else args.solver_timeout
     if enforce_execution_time:
         timeout = min(timeout, int(max(time_handler.time_remaining(), 0) * 1000) // 2 + 1)
@@ -42,7 +49,9 @@ def get_model(
     hit = _model_memo.get(key)
     if hit is not None:
         return hit
-    model, proven = _get_model_cached(raws, min_raws, max_raws, timeout)
+    model, proven = _get_model_cached(
+        raws, min_raws, max_raws, timeout, session, session_enable
+    )
     if proven:
         # only PROVEN-optimal (or objective-free) models memoize: a
         # budget-truncated refinement must re-solve under a later, larger
@@ -57,7 +66,12 @@ _model_memo: dict = {}
 
 
 def _get_model_cached(
-    raws: tuple, min_raws: tuple, max_raws: tuple, timeout: int
+    raws: tuple,
+    min_raws: tuple,
+    max_raws: tuple,
+    timeout: int,
+    session=None,
+    session_enable: Sequence[int] = (),
 ) -> Tuple[Model, bool]:
     # (kept as a separate function so the memo layer above stays readable;
     # ``cache_clear`` mirrors the old lru_cache surface for bench/tests)
@@ -66,7 +80,9 @@ def _get_model_cached(
             max_rounds=args.probe_rounds,
             candidates_per_round=args.probe_candidates,
             timeout_ms=timeout,
-        )
+        ),
+        session=session,
+        session_enable=session_enable,
     )
     opt.add(*raws)
     for m in min_raws:
