@@ -128,7 +128,7 @@ def _ttfe(issues, t0: float, swc: str = None) -> float:
     ]
     if not stamps:
         return float("nan")
-    return max(0.0, base + min(stamps) - t0)
+    return _rebase_stamp(base + min(stamps), t0)
 
 
 def _selects(input_hex: str, selector: int) -> bool:
@@ -413,7 +413,18 @@ def _ttfr(per_name, t0: float) -> float:
         latest = first if latest is None else max(latest, first)
     if latest is None:
         return float("nan")
-    return max(0.0, base + latest - t0)
+    return _rebase_stamp(base + latest, t0)
+
+
+def _rebase_stamp(wall: float, t0: float, eps: float = 0.05) -> float:
+    """Rebase an absolute discovery stamp against this run's start.  A stamp
+    meaningfully BEFORE t0 means the issue was served from a warm/cache path
+    rather than discovered by this run — report NaN so the measurement bug
+    surfaces instead of a silent perfect 0s."""
+    delta = wall - t0
+    if delta < -eps:
+        return float("nan")
+    return max(0.0, delta)
 
 
 def wl_corpus(production: bool):

@@ -104,36 +104,45 @@ class PredictableVariables(DetectionModule):
             )
         except UnsatError:
             return []
-        operation = annotations[0].operation
-        swc_id = (
-            WEAK_RANDOMNESS
-            if operation in ("block.coinbase", "blockhash")
-            else TIMESTAMP_DEPENDENCE
-        )
-        return [
-            Issue(
-                contract=state.environment.active_account.contract_name,
-                function_name=state.node.function_name if state.node else "unknown",
-                address=state.get_current_instruction()["address"],
-                swc_id=swc_id,
-                title="Dependence on predictable environment variable",
-                severity="Low",
-                bytecode=state.environment.code.bytecode,
-                description_head=f"A control flow decision is made based on {operation}.",
-                description_tail=(
-                    f"The {operation} environment variable is used to determine a "
-                    "control flow decision. Note that the values of variables like "
-                    "coinbase, gaslimit, block number and timestamp are predictable "
-                    "and can be manipulated by a malicious miner. Also keep in mind "
-                    "that attackers know hashes of earlier blocks. Don't use any of "
-                    "those environment variables as sources of randomness and be "
-                    "aware that use of these variables introduces a certain level "
-                    "of trust into miners."
-                ),
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                transaction_sequence=transaction_sequence,
+        # one issue per distinct tainting operation, in sorted order: the
+        # reference loops over every annotation on the condition
+        # (dependence_on_predictable_vars.py:74-110); sorting makes the set
+        # identical whether annotations arrived in host insertion order or
+        # were synthesized from device taint bits in ascending-bit order
+        # (frontier/taint.annotations_for_mask)
+        operations = sorted({a.operation for a in annotations})
+        issues = []
+        for operation in operations:
+            swc_id = (
+                WEAK_RANDOMNESS
+                if operation in ("block.coinbase", "blockhash")
+                else TIMESTAMP_DEPENDENCE
             )
-        ]
+            issues.append(
+                Issue(
+                    contract=state.environment.active_account.contract_name,
+                    function_name=state.node.function_name if state.node else "unknown",
+                    address=state.get_current_instruction()["address"],
+                    swc_id=swc_id,
+                    title="Dependence on predictable environment variable",
+                    severity="Low",
+                    bytecode=state.environment.code.bytecode,
+                    description_head=f"A control flow decision is made based on {operation}.",
+                    description_tail=(
+                        f"The {operation} environment variable is used to determine a "
+                        "control flow decision. Note that the values of variables like "
+                        "coinbase, gaslimit, block number and timestamp are predictable "
+                        "and can be manipulated by a malicious miner. Also keep in mind "
+                        "that attackers know hashes of earlier blocks. Don't use any of "
+                        "those environment variables as sources of randomness and be "
+                        "aware that use of these variables introduces a certain level "
+                        "of trust into miners."
+                    ),
+                    gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+                    transaction_sequence=transaction_sequence,
+                )
+            )
+        return issues
 
 
 detector = PredictableVariables

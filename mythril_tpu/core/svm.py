@@ -263,6 +263,8 @@ class LaserEVM:
             else start + self.execution_timeout
         )
         frontier_live = args.frontier and not create and not track_gas
+        frontier_enabled = frontier_live  # config verdict, never re-armed
+        rearm_width = 0  # work-list width that re-arms a zero-drain disable
         pending_seeds = 0  # fresh frames added since the last drain attempt
         iteration = 0
         first_drain_attempted = False
@@ -297,6 +299,20 @@ class LaserEVM:
             # gate decides whether a drain pays)
             iteration += 1
             pending_seeds += len(new_states)
+            # a zero-drain disable fires early (iterations ~24-40), when
+            # work lists are still narrow; a contract whose fanout widens
+            # later must get the device back.  Re-arm when the work list
+            # clearly outgrows the width that was being rejected, doubling
+            # the threshold each time so flapping decays geometrically.
+            if (
+                frontier_enabled
+                and not frontier_live
+                and rearm_width
+                and len(self.work_list) >= rearm_width
+            ):
+                frontier_live = True
+                zero_drains = 0
+                rearm_width *= 2
             # attempt a drain only once enough seeds accumulated to clear
             # the engine's own width gate — a handful would bail there
             # anyway, and every attempt rescans the work list.  The FIRST
@@ -324,6 +340,12 @@ class LaserEVM:
                     zero_drains = zero_drains + 1 if executed == 0 else 0
                     if zero_drains >= 3:
                         frontier_live = False
+                        # never shrink below the last re-arm threshold, or
+                        # a work list oscillating around it would flap the
+                        # device on/off at a constant width forever
+                        rearm_width = max(
+                            2 * len(self.work_list), 32, rearm_width
+                        )
                 except Exception as e:  # graceful degradation
                     log.warning(
                         "nested frontier drain failed; host continues: %s", e,

@@ -23,6 +23,16 @@ Module map:
   * ``engine``  — orchestration + LaserEVM integration
 """
 
-from mythril_tpu.frontier.engine import FrontierEngine
-
 __all__ = ["FrontierEngine"]
+
+
+def __getattr__(name: str):
+    # lazy: detection modules import frontier.taint (jax-free) at load time;
+    # an eager engine import here would pull step -> jax into every detector
+    # load and defeat svm.py's deliberately-lazy FrontierEngine import and
+    # its graceful degradation when jax is unavailable
+    if name == "FrontierEngine":
+        from mythril_tpu.frontier.engine import FrontierEngine
+
+        return FrontierEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

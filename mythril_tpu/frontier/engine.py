@@ -921,14 +921,22 @@ class FrontierEngine:
             else:
                 narrow_harvests = 0
 
-        if slow_bailed or (max_live < caps.MIN_LIVE and width_verdict_valid):
+        if slow_bailed:
             # slow: proven slower than host stepping on this link (absolute
-            # verdict).  Narrow: stayed under MIN_LIVE (skipped for narrow
-            # drains, still admitted by wide seed sets).  A run cut short
-            # by timeout/arena pressure proves nothing and marks nothing.
-            memo = _SLOW_CODES if slow_bailed else _NARROW_CODES
+            # verdict) — but only for codes whose OWN slow-segment count
+            # reached the bail threshold: a mixed batch bails on its worst
+            # member's count, and blanket-marking would permanently disable
+            # the device for codes that just joined the batch
             for code in table_code:
-                memo.add(_code_key(code))
+                key = _code_key(code)
+                if _SLOW_SEGMENTS.get(key, 0) >= _SLOW_BAIL_SEGMENTS:
+                    _SLOW_CODES.add(key)
+        elif max_live < caps.MIN_LIVE and width_verdict_valid:
+            # narrow: stayed under MIN_LIVE (skipped for narrow drains,
+            # still admitted by wide seed sets).  A run cut short by
+            # timeout/arena pressure proves nothing and marks nothing.
+            for code in table_code:
+                _NARROW_CODES.add(_code_key(code))
 
         visited_host = np.asarray(visited)
         for ci, (laser, code) in enumerate(zip(table_laser, table_code)):
