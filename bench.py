@@ -267,18 +267,21 @@ def wl_wide_frontier(production: bool):
         _clear_caches()
         from mythril_tpu.frontier.stats import FrontierStatistics
 
-        dev_before = FrontierStatistics().device_instructions
-        har_before = FrontierStatistics().harvest_s
+        fstats = FrontierStatistics()
+        dev_before = fstats.device_instructions
+        har_before = fstats.harvest_s
+        mid_before = _mid_counters(fstats)
         code = _wide_contract(10)  # 1024 concurrent paths
         t0 = time.time()
         sym, issues = _analyze(
             code, 0x0901D12E, 1, modules=["AccidentallyKillable"], timeout=300
         )
         wall = time.time() - t0
-        # residency/harvest over the TIMED run only (the warm-up above
-        # also runs device segments and harvests)
-        dev_delta = FrontierStatistics().device_instructions - dev_before
-        har_delta = FrontierStatistics().harvest_s - har_before
+        # residency/harvest/mid-frame over the TIMED run only (the warm-up
+        # above also runs device segments and harvests)
+        dev_delta = fstats.device_instructions - dev_before
+        har_delta = fstats.harvest_s - har_before
+        mid_delta = _mid_delta(fstats, mid_before)
     finally:
         args.frontier_width = old_width
     assert any(i.swc_id == "106" for i in issues), "wide-frontier recall lost"
@@ -286,6 +289,8 @@ def wl_wide_frontier(production: bool):
         sym.laser.total_states, wall, _ttfe(issues, t0, "106"),
         dev_delta if production else None,
         har_delta if production else None,
+        float("nan"),  # no ttfr channel for this workload
+        mid_delta if production else None,
     )
 
 
@@ -416,6 +421,19 @@ def _ttfr(per_name, t0: float) -> float:
     return _rebase_stamp(base + latest, t0)
 
 
+def _mid_counters(fstats):
+    return (
+        fstats.mid_injections,
+        fstats.mid_encode_failures,
+        fstats.semantic_parks,
+    )
+
+
+def _mid_delta(fstats, before):
+    after = _mid_counters(fstats)
+    return tuple(a - b for a, b in zip(after, before))
+
+
 def _rebase_stamp(wall: float, t0: float, eps: float = 0.05) -> float:
     """Rebase an absolute discovery stamp against this run's start.  A stamp
     meaningfully BEFORE t0 means the issue was served from a warm/cache path
@@ -470,17 +488,21 @@ def wl_corpus(production: bool):
             _clear_caches()
             from mythril_tpu.frontier.stats import FrontierStatistics
 
-            dev_before = FrontierStatistics().device_instructions
-            har_before = FrontierStatistics().harvest_s
+            fstats = FrontierStatistics()
+            dev_before = fstats.device_instructions
+            har_before = fstats.harvest_s
+            mid_before = _mid_counters(fstats)
             t0 = time.time()
             issues_by_name, states = analyze_cooperative(
                 jobs, transaction_count=2, execution_timeout=60
             )
             wall = time.time() - t0
-            # residency/harvest measured around the TIMED run only (the
-            # one-time warm-up above also executes device instructions)
-            dev_delta = FrontierStatistics().device_instructions - dev_before
-            har_delta = FrontierStatistics().harvest_s - har_before
+            # residency/harvest/mid-frame measured around the TIMED run
+            # only (the one-time warm-up above also executes device
+            # instructions)
+            dev_delta = fstats.device_instructions - dev_before
+            har_delta = fstats.harvest_s - har_before
+            mid_delta = _mid_delta(fstats, mid_before)
         finally:
             global_args.frontier_width = old_width
         findings = [
@@ -539,6 +561,7 @@ def wl_corpus(production: bool):
         (dev_delta if production else None),
         (har_delta if production else None),
         _ttfr(per_name, t0),
+        (mid_delta if production else None),
     )
 
 
@@ -585,6 +608,7 @@ def _new_row_data():
         "ttfrs": {"baseline": [], "production": []},
         "residency": [],
         "harvest_shares": [],
+        "mids": [],  # per-production-rep (mid_reentered, mid_bounced, semantic_parked)
         "completed_reps": 0,
     }
 
@@ -645,6 +669,22 @@ def _row_summary(unit: str, d: dict) -> dict:
             round(100 * _median(d["harvest_shares"]), 1)
             if d["harvest_shares"]
             else None
+        ),
+        # mid-frame residency (production runs): how many parked/resumed
+        # states re-entered the device vs bounced at encoding vs stayed
+        # pinned host-side as semantic parks — the counters that quantify
+        # the mid-frame re-entry claim on each workload
+        **(
+            {
+                "mid_frame": {
+                    key: _median([m[i] for m in d["mids"]])
+                    for i, key in enumerate(
+                        ("reentered", "bounced", "semantic_parked")
+                    )
+                }
+            }
+            if d["mids"]
+            else {}
         ),
     }
 
@@ -738,6 +778,11 @@ def main() -> None:
                 fstats = FrontierStatistics()
                 dev_before = fstats.device_instructions
                 har_before = fstats.harvest_s
+                mid_before = (
+                    fstats.mid_injections,
+                    fstats.mid_encode_failures,
+                    fstats.semantic_parks,
+                )
                 out = fn(production)
                 work, wall, ttfe = out[:3]
                 d["samples"][tag].append(work / wall if wall > 0 else 0.0)
@@ -767,6 +812,15 @@ def main() -> None:
                         else fstats.harvest_s - har_before
                     )
                     d["harvest_shares"].append(har / wall)
+                if production:
+                    # a workload with an internal warm-up supplies its own
+                    # timed-run delta (out[6]), mirroring out[3]/out[4]
+                    mid = (
+                        out[6]
+                        if len(out) > 6 and out[6] is not None
+                        else _mid_delta(fstats, mid_before)
+                    )
+                    d["mids"].append(mid)
             # LATEST pair wall, not the max: rep 0 includes once-per-process
             # warm-ups (wide_frontier/corpus segment compiles) that later
             # reps never pay — a max would over-trim them

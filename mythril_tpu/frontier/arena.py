@@ -27,8 +27,20 @@ from mythril_tpu.smt import terms as T
 
 # NOTE: ops.bitvec imports jax at module level; this module must stay
 # jax-free (frontier.taint -> frontier.code -> here is imported by every
-# detection module at load time), so from_ints/to_ints are imported at
-# their call sites — they are pure-numpy despite living in bitvec.py
+# detection module at load time), so from_ints/to_ints bind lazily on
+# first use — they are pure-numpy despite living in bitvec.py, and the
+# call sites run per-row in encode/decode hot paths
+_from_ints = None
+_to_ints = None
+
+
+def _bitvec_fns():
+    global _from_ints, _to_ints
+    if _from_ints is None:
+        from mythril_tpu.ops.bitvec import from_ints, to_ints
+
+        _from_ints, _to_ints = from_ints, to_ints
+    return _from_ints, _to_ints
 
 LIMBS = 16  # 256 bits as 16-bit limbs in uint32
 
@@ -73,9 +85,7 @@ class HostArena:
         self.op[i], self.a[i], self.b[i], self.c[i] = op, a, b, c
         self.width[i] = width
         if value is not None:
-            from mythril_tpu.ops.bitvec import from_ints
-
-            self.val[i] = from_ints(value & ((1 << 256) - 1), 256)
+            self.val[i] = _bitvec_fns()[0](value & ((1 << 256) - 1), 256)
             self.isconst[i] = True
         self.length += 1
         return i
@@ -129,9 +139,7 @@ class HostArena:
             width=term.width if T.is_bv_sort(term.sort) else 0,
         )
         if term.is_const and not no_fold:
-            from mythril_tpu.ops.bitvec import from_ints
-
-            self.val[row] = from_ints(term.value, 256)
+            self.val[row] = _bitvec_fns()[0](term.value, 256)
             self.isconst[row] = True
         self._decode_memo[row] = term
         return row
@@ -245,9 +253,7 @@ class HostArena:
     # ------------------------------------------------------------------
 
     def const_value(self, row: int) -> int:
-        from mythril_tpu.ops.bitvec import to_ints
-
-        vals = to_ints(self.val[row], 256)
+        vals = _bitvec_fns()[1](self.val[row], 256)
         width = int(self.width[row])  # numpy int32 cannot shift past 63
         return vals[0] & ((1 << width) - 1) if width else vals[0]
 

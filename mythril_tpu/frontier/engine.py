@@ -1057,6 +1057,7 @@ class FrontierEngine:
                 # re-park — the walker stamps the carrier so _mid_eligible
                 # holds it host-side until the host steps past the pc
                 rec.final["semantic_park"] = True
+                stats.semantic_parks += 1
             try:
                 walker.finish(rec)
             except Exception as e:  # pragma: no cover - diagnostics

@@ -30,6 +30,7 @@ class FrontierStatistics(metaclass=Singleton):
         self.mesh_devices = 0  # >0: segments ran path-sharded over a mesh
         self.mid_injections = 0  # mid-frame states re-entered on device
         self.mid_encode_failures = 0  # mid-frame seeds bounced at encoding
+        self.semantic_parks = 0  # paths pinned host-side until stepped past
 
     def record_park(self, opcode: str) -> None:
         self.parks_by_opcode[opcode] += 1
@@ -49,6 +50,7 @@ class FrontierStatistics(metaclass=Singleton):
             "harvest_s": round(self.harvest_s, 3),
             "mid_injections": self.mid_injections,
             "mid_encode_failures": self.mid_encode_failures,
+            "semantic_parks": self.semantic_parks,
             "parks_by_opcode": dict(self.parks_by_opcode.most_common()),
             "parks_by_reason": dict(self.parks_by_reason.most_common()),
         }

@@ -1,0 +1,51 @@
+"""Disassembly conformance against the reference's golden .easm outputs.
+
+The reference mount ships 13 expected disassembly listings
+(/root/reference/tests/testdata/outputs_expected/*.sol.o.easm, harness
+/root/reference/tests/disassembler_test.py) — pure data fixtures that act as
+a free oracle for bytecode -> listing formatting: one line per instruction,
+``<decimal address> <OPCODE> [0x<push-arg-hex>]``.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.frontend.disassembler import Disassembly
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+EXPECTED = Path("/root/reference/tests/testdata/outputs_expected")
+
+GOLDENS = sorted(EXPECTED.glob("*.sol.o.easm")) if EXPECTED.is_dir() else []
+
+# The goldens predate the reference's own opcode-table rename: its current
+# support/opcodes.py names 0xfe INVALID and 0xff SELFDESTRUCT, while the
+# stored listings still say ASSERT_FAIL / SUICIDE.  Normalize the LEGACY
+# tokens to the names both codebases use today (documented deviation, not a
+# formatting difference).
+_LEGACY_TOKENS = {" ASSERT_FAIL": " INVALID", " SUICIDE": " SELFDESTRUCT"}
+
+# overflow.sol.o.easm was generated from a different compiler's output than
+# the overflow.sol.o shipped in the same mount (golden opens `PUSH1 0x60`,
+# 388 lines; the input disassembles to `PUSH1 0x80`, 347 lines) — the golden
+# is stale against its own input, so byte comparison is meaningless.
+_STALE_GOLDENS = {"overflow.sol.o.easm"}
+
+
+def _normalize(text: str) -> str:
+    for legacy, current in _LEGACY_TOKENS.items():
+        text = text.replace(legacy, current)
+    return text
+
+
+@pytest.mark.skipif(not GOLDENS, reason="reference goldens not mounted")
+@pytest.mark.parametrize("golden", GOLDENS, ids=lambda p: p.name)
+def test_easm_matches_reference_golden(golden):
+    if golden.name in _STALE_GOLDENS:
+        pytest.skip("golden predates the mounted input bytecode")
+    source = INPUTS / golden.name[: -len(".easm")]
+    if not source.exists():
+        pytest.skip(f"no input for {golden.name}")
+    code = source.read_text().strip()
+    easm = Disassembly(code).get_easm()
+    assert easm == _normalize(golden.read_text())

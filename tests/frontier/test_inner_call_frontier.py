@@ -110,3 +110,9 @@ def test_inner_call_frame_runs_on_device_with_host_parity():
     assert stats["mid_injections"] > 0, (
         f"no mid-frame state re-entered the device: {stats}"
     )
+    # residency telemetry: opcode parks on this workload are pinned
+    # host-side until the host steps past the pc, and must be counted so
+    # the mid-frame residency story is checkable per run
+    assert stats["semantic_parks"] > 0, (
+        f"opcode parks not counted as semantic parks: {stats}"
+    )
