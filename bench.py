@@ -252,6 +252,9 @@ def wl_wide_frontier(production: bool):
     old_width = args.frontier_width
     if production:
         args.frontier_width = 1024
+        # device-only efficiency block (VERDICT r4 #7): first productive
+        # segment measures pure compute via chained-dispatch subtraction
+        args.frontier_microbench = True
         if not _wide_warmed:
             # warmup outside the timers: the segment program compiles once
             # per (caps, size bucket) (persistently cached when the XLA
@@ -284,6 +287,7 @@ def wl_wide_frontier(production: bool):
         mid_delta = _mid_delta(fstats, mid_before)
     finally:
         args.frontier_width = old_width
+        args.frontier_microbench = False
     assert any(i.swc_id == "106" for i in issues), "wide-frontier recall lost"
     return (
         sym.laser.total_states, wall, _ttfe(issues, t0, "106"),
@@ -702,6 +706,8 @@ def _emit_snapshot(table: dict, budget_meta: dict, partial: bool) -> None:
     """One JSON line on stdout + a file copy.  Emitted after EVERY completed
     workload pair so a driver-level timeout can never zero the artifact —
     the final (non-partial) snapshot is the last JSON line printed."""
+    from mythril_tpu.frontier.stats import FrontierStatistics
+
     headline = table.get("corpus_sweep")
     obj = {
         "metric": "corpus_sweep_states_per_sec",
@@ -714,6 +720,14 @@ def _emit_snapshot(table: dict, budget_meta: dict, partial: bool) -> None:
         ),
         "workloads": table,
         "budget": budget_meta,
+        # device-only efficiency (pure segment compute via chained-dispatch
+        # subtraction, independent of the host<->device link): the per-chip
+        # number that tracks distance to the paths/sec north star
+        **(
+            {"device_microbench": FrontierStatistics().microbench}
+            if FrontierStatistics().microbench
+            else {}
+        ),
     }
     if partial:
         obj["partial"] = True
@@ -778,11 +792,7 @@ def main() -> None:
                 fstats = FrontierStatistics()
                 dev_before = fstats.device_instructions
                 har_before = fstats.harvest_s
-                mid_before = (
-                    fstats.mid_injections,
-                    fstats.mid_encode_failures,
-                    fstats.semantic_parks,
-                )
+                mid_before = _mid_counters(fstats)
                 out = fn(production)
                 work, wall, ttfe = out[:3]
                 d["samples"][tag].append(work / wall if wall > 0 else 0.0)

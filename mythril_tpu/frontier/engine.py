@@ -797,6 +797,15 @@ class FrontierEngine:
                 k_limit=np.int32(min(caps.K, 96 << min(stats.segments, 4)))
             )
             st_dev = push_sharded(st) if mesh is not None else push_state(st)
+            micro = (
+                args.frontier_microbench
+                and not stats.microbench
+                and mesh is None
+            )
+            if micro:
+                micro_args = (
+                    st_dev, dev_arena, arena_len, visited, code_dev, cfg
+                )
             out_state, dev_arena, out_len, n_exec, seg_max_live, visited = (
                 segment(st_dev, dev_arena, arena_len, visited, code_dev, cfg)
             )
@@ -813,6 +822,8 @@ class FrontierEngine:
             stats.device_instructions += n_exec_host
             stats.segments += 1
             seg_only = time.time() - t_seg
+            if micro and n_exec_host > 0:
+                self._run_microbench(segment, micro_args, n_exec_host, st)
             stats.segment_s += seg_only
             _WARM_PROGRAMS.add(program_key)  # a segment really dispatched
 
@@ -1065,6 +1076,48 @@ class FrontierEngine:
             records[slot] = None
             clear_slot(st, slot)
             ev_seen[slot] = 0
+
+    @staticmethod
+    def _run_microbench(segment, micro_args, n_exec: int, st, reps: int = 4) -> None:
+        """Pure device-compute time of one segment, link-independent.
+
+        Over the axon tunnel neither wall timers nor block_until_ready see
+        device time (the async signal completes locally, ~0.05 ms against a
+        ~115 ms link).  Chained-dispatch subtraction cancels the link: one
+        dispatch plus a forced host readback measures compute+RTT; ``reps``
+        back-to-back dispatches on the SAME inputs (in-order device stream,
+        no donation) measure reps*compute+RTT; the difference divided by
+        reps-1 is the per-segment device compute alone.  Runs once per
+        process on the first productive segment when args.frontier_microbench
+        is set (bench.py's device_microbench block)."""
+        t0 = time.time()
+        out = segment(*micro_args)
+        np.asarray(out[3])  # n_exec scalar readback forces a true sync
+        t_one = time.time() - t0
+        t0 = time.time()
+        outs = [segment(*micro_args) for _ in range(reps)]
+        np.asarray(outs[-1][3])
+        t_many = time.time() - t0
+        compute = max((t_many - t_one) / max(reps - 1, 1), 1e-9)
+        # packed host->device push excludes events (rebuilt empty on
+        # device); the packed pull rides the same layout + 2 scalars
+        push_bytes = 4 * sum(
+            int(np.prod(f.shape))
+            for name, f in zip(st._fields, st)
+            if name != "events"
+        )
+        FrontierStatistics().microbench = {
+            "segment_compute_s": round(compute, 4),
+            "instructions_per_s": round(n_exec / compute, 1),
+            "n_exec_per_segment": int(n_exec),
+            "dispatch_plus_link_s": round(t_one, 4),
+            "bytes_pushed_per_segment": push_bytes,
+            # the packed pull rides the same layout + the arena_len /
+            # n_exec / max_live scalars (step.pull_harvest)
+            "bytes_pulled_meta_per_segment": push_bytes + 12,
+            "width": int(st.halt.shape[0]),
+            "reps": reps,
+        }
 
     def _lineage_constraint_rows(self, rec) -> List[int]:
         """Arena rows of the branch conditions appended along this path

@@ -31,6 +31,10 @@ class FrontierStatistics(metaclass=Singleton):
         self.mid_injections = 0  # mid-frame states re-entered on device
         self.mid_encode_failures = 0  # mid-frame seeds bounced at encoding
         self.semantic_parks = 0  # paths pinned host-side until stepped past
+        # device-only efficiency numbers (engine._run_microbench): pure
+        # segment compute time via chained re-dispatch subtraction, so the
+        # per-chip story is measurable independent of the host<->device link
+        self.microbench: dict = {}
 
     def record_park(self, opcode: str) -> None:
         self.parks_by_opcode[opcode] += 1
@@ -53,4 +57,5 @@ class FrontierStatistics(metaclass=Singleton):
             "semantic_parks": self.semantic_parks,
             "parks_by_opcode": dict(self.parks_by_opcode.most_common()),
             "parks_by_reason": dict(self.parks_by_reason.most_common()),
+            **({"microbench": self.microbench} if self.microbench else {}),
         }

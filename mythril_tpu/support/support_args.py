@@ -61,6 +61,10 @@ class Args:
     # engine shards automatically when >1 device is attached and the batch
     # width divides evenly
     frontier_mesh: bool = True
+    # measure pure device-compute time of the first segment (chained
+    # re-dispatch subtraction, tunnel-independent) into
+    # FrontierStatistics().microbench — bench.py's device_microbench block
+    frontier_microbench: bool = False
 
 
 args = Args()
