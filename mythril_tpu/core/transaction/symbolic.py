@@ -19,6 +19,7 @@ from mythril_tpu.core.transaction.transaction_models import (
     tx_id_manager,
 )
 from mythril_tpu.smt import And, BitVec, Or, symbol_factory
+from mythril_tpu.support.support_args import args
 
 log = logging.getLogger(__name__)
 
@@ -59,9 +60,13 @@ ACTORS = Actors()
 
 
 def generate_function_constraints(
-    calldata: SymbolicCalldata, func_hashes: List[int]
+    calldata: SymbolicCalldata, func_hashes: List[int], negate: bool = False
 ) -> List:
-    """Constrain the selector to one of the given functions (reference :77-96)."""
+    """Constrain the selector to one of the given functions (reference :77-96).
+
+    ``negate=True`` yields the COMPLEMENT (none of the given selectors
+    match) — the last cell of the multi-selector seed partition, covering
+    fallback dispatch and short-calldata paths."""
     if not func_hashes:
         return []
     from mythril_tpu.smt import Concat
@@ -75,7 +80,12 @@ def generate_function_constraints(
             options.append(ULT(calldata.calldatasize, symbol_factory.BitVecVal(4, 256)))
         else:
             options.append(selector == symbol_factory.BitVecVal(h, 32))
-    return [Or(*options)]
+    cond = Or(*options)
+    if negate:
+        from mythril_tpu.smt import Not
+
+        return [Not(cond)]
+    return [cond]
 
 
 def seed_message_call(
@@ -84,26 +94,57 @@ def seed_message_call(
     """Seed the work list with one symbolic message-call tx per open world
     state WITHOUT executing (reference :99-144 minus the exec call) — the
     cooperative corpus driver seeds many lasers first, then runs all their
-    seeds as one multi-code frontier batch."""
+    seeds as one multi-code frontier batch.
+
+    Multi-selector seeding (args.multi_selector_seeding): instead of one
+    seed with a fully symbolic selector, partition the selector space into
+    one seed per function-table entry plus a complement seed (fallback and
+    short-calldata paths).  The union of the partition is exactly the
+    single-seed state space — recall is unchanged (differentially tested)
+    — but the work list starts |selectors|+1 wide, so the batched device
+    frontier gets its width up front instead of growing it fork by fork
+    through the dispatcher."""
+    from copy import copy as _copy
+
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
 
     for open_world_state in open_states:
-        next_tx_id = tx_id_manager.get_next_tx_id()
-        external_sender = symbol_factory.BitVecSym(f"sender_{next_tx_id}", 256)
-        calldata = SymbolicCalldata(next_tx_id)
-        transaction = MessageCallTransaction(
-            world_state=open_world_state,
-            identifier=next_tx_id,
-            gas_limit=8_000_000,
-            origin=external_sender,
-            caller=external_sender,
-            callee_account=open_world_state[callee_address],
-            call_data=calldata,
-            call_value=symbol_factory.BitVecSym(f"call_value{next_tx_id}", 256),
-        )
-        constraints = generate_function_constraints(calldata, func_hashes or [])
-        _setup_global_state_for_execution(laser_evm, transaction, constraints)
+        seed_groups = [(func_hashes or [], False)]
+        if args.multi_selector_seeding and not func_hashes:
+            code = getattr(open_world_state[callee_address], "code", None)
+            hashes = [
+                h for h in (getattr(code, "func_hashes", None) or []) if h != -1
+            ]
+            if hashes:
+                seed_groups = [([h], False) for h in hashes] + [(hashes, True)]
+        for gi, (group, negate) in enumerate(seed_groups):
+            # each seed needs its OWN world state: the selector constraint
+            # lands on world_state.constraints, which sibling seeds must
+            # not observe.  The last group keeps the original object — one
+            # copy per sibling, none for a single-seed partition.
+            world_state = (
+                _copy(open_world_state)
+                if gi < len(seed_groups) - 1
+                else open_world_state
+            )
+            next_tx_id = tx_id_manager.get_next_tx_id()
+            external_sender = symbol_factory.BitVecSym(f"sender_{next_tx_id}", 256)
+            calldata = SymbolicCalldata(next_tx_id)
+            transaction = MessageCallTransaction(
+                world_state=world_state,
+                identifier=next_tx_id,
+                gas_limit=8_000_000,
+                origin=external_sender,
+                caller=external_sender,
+                callee_account=world_state[callee_address],
+                call_data=calldata,
+                call_value=symbol_factory.BitVecSym(f"call_value{next_tx_id}", 256),
+            )
+            constraints = generate_function_constraints(
+                calldata, list(group), negate
+            )
+            _setup_global_state_for_execution(laser_evm, transaction, constraints)
 
 
 def execute_message_call(

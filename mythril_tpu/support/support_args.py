@@ -65,6 +65,11 @@ class Args:
     # re-dispatch subtraction, tunnel-independent) into
     # FrontierStatistics().microbench — bench.py's device_microbench block
     frontier_microbench: bool = False
+    # partition each symbolic tx's selector space into one seed per
+    # function-table entry + a complement seed (core/transaction/symbolic.
+    # seed_message_call): same state space, but the work list starts
+    # |selectors|+1 wide so the device frontier gets width up front
+    multi_selector_seeding: bool = False
 
 
 args = Args()
