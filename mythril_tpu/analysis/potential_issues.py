@@ -79,7 +79,18 @@ def check_potential_issues(global_state: GlobalState) -> None:
     first (the sets share the whole path prefix — union model replay and
     merged dispatch resolve most), so the per-issue exploit synthesis
     (model + input minimization) is paid only for the satisfiable ones."""
+    from mythril_tpu.support.time_handler import time_handler
+
     annotation = get_potential_issues_annotation(global_state)
+    if time_handler.time_remaining() <= 0:
+        # budget exhausted: leave everything parked.  Confirmation solving
+        # runs inside harvest/walker replay, which the engine's per-
+        # iteration deadline checks cannot interrupt — without this guard a
+        # single wide harvest full of terminal paths overran the execution
+        # timeout by minutes of session blasting (bectoken: 501s wall on a
+        # 120s budget).  Partial-result discipline: issues confirmed before
+        # the deadline are already in detector.issues.
+        return
     # the detector's (address, bytecode-hash) cache is the reference's
     # dedup discipline (module/base.py:70-95, checked at analyze time);
     # multiple paths park the same program point before the first
@@ -95,6 +106,12 @@ def check_potential_issues(global_state: GlobalState) -> None:
     gate, session, enable_map = _gate_issues(global_state, pending)
     try:
         for idx, (potential_issue, feasible) in enumerate(zip(pending, gate)):
+            if time_handler.time_remaining() <= 0:
+                # deadline landed mid-sweep: everything not yet confirmed
+                # stays parked (same partial-result discipline as the
+                # entry guard)
+                unsolved.append(potential_issue)
+                continue
             if not feasible:
                 # an UNKNOWN here degrades exactly like a failed solve
                 # below: the issue stays parked, retried at a later tx end
@@ -271,6 +288,8 @@ def _gate_issues(global_state: GlobalState, issues: List[PotentialIssue]):
     enable_map = {i: gi for gi, i in enumerate(members)}
     try:
         for gi, i in enumerate(members):
+            if time_handler.time_remaining() <= 0:
+                break  # deadline mid-gate: the rest pass through True
             # the OVERALL analysis deadline is re-read per query: one hard
             # issue must not spend the whole remaining budget N times over
             budget_s = max(0.05, min(

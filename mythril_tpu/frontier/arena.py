@@ -290,9 +290,27 @@ class HostArena:
     def _decode_one(self, r: int) -> T.Term:
         op = int(self.op[r])
         m = self._decode_memo
-        A = lambda: m[int(self.a[r])]  # noqa: E731
-        B = lambda: m[int(self.b[r])]  # noqa: E731
-        C = lambda: m[int(self.c[r])]  # noqa: E731
+
+        # Sort coercion: the device kernel keeps EVM comparison results as
+        # 0/1 limb WORDS, but comparison rows decode to host BOOL terms (a
+        # JUMPI condition wants exactly that).  A word-op consuming a
+        # comparison row (solc emits LT;NOT, ISZERO;MUL, ...) must coerce
+        # the bool back to the 0/1 word the device actually computed —
+        # previously this crashed the walker ("not a bitvector: eq") and
+        # dropped the path.
+        def _word(t: T.Term) -> T.Term:
+            if t.sort is T.BOOL:
+                return T.ite(t, T.const(1, 256), T.const(0, 256))
+            return t  # bitvectors unchanged; arrays (select/store) too
+
+        def _bool(t: T.Term) -> T.Term:
+            if T.is_bv_sort(t.sort):
+                return T.ne(t, T.const(0, t.width))
+            return t
+
+        A = lambda: _word(m[int(self.a[r])])  # noqa: E731
+        B = lambda: _word(m[int(self.b[r])])  # noqa: E731
+        C = lambda: _word(m[int(self.c[r])])  # noqa: E731
         w = int(self.width[r])
 
         if op == O.A_CONST:
@@ -313,13 +331,16 @@ class HostArena:
         if op in simple:
             return simple[op](A(), B())
         if op == O.A_EQZ:
-            return T.eq(A(), T.const(0, A().width))
+            raw = m[int(self.a[r])]
+            if not T.is_bv_sort(raw.sort):
+                return T.lnot(raw)  # ISZERO over a comparison: logical not
+            return T.eq(raw, T.const(0, raw.width))
         if op == O.A_NOT:
             return T.bnot(A())
         if op == O.A_BNOT:
-            return T.lnot(A())
+            return T.lnot(_bool(m[int(self.a[r])]))
         if op == O.A_ITEW:
-            return T.ite(A(), B(), C())
+            return T.ite(_bool(m[int(self.a[r])]), B(), C())
         if op == O.A_CONCAT:
             return T.concat2(A(), B())
         if op == O.A_EXTRACT:

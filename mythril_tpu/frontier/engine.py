@@ -100,6 +100,12 @@ _SLOW_CODES: set = set()
 _SLOW_BAIL_FLOOR = 100.0
 _SLOW_BAIL_HOST_FACTOR = 0.7
 _SLOW_BAIL_SEGMENTS = 2
+# a DECISIVE loss (segment rate under half the bail rate, i.e. the device
+# is running at under 0.35x the slowest host alternative) bails after ONE
+# warm segment: round 4's first-analysis numbers showed narrow real
+# contracts losing 0.3-0.7x for two full segments before the counter
+# tripped, and the first analysis is the case that matters
+_SLOW_BAIL_DECISIVE = 0.5
 
 # slow-segment counters persist ACROSS runs per code (short explorations
 # split into several 1-2 segment runs, so a per-run counter never reaches
@@ -114,6 +120,16 @@ _WARM_PROGRAMS: set = set()
 # static width hint: below this many JUMPIs across the seed codes a narrow
 # seed set cannot fan out wide enough to amortize segment dispatches
 _MIN_STATIC_JUMPIS = 8
+
+# observed-width admission gate (calibration-scaled with the link RTT): a
+# seed set narrower than this stays host-side even when statically branchy.
+# Round 4's static-JUMPI-only gate admitted requires-style contracts
+# (overflow/underflow: 10 JUMPIs but observed max work-list width 5-12 —
+# every fork's other side reverts) which then lost 0.3-0.7x to segment
+# fixed costs on the first analysis.  Genuinely wide workloads prove their
+# width ON THE HOST within milliseconds (fork doubling), so demanding
+# observed width costs them one drain interval, not a compile or a segment.
+_MIN_SEED_WIDTH = 8
 
 _jumpi_count_cache: Dict[object, int] = {}
 
@@ -340,9 +356,10 @@ class FrontierEngine:
 
     def _device_worthwhile(self, pairs: List[Tuple]) -> bool:
         """A-priori narrow bail: segment dispatches only amortize over wide
-        frontiers, so a seed set that cannot fan out stays host-side.  Wide
-        seed sets always go; narrow ones need enough static branch points
-        (JUMPIs) and no prior narrow-bail verdict on their codes."""
+        frontiers, so a seed set that cannot fan out stays host-side.  The
+        admission evidence is OBSERVED width (the link-calibrated
+        _MIN_SEED_WIDTH); a statically-branchy seed set that has already
+        fanned out to half the gate is admitted early."""
         if args.frontier_force:
             return True
         # scale the break-evens to the measured link (no-op after first call)
@@ -353,14 +370,23 @@ class FrontierEngine:
         # the slow verdict outranks the width bypass (see _SLOW_CODES)
         if all(_code_key(c) in _SLOW_CODES for c in codes.values()):
             return False
-        if len(pairs) >= self.caps.MIN_LIVE:
+        width_gate = max(self.caps.MIN_LIVE, _MIN_SEED_WIDTH)
+        if len(pairs) >= width_gate:
             return True
         if all(
             _code_key(c) in _NARROW_CODES or _code_key(c) in _SLOW_CODES
             for c in codes.values()
         ):
             return False
-        return sum(_jumpi_count(c) for c in codes.values()) >= _MIN_STATIC_JUMPIS
+        # early admission for provably-branchy code that is already halfway
+        # to the width gate: fork doubling will cross it within one segment
+        # (no MIN_LIVE floor here — at the default gate==MIN_LIVE this
+        # clause is only reached when len(pairs) < MIN_LIVE, so flooring
+        # would make it dead code)
+        return (
+            sum(_jumpi_count(c) for c in codes.values()) >= _MIN_STATIC_JUMPIS
+            and len(pairs) >= max(2, width_gate // 2)
+        )
 
     # ------------------------------------------------------------------
 
@@ -865,11 +891,15 @@ class FrontierEngine:
                     if host_rates else _SLOW_BAIL_FLOOR
                 )
                 code_keys = [_code_key(c) for c in table_code]
-                if n_exec_host / max(seg_only, 1e-6) < bail_rate:
+                seg_rate = n_exec_host / max(seg_only, 1e-6)
+                if seg_rate < bail_rate:
                     counts = [_SLOW_SEGMENTS.get(k, 0) + 1 for k in code_keys]
                     for k, c in zip(code_keys, counts):
                         _SLOW_SEGMENTS[k] = c
-                    if max(counts) >= _SLOW_BAIL_SEGMENTS:
+                    if (
+                        max(counts) >= _SLOW_BAIL_SEGMENTS
+                        or seg_rate < _SLOW_BAIL_DECISIVE * bail_rate
+                    ):
                         log.info(
                             "frontier: %d instructions in %.2fs (below "
                             "%.0f/s); host engine takes over",

@@ -30,7 +30,11 @@ MUTATOR_OPCODES = ("SSTORE", "CALL", "STATICCALL", "CREATE", "CREATE2")
 # the per-query probe budget for the "can callvalue exceed 0" check; shared
 # with the frontier prefetch so its warmed memo entries match the hook's
 MUTATION_PROBE_CONFIG = dict(
-    max_rounds=1, candidates_per_round=16, timeout_ms=500, prune_critical=True
+    max_rounds=1, candidates_per_round=16, timeout_ms=500, prune_critical=True,
+    # "is a nonzero callvalue still possible" is satisfiable on almost every
+    # path (callvalue is free up to the balance bound): answer it from a few
+    # directed candidates before any exact-UNSAT machinery
+    sat_biased=True,
 )
 
 

@@ -622,11 +622,17 @@ class ProbeConfig:
         timeout_ms: int = 10_000,
         rng_seed: int = 0x5EED,
         prune_critical: bool = False,
+        sat_biased: bool = False,
     ):
         self.max_rounds = max_rounds
         self.candidates_per_round = candidates_per_round
         self.timeout_ms = timeout_ms
         self.rng_seed = rng_seed
+        # sat-biased queries (successor pruning, mutation-pruner sweeps) are
+        # overwhelmingly satisfiable: a handful of directed candidates is
+        # tried BEFORE the exact-UNSAT interval tier and the independence
+        # split, so the common SAT answer skips their per-query DAG walks
+        self.sat_biased = sat_biased
         # prune-critical queries (is_possible, frontier/batch pruning) kill
         # paths on UNSAT: the exact CDCL tier is guaranteed a time slice even
         # when the probe burned the whole deadline, so an UNKNOWN-driven
@@ -1157,7 +1163,7 @@ def check_satisfiable_batch(
     """
     config = config or ProbeConfig(
         max_rounds=2, candidates_per_round=24, timeout_ms=2000,
-        prune_critical=True,
+        prune_critical=True, sat_biased=True,
     )
     results: List[Optional[bool]] = [None] * len(constraint_sets)
     pending: List[Tuple[int, List[Term], frozenset]] = []
@@ -1358,6 +1364,25 @@ def solve_conjunction(
     if resolved is not None:
         return resolved
 
+    gen: Optional[CandidateGenerator] = None
+    # tier 0.55 (sat-biased queries only): a few directed candidates before
+    # any exact-UNSAT machinery.  Pruning sweeps ask "is this successor /
+    # this callvalue!=0 variant still feasible" — almost always yes, and
+    # the seeder's repair passes hit in 1-3 candidates; paying the interval
+    # walk + independence split per sibling first was the dominant harvest
+    # cost on wide frontiers (profiled: ~8ms+2.6ms per query x thousands)
+    if config.sat_biased and getattr(global_args, "probe_backend", "auto") != "cdcl":
+        # (forced-exact mode skips every heuristic tier, this one included)
+        gen = CandidateGenerator(conjuncts, config)
+        for asg in gen.generate(8, deadline=t0 + config.timeout_ms / 2000.0):
+            vals = evaluate(conjuncts, asg)
+            if all(vals[c] for c in conjuncts):
+                stats.probe_hits += 1
+                if use_cache:
+                    _model_cache.remember(cache_key, SAT, asg)
+                stats.solver_time += time.time() - t0
+                return SAT, asg
+
     # tier 0.6: interval-bound refutation — exact UNSAT for range-impossible
     # demands (a loop-exit path pinning cnt<=1 conjoined with an overflow
     # demand cnt*value >= 2^256), at one linear DAG walk instead of seconds
@@ -1386,6 +1411,7 @@ def solve_conjunction(
                 timeout_ms=remaining_ms,
                 rng_seed=config.rng_seed,
                 prune_critical=config.prune_critical,
+                sat_biased=config.sat_biased,
             )
             status, asg = solve_conjunction(
                 bucket, sub_config, extra_seeds=extra_seeds,
@@ -1449,7 +1475,8 @@ def solve_conjunction(
         stats.solver_time += time.time() - t0
         return result
 
-    gen = CandidateGenerator(conjuncts, config)
+    if gen is None:
+        gen = CandidateGenerator(conjuncts, config)
     scalar_vars = gen.scalar_vars
     seeder = gen.seeder
     rng = gen.rng

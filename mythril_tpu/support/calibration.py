@@ -27,6 +27,12 @@ log = logging.getLogger(__name__)
 _ANCHOR_RTT_MS = 100.0
 _ANCHOR_PROBE_THRESHOLD = 600_000
 _ANCHOR_MIN_STATIC_JUMPIS = 8
+# observed-width admission gate at the anchor link: 24 live paths was the
+# empirical floor below which segment fixed costs beat the host on the
+# ~100ms tunnel (round-5 width study: the 0.3-0.7x rows peak at width
+# 5-12, the winning rows at 40+); on a local-RTT chip this scales down to
+# the engine default of 8
+_ANCHOR_MIN_SEED_WIDTH = 24
 
 _state: Dict = {"done": False, "rtt_ms": None, "applied": {}}
 
@@ -93,6 +99,10 @@ def calibrate() -> Dict:
         new_jumpis = int(min(16, max(2, round(_ANCHOR_MIN_STATIC_JUMPIS * scale))))
         frontier_engine._MIN_STATIC_JUMPIS = new_jumpis
         applied["min_static_jumpis"] = new_jumpis
+    if frontier_engine._MIN_SEED_WIDTH == 8:  # engine default, un-overridden
+        new_width = int(min(64, max(8, round(_ANCHOR_MIN_SEED_WIDTH * scale))))
+        frontier_engine._MIN_SEED_WIDTH = new_width
+        applied["min_seed_width"] = new_width
     _state["applied"] = applied
     log.info("device calibration: %s", applied)
     return applied

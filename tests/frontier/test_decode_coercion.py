@@ -1,0 +1,47 @@
+"""Arena decode sort-coercion: word ops over comparison rows.
+
+The device kernel keeps EVM comparison results as 0/1 words; the host
+decoder rebuilds comparison rows as Bool terms.  solc-style sequences like
+``LT; NOT`` or ``ISZERO; MUL`` therefore hand a Bool to a word operator at
+decode time — which crashed the walker ("not a bitvector: eq") and silently
+dropped the path (recall loss on the device config only).
+"""
+
+from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.support.support_args import args as global_args
+
+# CALLDATALOAD(0); PUSH1 5; LT; NOT; SSTORE(0, .); CALLER; SELFDESTRUCT
+# the NOT consumes a symbolic comparison row; SSTORE ships it in an event,
+# forcing the walker to decode the bool-typed row as a word operand
+CODE = "600035" "6005" "10" "19" "600055" "33" "ff"
+
+
+def _analyze(frontier: bool):
+    reset_callback_modules()
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        m.cache.clear()
+    old = (global_args.frontier, global_args.frontier_force)
+    global_args.frontier, global_args.frontier_force = frontier, frontier
+    try:
+        sym = SymExecWrapper(
+            bytes.fromhex(CODE),
+            address=0x0901D12E,
+            strategy="bfs",
+            transaction_count=1,
+            execution_timeout=60,
+            modules=["AccidentallyKillable"],
+        )
+        issues = fire_lasers(sym, white_list=["AccidentallyKillable"])
+    finally:
+        global_args.frontier, global_args.frontier_force = old
+    return sorted((i.swc_id, i.address) for i in issues)
+
+
+def test_not_over_comparison_row_survives_device_decode():
+    host = _analyze(frontier=False)
+    dev = _analyze(frontier=True)
+    assert host, "selfdestruct not reachable on host"
+    assert host == dev, f"device path lost issues: host={host} dev={dev}"
