@@ -1,0 +1,24 @@
+"""Sphinx configuration for mythril-tpu (mirrors the reference docs tree
+scope, /root/reference/docs/source/conf.py, rebuilt for this package)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "mythril-tpu"
+author = "mythril-tpu contributors"
+release = "0.5.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+# jax and the native library are heavyweight/optional at doc-build time
+autodoc_mock_imports = ["jax", "jaxlib"]
+
+templates_path = []
+exclude_patterns = []
+html_theme = "alabaster"
