@@ -1490,6 +1490,23 @@ def solve_conjunction(
     if extra_seeds:
         candidates.extend(extra_seeds)
     total = config.max_rounds * config.candidates_per_round
+    # when the exact tier is cheap (native CDCL present, small blast), cap
+    # the heuristic budget at one round: for UNSAT-leaning queries the full
+    # candidate stream plus the 64-mutation repair costs more than the
+    # exact answer (profiled ~190k candidate evaluations per wide_solc run)
+    cheap_exact = False
+    if total > config.candidates_per_round:
+        try:
+            from mythril_tpu.native import bitblast as _bb
+
+            cheap_exact = (
+                _bb.available()
+                and len(terms.topo_order(list(conjuncts))) < 1500
+            )
+        except Exception:
+            cheap_exact = False
+        if cheap_exact:
+            total = config.candidates_per_round
 
     # Device batching only when the deadline still has room: a cache-miss
     # compile is the dominant cost, and a blown solver_timeout breaks the
@@ -1566,7 +1583,7 @@ def solve_conjunction(
 
     # local repair: mutate the best candidate on vars feeding failed conjuncts
     if best_asg is not None and scalar_vars:
-        for _ in range(64):
+        for _ in range(16 if cheap_exact else 64):
             if time.time() > deadline:
                 break
             asg = Assignment(

@@ -26,6 +26,7 @@ def test_fast_link_lowers_breakevens(monkeypatch):
     _with_rtt(monkeypatch, 2.0)  # local chip: ~2ms round trip
     old_thresh = args.device_probe_threshold
     old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    old_width = frontier_engine._MIN_SEED_WIDTH
     try:
         applied = cal.calibrate()
         assert applied["dispatch_rtt_ms"] == 2.0
@@ -34,9 +35,12 @@ def test_fast_link_lowers_breakevens(monkeypatch):
         assert applied["min_static_jumpis"] == 2
         assert args.device_probe_threshold == 20_000
         assert frontier_engine._MIN_STATIC_JUMPIS == 2
+        # 24 * (2/100) rounds to 0, floored at the engine default of 8
+        assert frontier_engine._MIN_SEED_WIDTH == 8
     finally:
         args.device_probe_threshold = old_thresh
         frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        frontier_engine._MIN_SEED_WIDTH = old_width
         _fresh_state()
 
 
@@ -45,13 +49,16 @@ def test_anchor_link_keeps_defaults(monkeypatch):
     _with_rtt(monkeypatch, 100.0)
     old_thresh = args.device_probe_threshold
     old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    old_width = frontier_engine._MIN_SEED_WIDTH
     try:
         applied = cal.calibrate()
         assert applied.get("device_probe_threshold") == 600_000
         assert applied.get("min_static_jumpis") == 8
+        assert applied.get("min_seed_width") == 24
     finally:
         args.device_probe_threshold = old_thresh
         frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        frontier_engine._MIN_SEED_WIDTH = old_width
         _fresh_state()
 
 
@@ -60,6 +67,7 @@ def test_user_override_untouched(monkeypatch):
     _with_rtt(monkeypatch, 2.0)
     old_thresh = args.device_probe_threshold
     old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    old_width = frontier_engine._MIN_SEED_WIDTH
     args.device_probe_threshold = 123_456  # user-set: must not be rescaled
     try:
         applied = cal.calibrate()
@@ -68,6 +76,7 @@ def test_user_override_untouched(monkeypatch):
     finally:
         args.device_probe_threshold = old_thresh
         frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        frontier_engine._MIN_SEED_WIDTH = old_width
         _fresh_state()
 
 
@@ -82,6 +91,7 @@ def test_idempotent(monkeypatch):
     monkeypatch.setattr(cal, "measure_dispatch_rtt_ms", fake)
     old_thresh = args.device_probe_threshold
     old_jumpis = frontier_engine._MIN_STATIC_JUMPIS
+    old_width = frontier_engine._MIN_SEED_WIDTH
     try:
         first = cal.calibrate()
         second = cal.calibrate()
@@ -90,4 +100,5 @@ def test_idempotent(monkeypatch):
     finally:
         args.device_probe_threshold = old_thresh
         frontier_engine._MIN_STATIC_JUMPIS = old_jumpis
+        frontier_engine._MIN_SEED_WIDTH = old_width
         _fresh_state()
