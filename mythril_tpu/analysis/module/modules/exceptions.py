@@ -18,23 +18,62 @@ DESCRIPTION = """
 Checks whether any exception states are reachable.
 """
 
+def _is_assertion_failure(state: GlobalState) -> bool:
+    """REVERT carrying Panic(0x01) — a solc >=0.8 assert failure (reference
+    exceptions.py:123-133: concrete return data starting with the Panic
+    selector whose last byte is panic code 1).  The selector is checked
+    FIRST so the dominant non-assert revert class (Error(string) from
+    require) costs four byte reads, not a scan of its whole return data."""
+    from mythril_tpu.analysis.swc_data import PANIC_SELECTOR_BYTES
+    from mythril_tpu.core.util import get_concrete_int
+
+    mstate = state.mstate
+    try:
+        offset = get_concrete_int(mstate.stack[-1])
+        length = get_concrete_int(mstate.stack[-2])
+    except (TypeError, IndexError):
+        return False
+    if length < 5 or length > 4096:
+        return False
+    try:
+        selector = [get_concrete_int(mstate.memory[offset + i]) for i in range(4)]
+        if selector != PANIC_SELECTOR_BYTES:
+            return False
+        return get_concrete_int(mstate.memory[offset + length - 1]) == 1
+    except (TypeError, KeyError):
+        return False
+
 
 class Exceptions(DetectionModule):
     name = "Assertion violation"
     swc_id = ASSERT_VIOLATION
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
-    pre_hooks = ["INVALID"]
+    pre_hooks = ["INVALID", "REVERT"]
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
-        if self._cache_key(state) in self.cache:
+        # solc >= 0.8 routes EVERY assert through one shared panic block,
+        # so the revert pc alone cannot tell two assert sites apart — key
+        # the dedup by the active function as well (the reference gets the
+        # same distinction from its last-JUMP source_location annotation,
+        # exceptions.py:24-29; the function entry works identically for
+        # one-assert-per-function layouts and needs no JUMP hook, which
+        # would re-inflate the device event diet)
+        function = state.node.function_name if state.node else "unknown"
+        key = self._cache_key(state) + (function,)
+        if key in self.cache:
             return None
-        return self._analyze_state(state)
+        issues = self._analyze_state(state)
+        if issues:
+            self.cache.add(key)
+        return issues
 
     def _analyze_state(self, state: GlobalState) -> List[Issue]:
-        # solve immediately: the INVALID halts this path exceptionally, so a
-        # deferred (tx-end) check would never fire for it
+        # solve immediately: INVALID/REVERT halt this path exceptionally,
+        # so a deferred (tx-end) check would never fire for it
         instruction = state.get_current_instruction()
+        if instruction["opcode"] == "REVERT" and not _is_assertion_failure(state):
+            return []
         try:
             transaction_sequence = get_transaction_sequence(
                 state, state.world_state.constraints.get_all_constraints()

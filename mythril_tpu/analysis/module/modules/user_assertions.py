@@ -22,7 +22,7 @@ DESCRIPTION = "Search for reachable user-supplied exceptions (hidden assertions)
 ASSERTION_FAILED_TOPIC = 0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0
 
 # solc >=0.8 Panic(uint256) selector
-PANIC_SELECTOR = 0x4E487B71
+from mythril_tpu.analysis.swc_data import PANIC_SELECTOR
 
 
 class UserAssertions(DetectionModule):

@@ -135,8 +135,18 @@ class Report:
         return sorted(issue_list, key=lambda k: (k["swc-id"], k["address"]))
 
     def append_issue(self, issue: Issue) -> None:
+        # the FUNCTION is part of the identity (reference report.py:236-246
+        # keys contract+function+address+title): solc >= 0.8 routes every
+        # assert through one shared panic block, so two assert sites in
+        # different functions report the same pc
         key = hashlib.md5(
-            (issue.bytecode_hash + str(issue.address) + issue.swc_id + issue.title).encode()
+            (
+                issue.bytecode_hash
+                + issue.function
+                + str(issue.address)
+                + issue.swc_id
+                + issue.title
+            ).encode()
         ).digest()
         self.issues[key] = issue
 

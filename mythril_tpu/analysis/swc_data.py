@@ -10,6 +10,12 @@ REENTRANCY = "107"
 DEFAULT_VISIBILITY_STATE = "108"
 UNINITIALIZED_STORAGE_POINTER = "109"
 ASSERT_VIOLATION = "110"
+
+# Panic(uint256) error selector (solc >= 0.8 assert/panic reverts); shared
+# by the Exceptions (revert-data scan) and UserAssertions (MSTORE value
+# gate) modules so the two encodings cannot drift
+PANIC_SELECTOR = 0x4E487B71
+PANIC_SELECTOR_BYTES = list(PANIC_SELECTOR.to_bytes(4, "big"))
 DEPRECATED_FUNCTIONS_USAGE = "111"
 DELEGATECALL_TO_UNTRUSTED_CONTRACT = "112"
 MULTIPLE_SENDS = "113"

@@ -534,12 +534,38 @@ class Instruction:
     @StateTransition()
     def codesize_(self, global_state: GlobalState) -> List[GlobalState]:
         code = global_state.environment.code
+        no_of_bytes = len(code.bytecode)
+        if isinstance(
+            global_state.current_transaction, ContractCreationTransaction
+        ):
+            # constructor arguments live AFTER the creation code; model them
+            # as the tx calldata appended past the code end (reference
+            # instructions.py:980-989): concrete calldata extends CODESIZE
+            # by its real length, symbolic calldata by 16 32-byte argument
+            # slots with the size pinned so bounds checks in solc's arg
+            # decoder are decidable
+            calldata = global_state.environment.calldata
+            if isinstance(calldata, ConcreteCalldata):
+                no_of_bytes += calldata.size
+            else:
+                no_of_bytes += 0x200
+                global_state.world_state.constraints.append(
+                    calldata.calldatasize
+                    == symbol_factory.BitVecVal(no_of_bytes, 256)
+                )
         global_state.mstate.stack.append(
-            symbol_factory.BitVecVal(len(code.bytecode), 256)
+            symbol_factory.BitVecVal(no_of_bytes, 256)
         )
         return [global_state]
 
-    def _copy_code_to_memory(self, global_state, code_bytes: bytes, dest, offset, size):
+    def _copy_code_to_memory(
+        self, global_state, code_bytes: bytes, dest, offset, size,
+        overflow_calldata=None,
+    ):
+        """``overflow_calldata``: creation-tx constructor-argument model —
+        reads past the code end route to the transaction calldata at the
+        shifted offset (reference instructions.py:1080-1101) instead of
+        zero-padding, so symbolic constructor arguments work."""
         mstate = global_state.mstate
         if size.value is None:
             for i in range(32):
@@ -553,7 +579,12 @@ class Instruction:
         start = offset.value
         for i in range(n):
             if start is not None:
-                b = code_bytes[start + i] if start + i < len(code_bytes) else 0
+                if start + i < len(code_bytes):
+                    b = code_bytes[start + i]
+                elif overflow_calldata is not None:
+                    b = overflow_calldata[start + i - len(code_bytes)]
+                else:
+                    b = 0
                 mstate.memory.set_byte(dest + i, b)
             else:
                 mstate.memory.set_byte(
@@ -565,7 +596,15 @@ class Instruction:
         s = global_state.mstate.stack
         dest, offset, size = s.pop(), s.pop(), s.pop()
         code = global_state.environment.code.bytecode
-        self._copy_code_to_memory(global_state, code, dest, offset, size)
+        overflow = None
+        if isinstance(
+            global_state.current_transaction, ContractCreationTransaction
+        ):
+            # constructor args follow the creation code (see codesize_)
+            overflow = global_state.environment.calldata
+        self._copy_code_to_memory(
+            global_state, code, dest, offset, size, overflow_calldata=overflow
+        )
         return [global_state]
 
     @StateTransition()
@@ -921,7 +960,12 @@ class Instruction:
             caller=caller,
             callee_account=callee_account,
             code=code,
-            call_data=None,
+            # EMPTY CONCRETE calldata, not the symbolic default: the
+            # constructor args of an inner CREATE/CREATE2 are already
+            # embedded in init_bytes, so the symbolic constructor-arg
+            # model (codesize_/codecopy_ +0x200 phantom bytes) must not
+            # apply — CODESIZE must be exact here
+            call_data=ConcreteCalldata(0, []),
             gas_price=environment.gasprice,
             gas_limit=global_state.mstate.gas_left,
             origin=environment.origin,

@@ -83,6 +83,9 @@ def execute_contract_creation(
         origin=_bv(origin_address),
         caller=_bv(caller_address),
         code=Disassembly(bytes.fromhex(contract_initialization_code.replace("0x", ""))),
+        # concrete replay: constructor args are embedded in the creation
+        # hex — the symbolic constructor-arg default must not apply
+        call_data=ConcreteCalldata(next_tx_id, []),
         gas_price=_bv(gas_price),
         call_value=_bv(value),
         contract_name=contract_name,

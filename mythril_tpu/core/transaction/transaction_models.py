@@ -14,7 +14,11 @@ import copy as _copy
 from typing import Optional
 
 from mythril_tpu.core.state.account import Account
-from mythril_tpu.core.state.calldata import BaseCalldata, ConcreteCalldata
+from mythril_tpu.core.state.calldata import (
+    BaseCalldata,
+    ConcreteCalldata,
+    SymbolicCalldata,
+)
 from mythril_tpu.core.state.constraints import Constraints
 from mythril_tpu.core.state.environment import Environment
 from mythril_tpu.core.state.global_state import GlobalState
@@ -109,7 +113,12 @@ class BaseTransaction:
         self.caller = caller
         self.callee_account = callee_account
         if call_data is None and init_call_data:
-            call_data = ConcreteCalldata(self.id, [])
+            # symbolic, not empty-concrete (reference transaction_models.py:
+            # 103-104): creation transactions read constructor arguments
+            # through the calldata model (codesize_/codecopy_ route reads
+            # past the code end there), so the default must be able to
+            # carry symbolic argument bytes
+            call_data = SymbolicCalldata(self.id)
         self.call_data = call_data
         self.call_value = (
             call_value
@@ -219,7 +228,21 @@ class ContractCreationTransaction(BaseTransaction):
     def end(self, global_state: GlobalState, return_data=None, revert: bool = False):
         from mythril_tpu.frontend.disassembler import Disassembly
 
-        if not revert and return_data is not None and isinstance(return_data, (bytes, bytearray)):
+        if not revert and return_data is not None:
+            if not isinstance(return_data, (bytes, bytearray)):
+                # runtime code with SYMBOLIC bytes: solc >= 0.8 writes
+                # immutable values into PUSH operands of the returned code
+                # before RETURN, and a constructor-argument-derived
+                # immutable is symbolic.  Deploy with those operand bytes
+                # concretized to zero rather than dropping the deployment
+                # (the reference accepts symbolic entries into its
+                # disassembly the same way, transaction_models.py:249-253;
+                # the code STRUCTURE is unaffected — only immutable reads
+                # lose their symbolic identity)
+                return_data = bytes(
+                    (b.value or 0) if hasattr(b, "value") else int(b)
+                    for b in return_data
+                )
             global_state.environment.active_account.code = Disassembly(bytes(return_data))
             self.return_data = global_state.environment.active_account.address
         elif not revert:
