@@ -795,6 +795,12 @@ _UNIT_BLURB = (
 )
 
 
+def _observability_snapshot() -> dict:
+    from mythril_tpu.observability import get_registry
+
+    return get_registry().snapshot()
+
+
 def _emit_snapshot(table: dict, budget_meta: dict, partial: bool) -> None:
     """One JSON line on stdout + a file copy.  Emitted after EVERY completed
     workload pair so a driver-level timeout can never zero the artifact —
@@ -821,6 +827,10 @@ def _emit_snapshot(table: dict, budget_meta: dict, partial: bool) -> None:
             if FrontierStatistics().microbench
             else {}
         ),
+        # machine-readable per-stage breakdown: the full metrics-registry
+        # snapshot (frontier/solver counters plus the segment/harvest/
+        # smt-solve wall-time histograms) accumulated over the sweep
+        "observability": _observability_snapshot(),
     }
     if partial:
         obj["partial"] = True
@@ -838,7 +848,10 @@ def main() -> None:
     # so the measured configuration actually exercises the device hybrid
     import os
 
-    t_proc = time.time()
+    # suite-internal budget clock (monotonic); the per-workload t0 stamps
+    # stay time.time() because _ttfe/_rebase_stamp compare them against the
+    # epoch-anchored report.StartTime discovery stamps
+    t_proc = time.perf_counter()
     # global wall-clock budget: the driver kills long runs (round 4's capture
     # died rc=124 with no JSON emitted), so the suite trims itself instead —
     # rep 1 of every workload always runs (full table first), reps 2+ run
@@ -866,7 +879,7 @@ def main() -> None:
     def budget_meta():
         return {
             "budget_s": budget_s,
-            "elapsed_s": round(time.time() - t_proc, 1),
+            "elapsed_s": round(time.perf_counter() - t_proc, 1),
             "trimmed": trimmed,
         }
 
@@ -875,12 +888,12 @@ def main() -> None:
             if rep >= reps:
                 continue
             est = pair_cost.get(name, 0.0)
-            if rep > 0 and time.time() + est > deadline:
+            if rep > 0 and time.perf_counter() + est > deadline:
                 # deterministic trim: later reps go first, rep 1 never does
                 trimmed.append({"workload": name, "rep": rep + 1})
                 continue
             d = data[name]
-            t_pair = time.time()
+            t_pair = time.perf_counter()
             for tag, production in (("baseline", False), ("production", True)):
                 fstats = FrontierStatistics()
                 dev_before = fstats.device_instructions
@@ -927,7 +940,7 @@ def main() -> None:
             # LATEST pair wall, not the max: rep 0 includes once-per-process
             # warm-ups (wide_frontier/corpus segment compiles) that later
             # reps never pay — a max would over-trim them
-            pair_cost[name] = time.time() - t_pair
+            pair_cost[name] = time.perf_counter() - t_pair
             d["completed_reps"] += 1
             row = _row_summary(unit, d)
             for tag in ("baseline", "production"):

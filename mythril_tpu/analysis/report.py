@@ -236,6 +236,12 @@ class Report:
             meta["mythril_execution_info"] = {}
             for ei in self.execution_info:
                 meta["mythril_execution_info"].update(ei.as_dict())
+        # full metrics snapshot (and trace summary when tracing was on):
+        # the machine-readable per-stage breakdown next to the legacy
+        # execution-info rollups
+        from mythril_tpu.observability import observability_meta
+
+        meta["observability"] = observability_meta()
         result = [
             {
                 "issues": sorted(_issues, key=lambda k: k["swcID"]),

@@ -28,6 +28,7 @@ from mythril_tpu.core.strategy.basic import (
 from mythril_tpu.core.strategy.extensions.bounded_loops import BoundedLoopsStrategy
 from mythril_tpu.core.svm import LaserEVM
 from mythril_tpu.core.transaction.symbolic import ACTORS
+from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.plugins.loader import LaserPluginLoader
 from mythril_tpu.plugins.plugins.call_depth_limiter import CallDepthLimitBuilder
 from mythril_tpu.plugins.plugins.coverage import CoveragePluginBuilder
@@ -175,26 +176,31 @@ class SymExecWrapper:
             return
 
         # execute (creation vs runtime, reference symbolic.py:168-220)
-        if self._resume_from:
-            self._exec_resumed(address)
-        elif isinstance(contract, (bytes, bytearray)):
-            # raw runtime bytecode
-            from mythril_tpu.frontend.disassembler import Disassembly
+        with _otrace.span("analysis.sym_exec", cat="analysis"):
+            if self._resume_from:
+                self._exec_resumed(address)
+            elif isinstance(contract, (bytes, bytearray)):
+                # raw runtime bytecode
+                from mythril_tpu.frontend.disassembler import Disassembly
 
-            acct = world_state.create_account(
-                balance=0, address=address, concrete_storage=False
-            )
-            acct.code = Disassembly(bytes(contract))
-            self.laser.sym_exec(world_state=world_state, target_address=address)
-        elif getattr(contract, "creation_code", None):
-            self._exec_creation(contract, world_state)
-        else:
-            acct = world_state.create_account(
-                balance=0, address=address, concrete_storage=False
-            )
-            acct.code = contract.disassembly
-            acct.contract_name = getattr(contract, "name", "Unknown")
-            self.laser.sym_exec(world_state=world_state, target_address=address)
+                acct = world_state.create_account(
+                    balance=0, address=address, concrete_storage=False
+                )
+                acct.code = Disassembly(bytes(contract))
+                self.laser.sym_exec(
+                    world_state=world_state, target_address=address
+                )
+            elif getattr(contract, "creation_code", None):
+                self._exec_creation(contract, world_state)
+            else:
+                acct = world_state.create_account(
+                    balance=0, address=address, concrete_storage=False
+                )
+                acct.code = contract.disassembly
+                acct.contract_name = getattr(contract, "name", "Unknown")
+                self.laser.sym_exec(
+                    world_state=world_state, target_address=address
+                )
 
         if self._benchmark_plugin is not None:
             try:

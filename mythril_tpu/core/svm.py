@@ -256,7 +256,7 @@ class LaserEVM:
                     "frontier engine failed; host engine continues: %s",
                     e, exc_info=True,
                 )
-        start = time.time()
+        start = time.perf_counter()
         deadline = (
             start + self.create_timeout
             if create and self.create_timeout
@@ -270,10 +270,10 @@ class LaserEVM:
         first_drain_attempted = False
         zero_drains = 0  # consecutive drain attempts that executed nothing
         for global_state in self.strategy:
-            if time.time() > deadline or time_handler.time_remaining() <= 0:
+            if time.perf_counter() > deadline or time_handler.time_remaining() <= 0:
                 log.info("%s timeout reached; halting exec loop", "create" if create else "execution")
                 break
-            t_step = time.time()
+            t_step = time.perf_counter()
             new_states, op_code = self.execute_state(global_state)
             if self.requires_statespace:
                 self.manage_cfg(op_code, new_states)
@@ -285,7 +285,7 @@ class LaserEVM:
             # bail compares device segment rates against it — the host's
             # own pace on a workload spans 5..900 states/s, so no fixed
             # floor can stand in for it
-            self._host_step_secs += time.time() - t_step
+            self._host_step_secs += time.perf_counter() - t_step
             self._host_steps += 1
             self.work_list.extend(new_states)
             self.total_states += len(new_states)

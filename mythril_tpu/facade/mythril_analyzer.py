@@ -167,12 +167,17 @@ class MythrilAnalyzer:
             if benchmark_base and len(self.contracts) > 1:
                 # one series file per contract instead of silent overwrites
                 args.benchmark_path = f"{benchmark_base}.{n_contract}"
-            # the frontier counters are process-wide: without a per-contract
-            # reset, contract N's jsonv2 meta would report parks/segment time
-            # accumulated from earlier contracts in the same invocation
-            from mythril_tpu.frontier.stats import FrontierStatistics
+            # the telemetry singletons are process-wide: without a
+            # per-contract sweep, contract N's jsonv2 meta would report
+            # parks/segment time/solver queries accumulated from earlier
+            # contracts in the same invocation.  The sweep clears every
+            # non-persistent metric (FrontierStatistics and
+            # SolverStatistics facades included); the frontier's per-code
+            # slow/narrow verdicts are persistent-scope and survive — see
+            # reset_analysis_metrics / frontier/engine.py.
+            from mythril_tpu.observability import reset_analysis_metrics
 
-            FrontierStatistics().reset()
+            reset_analysis_metrics()
             try:
                 sym = self._sym_exec(contract)
                 issues = fire_lasers(sym, modules or self.cmd_args.modules)

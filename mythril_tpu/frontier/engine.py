@@ -60,6 +60,8 @@ from mythril_tpu.frontier.code import (
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
 from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability import tracer as _otrace
+from mythril_tpu.observability.metrics import get_registry as _get_metrics
 from mythril_tpu.frontier.step import (
     ArenaDev,
     CfgScalars,
@@ -109,7 +111,11 @@ _SLOW_BAIL_DECISIVE = 0.5
 
 # slow-segment counters persist ACROSS runs per code (short explorations
 # split into several 1-2 segment runs, so a per-run counter never reaches
-# the bail threshold); a fast segment resets its codes
+# the bail threshold); a fast segment resets its codes.  These dicts are
+# deliberately process-lifetime state, NOT per-analysis telemetry: the
+# observability registry mirrors the verdicts under persistent-scope
+# metrics (frontier.slow_code_verdicts / frontier.narrow_code_verdicts)
+# that survive the reset_analysis_metrics() sweep, exactly like the dicts.
 _SLOW_SEGMENTS: Dict[object, int] = {}
 
 # (caps, bucket) programs already dispatched once this process: their first
@@ -607,7 +613,7 @@ class FrontierEngine:
     def _run(self, pairs: List[Tuple],
              bucket_floor: Optional[tuple] = None) -> int:
         caps = self.caps
-        t_start = time.time()
+        t_start = time.perf_counter()
 
         seed_lasers = [laser for laser, _ in pairs]
         seeds = [gs for _, gs in pairs]
@@ -655,9 +661,14 @@ class FrontierEngine:
         if bucket_floor is not None:
             bucket = tuple(max(b, f) for b, f in zip(bucket, bucket_floor))
         code_cap, instr_cap, addr_cap, loops_cap = bucket
-        segment = cached_segment(caps, *bucket)
         program_key = (caps, bucket)
         program_warm = program_key in _WARM_PROGRAMS
+        with _otrace.span("frontier.compile", cat="frontier",
+                          warm=program_warm, bucket=list(bucket)):
+            # builds (or returns) the jitted program; the XLA compile
+            # itself is paid inside the first dispatch's segment span
+            # (warm=False marks it)
+            segment = cached_segment(caps, *bucket)
         # marked warm only AFTER a segment actually dispatches (loop below):
         # a run that breaks before its first segment must not tag the still
         # uncompiled program as warm, or the NEXT run's compile-paying first
@@ -690,7 +701,8 @@ class FrontierEngine:
             if _is_fresh(gs):
                 mid_enc.append(None)
                 continue
-            enc = self._encode_mid(arena, gs)
+            with _otrace.span("frontier.mid_encode", cat="frontier", seed=i):
+                enc = self._encode_mid(arena, gs)
             mid_enc.append(enc)
             if enc is None:
                 FrontierStatistics().mid_encode_failures += 1
@@ -804,14 +816,14 @@ class FrontierEngine:
 
         width_verdict_valid = True  # False when the run was cut short
         while True:
-            if time.time() > deadline or time_handler.time_remaining() <= 0:
+            if time.perf_counter() > deadline or time_handler.time_remaining() <= 0:
                 log.info("frontier: execution timeout; parking live paths")
                 self._park_all(st, records, walker, reason="timeout")
                 width_verdict_valid = False
                 break
 
             stats = FrontierStatistics()
-            t_seg = time.time()
+            t_seg = time.perf_counter()
             # step-limit ramp (dynamic scalar, no recompile): early segments
             # stay short so the first terminals harvest — and their exploits
             # confirm — quickly; later segments run long to amortize the
@@ -832,35 +844,44 @@ class FrontierEngine:
                 micro_args = (
                     st_dev, dev_arena, arena_len, visited, code_dev, cfg
                 )
-            out_state, dev_arena, out_len, n_exec, seg_max_live, visited = (
-                segment(st_dev, dev_arena, arena_len, visited, code_dev, cfg)
-            )
-            # pull state to host mirrors (writable: harvest mutates slots):
-            # one packed meta transfer (scalars ride along) + one
-            # bucket-capped events pull
-            st, arena_len_new, n_exec_host, seg_ml_host = pull_harvest(
-                out_state, out_len, n_exec, seg_max_live
-            )
+            with _otrace.span(
+                "frontier.segment", cat="device",
+                segment=run_segments, warm=program_warm,
+            ), _otrace.device_annotation("frontier.segment"):
+                out_state, dev_arena, out_len, n_exec, seg_max_live, visited = (
+                    segment(st_dev, dev_arena, arena_len, visited, code_dev, cfg)
+                )
+                # pull state to host mirrors (writable: harvest mutates
+                # slots): one packed meta transfer (scalars ride along) +
+                # one bucket-capped events pull
+                st, arena_len_new, n_exec_host, seg_ml_host = pull_harvest(
+                    out_state, out_len, n_exec, seg_max_live
+                )
             max_live = max(max_live, seg_ml_host)
             arena.pull_from_device(dev_arena, arena_len_new)
             arena_len = arena_len_new
             executed += n_exec_host
             stats.device_instructions += n_exec_host
             stats.segments += 1
-            seg_only = time.time() - t_seg
+            seg_only = time.perf_counter() - t_seg
             if micro and n_exec_host > 0:
                 self._run_microbench(segment, micro_args, n_exec_host, st)
             stats.segment_s += seg_only
+            _get_metrics().observe("frontier.segment_wall_s", seg_only)
             _WARM_PROGRAMS.add(program_key)  # a segment really dispatched
 
-            t_har = time.time()
-            self._harvest(st, records, walker, ev_seen)
+            t_har = time.perf_counter()
+            with _otrace.span("frontier.harvest", cat="frontier",
+                              segment=run_segments):
+                self._harvest(st, records, walker, ev_seen)
             # events were fully drained into the path records, and the next
             # segment starts with EMPTY device buffers (push_state rebuilds
             # them; events never cross the link upward) — restart the
             # per-slot seen counters to match
             ev_seen.fill(0)
-            stats.harvest_s += time.time() - t_har
+            har_only = time.perf_counter() - t_har
+            stats.harvest_s += har_only
+            _get_metrics().observe("frontier.harvest_wall_s", har_only)
 
             # mid-run throughput accounting — BEFORE the exit checks below,
             # so a run's final segment still counts (short explorations
@@ -930,7 +951,9 @@ class FrontierEngine:
                                  _beam_importance(seeds[si]) if beam else 0,
                                  static=statics[si])
                     if mid_enc[si] is not None:
-                        self._apply_mid(st, slot, mid_enc[si])
+                        with _otrace.span("frontier.mid_inject",
+                                          cat="frontier", seed=si):
+                            self._apply_mid(st, slot, mid_enc[si])
                         FrontierStatistics().mid_injections += 1
                     records[slot] = PathRecord(seed_idx=si)
                     ev_seen[slot] = 0
@@ -971,12 +994,20 @@ class FrontierEngine:
             for code in table_code:
                 key = _code_key(code)
                 if _SLOW_SEGMENTS.get(key, 0) >= _SLOW_BAIL_SEGMENTS:
+                    if key not in _SLOW_CODES:
+                        _get_metrics().counter(
+                            "frontier.slow_code_verdicts", persistent=True
+                        ).inc()
                     _SLOW_CODES.add(key)
         elif max_live < caps.MIN_LIVE and width_verdict_valid:
             # narrow: stayed under MIN_LIVE (skipped for narrow drains,
             # still admitted by wide seed sets).  A run cut short by
             # timeout/arena pressure proves nothing and marks nothing.
             for code in table_code:
+                if _code_key(code) not in _NARROW_CODES:
+                    _get_metrics().counter(
+                        "frontier.narrow_code_verdicts", persistent=True
+                    ).inc()
                 _NARROW_CODES.add(_code_key(code))
 
         visited_host = np.asarray(visited)
@@ -1120,14 +1151,14 @@ class FrontierEngine:
         reps-1 is the per-segment device compute alone.  Runs once per
         process on the first productive segment when args.frontier_microbench
         is set (bench.py's device_microbench block)."""
-        t0 = time.time()
+        t0 = time.perf_counter()
         out = segment(*micro_args)
         np.asarray(out[3])  # n_exec scalar readback forces a true sync
-        t_one = time.time() - t0
-        t0 = time.time()
+        t_one = time.perf_counter() - t0
+        t0 = time.perf_counter()
         outs = [segment(*micro_args) for _ in range(reps)]
         np.asarray(outs[-1][3])
-        t_many = time.time() - t0
+        t_many = time.perf_counter() - t0
         compute = max((t_many - t_one) / max(reps - 1, 1), 1e-9)
         # packed host->device push excludes events (rebuilt empty on
         # device); the packed pull rides the same layout + 2 scalars

@@ -6,35 +6,104 @@ prioritizes widening device coverage, and how much of a run stayed
 device-resident is the number that explains the measured speedup.  Counters
 land in the report meta next to the solver statistics (reference parity:
 engine telemetry via ExecutionInfo, mythril/analysis/report.py:319-320).
+
+Since the observability subsystem landed this class is a thin facade:
+every attribute is a property backed by a named metric in
+``mythril_tpu.observability.metrics`` (prefix ``frontier.``), so the
+``stats.segments += 1`` call sites and the ``as_dict()`` report shape
+are unchanged while the same numbers flow into ``--metrics-out`` /
+``meta.observability`` snapshots.
 """
 
 from __future__ import annotations
 
-from collections import Counter
-
+from mythril_tpu.observability.metrics import get_registry
 from mythril_tpu.support.support_utils import Singleton
+
+_PREFIX = "frontier."
+
+
+def _counter_prop(attr: str, doc: str = ""):
+    name = _PREFIX + attr
+
+    def fget(self):
+        return get_registry().counter(name).value
+
+    def fset(self, v):
+        get_registry().counter(name).set(v)
+
+    return property(fget, fset, doc=doc)
 
 
 class FrontierStatistics(metaclass=Singleton):
-    def __init__(self) -> None:
-        self.reset()
+    """Facade over the ``frontier.*`` metrics in the global registry."""
 
-    def reset(self) -> None:
-        self.device_instructions = 0  # instructions executed on device
-        self.device_paths = 0  # paths that ran (fully or partly) on device
-        self.parks_by_opcode = Counter()  # opcode name -> paths parked on it
-        self.parks_by_reason = Counter()  # timeout/arena/narrow/batch-full
-        self.segments = 0  # device segment dispatches
-        self.segment_s = 0.0  # wall time in segment dispatch + state pull
-        self.harvest_s = 0.0  # wall time in host-side harvest
-        self.mesh_devices = 0  # >0: segments ran path-sharded over a mesh
-        self.mid_injections = 0  # mid-frame states re-entered on device
-        self.mid_encode_failures = 0  # mid-frame seeds bounced at encoding
-        self.semantic_parks = 0  # paths pinned host-side until stepped past
+    device_instructions = _counter_prop(
+        "device_instructions", "instructions executed on device")
+    device_paths = _counter_prop(
+        "device_paths", "paths that ran (fully or partly) on device")
+    segments = _counter_prop("segments", "device segment dispatches")
+    segment_s = _counter_prop(
+        "segment_s", "wall time in segment dispatch + state pull")
+    harvest_s = _counter_prop("harvest_s", "wall time in host-side harvest")
+    mesh_devices = _counter_prop(
+        "mesh_devices", ">0: segments ran path-sharded over a mesh")
+    mid_injections = _counter_prop(
+        "mid_injections", "mid-frame states re-entered on device")
+    mid_encode_failures = _counter_prop(
+        "mid_encode_failures", "mid-frame seeds bounced at encoding")
+    semantic_parks = _counter_prop(
+        "semantic_parks", "paths pinned host-side until stepped past")
+
+    def __init__(self) -> None:
+        self._materialize()
+
+    @property
+    def parks_by_opcode(self):
+        """opcode name -> paths parked on it"""
+        return get_registry().labeled_counter(_PREFIX + "parks_by_opcode")
+
+    @property
+    def parks_by_reason(self):
+        """timeout/arena/narrow/batch-full"""
+        return get_registry().labeled_counter(_PREFIX + "parks_by_reason")
+
+    @property
+    def microbench(self) -> dict:
         # device-only efficiency numbers (engine._run_microbench): pure
         # segment compute time via chained re-dispatch subtraction, so the
         # per-chip story is measurable independent of the host<->device link
-        self.microbench: dict = {}
+        return get_registry().gauge(_PREFIX + "microbench", default={}).value
+
+    @microbench.setter
+    def microbench(self, v: dict) -> None:
+        get_registry().gauge(_PREFIX + "microbench", default={}).set(v)
+
+    def _materialize(self) -> None:
+        """Force-create the backing metrics so snapshots always carry the
+        full frontier block even before the first increment."""
+        reg = get_registry()
+        for attr in (
+            "device_instructions", "device_paths", "segments",
+            "mesh_devices", "mid_injections", "mid_encode_failures",
+            "semantic_parks",
+        ):
+            reg.counter(_PREFIX + attr)
+        # float-typed wall-time accumulators (report emits 0.0, not 0)
+        reg.counter(_PREFIX + "segment_s", initial=0.0)
+        reg.counter(_PREFIX + "harvest_s", initial=0.0)
+        reg.labeled_counter(_PREFIX + "parks_by_opcode")
+        reg.labeled_counter(_PREFIX + "parks_by_reason")
+        reg.gauge(_PREFIX + "microbench", default={})
+
+    def reset(self) -> None:
+        """Zero the frontier-scoped metrics.
+
+        Note this deliberately does NOT touch the persistent-scope
+        verdict metrics (``frontier.slow_code_verdicts`` etc.) that
+        mirror engine.py's process-lifetime slow-segment bookkeeping.
+        """
+        get_registry().reset(prefix=_PREFIX)
 
     def record_park(self, opcode: str) -> None:
         self.parks_by_opcode[opcode] += 1

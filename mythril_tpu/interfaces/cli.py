@@ -139,6 +139,19 @@ def _add_analysis_options(parser) -> None:
         default=64,
         help="device frontier batch width (paths held on device)",
     )
+    group.add_argument(
+        "--trace-out",
+        metavar="FILE",
+        help="enable span tracing and write a Chrome-trace/Perfetto JSON "
+        "to FILE after the run (open in https://ui.perfetto.dev); "
+        "FILE.jsonl additionally gets the flat span records",
+    )
+    group.add_argument(
+        "--metrics-out",
+        metavar="FILE",
+        help="write the full metrics-registry snapshot (frontier/solver/"
+        "profiler counters and per-stage histograms) to FILE as JSON",
+    )
 
 
 def _add_output_options(parser) -> None:
@@ -311,6 +324,36 @@ def _build_analyzer(parsed, query_signature: bool = False):
     return analyzer
 
 
+def _arm_observability(parsed) -> None:
+    """Enable span tracing before the analyzer is built when requested."""
+    if getattr(parsed, "trace_out", None):
+        from mythril_tpu.observability import get_tracer
+
+        get_tracer().enabled = True
+
+
+def _export_observability(parsed) -> None:
+    """Write --trace-out / --metrics-out artifacts after an analysis."""
+    trace_out = getattr(parsed, "trace_out", None)
+    metrics_out = getattr(parsed, "metrics_out", None)
+    if trace_out:
+        from mythril_tpu.observability import get_tracer
+
+        tracer = get_tracer()
+        tracer.export_chrome_trace(trace_out)
+        tracer.export_jsonl(trace_out + ".jsonl")
+        log.info(
+            "wrote %d spans (%d dropped) to %s [+.jsonl]",
+            len(tracer), tracer.dropped, trace_out,
+        )
+    if metrics_out:
+        from mythril_tpu.observability import observability_meta
+
+        with open(metrics_out, "w") as f:
+            json.dump(observability_meta(), f, indent=2, sort_keys=True)
+        log.info("wrote metrics snapshot to %s", metrics_out)
+
+
 def execute_command(parsed) -> None:
     command = COMMAND_ALIASES.get(parsed.command, parsed.command)
 
@@ -404,13 +447,17 @@ def execute_command(parsed) -> None:
         return
 
     if command == "safe-functions":
+        _arm_observability(parsed)
         analyzer = _build_analyzer(parsed)
         parsed_tx_count_backup = parsed.transaction_count
         analyzer.cmd_args.transaction_count = 1
         from mythril_tpu.support.support_args import args as global_args
 
         global_args.unconstrained_storage = True
-        report = analyzer.fire_lasers()
+        try:
+            report = analyzer.fire_lasers()
+        finally:
+            _export_observability(parsed)
         issue_functions = {i["function"] for i in report.sorted_issues()}
         all_functions = set()
         for contract in analyzer.contracts:
@@ -425,6 +472,7 @@ def execute_command(parsed) -> None:
         return
 
     if command == "analyze":
+        _arm_observability(parsed)
         analyzer = _build_analyzer(parsed)
         if parsed.graph:
             html = analyzer.graph_html(
@@ -437,7 +485,10 @@ def execute_command(parsed) -> None:
             with open(parsed.statespace_json, "w") as f:
                 f.write(analyzer.dump_statespace())
             return
-        report = analyzer.fire_lasers()
+        try:
+            report = analyzer.fire_lasers()
+        finally:
+            _export_observability(parsed)
         outputs = {
             "json": report.as_json(),
             "jsonv2": report.as_swc_standard_format(),

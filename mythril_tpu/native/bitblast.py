@@ -556,7 +556,7 @@ def solve(
     lib = _load()
     if lib is None or timeout_s <= 0:
         return UNKNOWN, None
-    deadline = _time.time() + timeout_s
+    deadline = _time.perf_counter() + timeout_s
     refine: List[Tuple[int, int, int]] = []
     kec_refine: List[Tuple[int, int, int]] = []
     kec_done: set = set()
@@ -585,7 +585,7 @@ def solve(
             log.debug("refinement hit tape cap: %s", e)
             return UNKNOWN, None
         refine, kec_refine = [], []
-        remaining = deadline - _time.time()
+        remaining = deadline - _time.perf_counter()
         if remaining <= 0:
             return UNKNOWN, None
         status, model = _run_solver(lib, tape, remaining)
@@ -733,11 +733,11 @@ class OptimizeSession:
 
         if self._handle is None:
             return UNKNOWN, None
-        deadline = _time.time() + timeout_s
+        deadline = _time.perf_counter() + timeout_s
         kec_done: set = set()
         kec_rounds = 0
         for _round in range(_CEGAR_ROUNDS):
-            remaining = deadline - _time.time()
+            remaining = deadline - _time.perf_counter()
             if remaining <= 0:
                 return UNKNOWN, None
             status, asg, violations, kec_mm = self._solve_once(

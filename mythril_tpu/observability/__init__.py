@@ -1,0 +1,69 @@
+"""Unified tracing & metrics for the analyzer runtime.
+
+Two pieces, both process-wide singletons:
+
+* :mod:`mythril_tpu.observability.tracer` — a low-overhead span tracer
+  (context-manager / decorator API over a thread-safe ring buffer) with
+  Chrome-trace/Perfetto JSON and flat JSONL exporters.  Disabled by
+  default; when disabled every instrumentation site costs one attribute
+  check and returns a shared no-op context manager.
+
+* :mod:`mythril_tpu.observability.metrics` — a registry of named
+  counters / gauges / histograms that absorbs the mutable-attribute
+  telemetry style of ``FrontierStatistics`` and ``SolverStatistics``.
+  Those classes remain as thin facades over the registry so existing
+  call sites and report-meta output are unchanged.
+
+The convenience re-exports below are the recommended import surface::
+
+    from mythril_tpu.observability import get_tracer, get_registry, span
+
+    with span("frontier.segment", cat="frontier", k=64):
+        ...
+"""
+
+from mythril_tpu.observability.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledCounter,
+    MetricsRegistry,
+    get_registry,
+)
+from mythril_tpu.observability.tracer import (  # noqa: F401
+    Tracer,
+    device_annotation,
+    get_tracer,
+    span,
+    traced,
+)
+
+
+def observability_meta() -> dict:
+    """Snapshot block embedded in report meta and BENCH rows."""
+    # Materialize the facade-backed metrics first so the snapshot always
+    # carries the full frontier.*/solver.* key set, even for runs where a
+    # stage never executed (e.g. narrow workloads that bail off-device).
+    from mythril_tpu.frontier.stats import FrontierStatistics
+    from mythril_tpu.smt.solver import SolverStatistics
+
+    FrontierStatistics()._materialize()
+    SolverStatistics()
+    tracer = get_tracer()
+    meta = {"metrics": get_registry().snapshot()}
+    if tracer.enabled or len(tracer):
+        meta["trace"] = tracer.summary()
+    return meta
+
+
+def reset_analysis_metrics() -> None:
+    """Reset per-analysis telemetry at the start of an analysis.
+
+    Clears every non-persistent metric in the registry (which resets the
+    ``FrontierStatistics`` / ``SolverStatistics`` facades with it).
+    Metrics registered with ``persistent=True`` — e.g. the frontier's
+    per-code slow/narrow-segment verdicts, which engine.py deliberately
+    keeps across runs so a code that degenerated once is not re-probed —
+    survive the sweep.
+    """
+    get_registry().reset()

@@ -1,0 +1,269 @@
+"""Process-wide metrics registry: named counters, gauges, histograms.
+
+This registry absorbs the mutable-attribute telemetry that used to live
+in three disconnected singletons (``FrontierStatistics``,
+``SolverStatistics``, the ``InstructionProfiler`` plugin).  Those
+classes remain as thin facades whose attributes are properties backed by
+registry metrics, so call sites like ``stats.segments += 1`` and tests
+that assign ``stats.unknown_as_unsat = 0`` keep working unchanged.
+
+Scopes
+------
+Metrics default to the *analysis* scope and are cleared by
+``MetricsRegistry.reset()`` at the start of each analysis.  Metrics
+created with ``persistent=True`` survive that sweep — the frontier's
+per-code slow/narrow-segment verdicts use this, mirroring the
+deliberately process-persistent ``_SLOW_CODES`` / ``_NARROW_CODES``
+dicts in ``frontier/engine.py`` (a code that degenerated once must not
+be re-probed by the very next analysis in the same process).
+
+Thread-safety: metric mutation is plain attribute arithmetic guarded by
+the GIL, matching the guarantees of the singletons it replaces; registry
+*registration* is lock-protected because harvest worker threads may
+create metrics concurrently.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LabeledCounter",
+    "MetricsRegistry",
+    "get_registry",
+]
+
+Number = Union[int, float]
+
+
+class Counter:
+    """Monotonic-by-convention accumulator; ``set()`` exists for facades.
+
+    ``initial`` fixes the numeric type: a counter created with ``0.0``
+    resets to float zero, keeping facade report output (``round(x, 3)``)
+    type-stable with the pre-registry singletons.
+    """
+
+    __slots__ = ("name", "persistent", "value", "_initial")
+
+    def __init__(self, name: str, persistent: bool = False, initial: Number = 0):
+        self.name = name
+        self.persistent = persistent
+        self._initial = initial
+        self.value: Number = initial
+
+    def inc(self, n: Number = 1) -> None:
+        self.value += n
+
+    def set(self, v: Number) -> None:
+        self.value = v
+
+    def reset(self) -> None:
+        self.value = self._initial
+
+    def snapshot(self) -> Number:
+        return self.value
+
+
+class Gauge:
+    """Last-write-wins value; may hold any JSON-serializable object."""
+
+    __slots__ = ("name", "persistent", "value", "_default")
+
+    def __init__(self, name: str, persistent: bool = False, default: Any = 0):
+        self.name = name
+        self.persistent = persistent
+        self._default = default
+        self.value: Any = _copy_default(default)
+
+    def set(self, v: Any) -> None:
+        self.value = v
+
+    def reset(self) -> None:
+        self.value = _copy_default(self._default)
+
+    def snapshot(self) -> Any:
+        return self.value
+
+
+def _copy_default(default: Any) -> Any:
+    # mutable defaults (microbench dict) must not be shared across resets
+    return default.copy() if isinstance(default, (dict, list)) else default
+
+
+class LabeledCounter(collections.Counter):
+    """A ``collections.Counter`` registered as one metric.
+
+    Subclassing keeps facade call sites like
+    ``stats.parks_by_opcode[op] += 1`` and ``.most_common()`` intact.
+    """
+
+    def __init__(self, name: str, persistent: bool = False):
+        super().__init__()
+        self.name = name
+        self.persistent = persistent
+
+    def reset(self) -> None:
+        self.clear()
+
+    def snapshot(self) -> Dict[str, Number]:
+        return dict(self.most_common())
+
+
+# Power-of-two-ish duration buckets (seconds): 100µs .. ~100s.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram with count/sum/min/max.
+
+    ``bucket_counts[i]`` counts observations ``<= buckets[i]``; the final
+    slot is the +Inf overflow bucket (Prometheus-style cumulative-free
+    layout — each observation lands in exactly one slot).
+    """
+
+    __slots__ = (
+        "name", "persistent", "buckets", "bucket_counts",
+        "count", "sum", "min", "max",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+        persistent: bool = False,
+    ):
+        self.name = name
+        self.persistent = persistent
+        self.buckets: Tuple[float, ...] = tuple(buckets)
+        self.bucket_counts: List[int] = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+    def reset(self) -> None:
+        self.bucket_counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+        }
+        if self.count:
+            out["min"] = round(self.min, 6)
+            out["max"] = round(self.max, 6)
+            out["avg"] = round(self.sum / self.count, 6)
+            # only non-empty buckets, keyed by upper bound ("+Inf" last)
+            nonzero = {}
+            for i, c in enumerate(self.bucket_counts):
+                if c:
+                    le = "+Inf" if i == len(self.buckets) else repr(self.buckets[i])
+                    nonzero[le] = c
+            out["buckets_le"] = nonzero
+        return out
+
+
+class MetricsRegistry:
+    """Name -> metric map with get-or-create accessors and scoped reset."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Any] = {}
+
+    def _get_or_create(self, name: str, factory, kind) -> Any:
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = factory()
+                    self._metrics[name] = m
+        if not isinstance(m, kind):
+            raise TypeError(
+                f"metric {name!r} already registered as {type(m).__name__}"
+            )
+        return m
+
+    def counter(
+        self, name: str, persistent: bool = False, initial: Number = 0
+    ) -> Counter:
+        return self._get_or_create(
+            name, lambda: Counter(name, persistent, initial), Counter
+        )
+
+    def gauge(self, name: str, persistent: bool = False, default: Any = 0) -> Gauge:
+        return self._get_or_create(
+            name, lambda: Gauge(name, persistent, default), Gauge
+        )
+
+    def labeled_counter(self, name: str, persistent: bool = False) -> LabeledCounter:
+        return self._get_or_create(
+            name, lambda: LabeledCounter(name, persistent), LabeledCounter
+        )
+
+    def histogram(
+        self,
+        name: str,
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+        persistent: bool = False,
+    ) -> Histogram:
+        return self._get_or_create(
+            name, lambda: Histogram(name, buckets, persistent), Histogram
+        )
+
+    def observe(self, name: str, v: float) -> None:
+        """Shorthand: record ``v`` into histogram ``name``."""
+        self.histogram(name).observe(v)
+
+    def reset(self, include_persistent: bool = False, prefix: str = "") -> None:
+        """Zero analysis-scoped metrics; keep ``persistent=True`` ones
+        unless ``include_persistent`` is set.  ``prefix`` restricts the
+        sweep to one namespace (e.g. ``"frontier."``)."""
+        with self._lock:
+            metrics = [
+                m for name, m in self._metrics.items()
+                if name.startswith(prefix)
+            ]
+        for m in metrics:
+            if include_persistent or not m.persistent:
+                m.reset()
+
+    def snapshot(self, prefix: str = "") -> Dict[str, Any]:
+        """JSON-serializable view of every metric (optionally filtered)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        return {
+            name: m.snapshot()
+            for name, m in items
+            if name.startswith(prefix)
+        }
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry

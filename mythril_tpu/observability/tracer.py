@@ -1,0 +1,258 @@
+"""Low-overhead span tracer with Chrome-trace/Perfetto and JSONL export.
+
+Design constraints, in order:
+
+1. **Near-zero cost when disabled.**  The tracer ships disabled; every
+   instrumentation site in the hot path (per-segment, per-SMT-query,
+   per-detection-module) does one attribute check and receives a shared
+   immutable no-op context manager.  No allocation, no clock read.
+
+2. **Cheap when enabled.**  Spans are recorded as plain tuples into a
+   bounded ring buffer under a lock (harvest threads and the host engine
+   both emit spans); ``time.perf_counter()`` is the only clock used, so
+   NTP steps cannot corrupt durations.
+
+3. **Standard export.**  ``export_chrome_trace()`` writes the Chrome
+   ``trace_event`` JSON object format ("X" complete events) that
+   chrome://tracing and https://ui.perfetto.dev load directly;
+   ``export_jsonl()`` writes one flat JSON object per line for ad-hoc
+   grep/jq pipelines.
+
+Timestamps are microseconds relative to the tracer's origin (first
+construction or last ``reset()``), which is what the trace viewers
+expect — they render relative time, not epoch time.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["Tracer", "get_tracer", "span", "traced", "device_annotation"]
+
+
+class _NullContext:
+    """Shared no-op context manager returned when tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        return False
+
+    def set(self, **_args):  # matches _SpanContext.set
+        return self
+
+
+_NULL_CONTEXT = _NullContext()
+
+
+class _SpanContext:
+    """Context manager recording one complete ("X") span on exit."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: Optional[dict]):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def set(self, **args) -> "_SpanContext":
+        """Attach/override span args from inside the span body."""
+        if self._args is None:
+            self._args = args
+        else:
+            self._args.update(args)
+        return self
+
+    def __enter__(self) -> "_SpanContext":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *_exc) -> bool:
+        t1 = time.perf_counter()
+        self._tracer._record(
+            self._name, self._cat, self._t0, t1 - self._t0,
+            threading.get_ident(), self._args,
+        )
+        return False
+
+
+class Tracer:
+    """Thread-safe bounded span recorder.
+
+    ``capacity`` bounds memory: once full, the oldest spans are evicted
+    and counted in ``dropped`` so exports can report truncation instead
+    of silently looking complete.
+    """
+
+    def __init__(self, capacity: int = 100_000):
+        self.enabled = False
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+        self.dropped = 0
+        self._origin = time.perf_counter()
+
+    # -- recording -----------------------------------------------------
+
+    def span(self, name: str, cat: str = "host", **args):
+        """Context manager timing a span; no-op when disabled.
+
+        ::
+
+            with tracer.span("frontier.segment", cat="frontier", k=64):
+                dispatch()
+        """
+        if not self.enabled:
+            return _NULL_CONTEXT
+        return _SpanContext(self, name, cat, args or None)
+
+    def instant(self, name: str, cat: str = "host", **args) -> None:
+        """Record a zero-duration marker event."""
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        self._record(name, cat, t, 0.0, threading.get_ident(), args or None)
+
+    def _record(self, name, cat, t0, dur, tid, args) -> None:
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self.dropped += 1
+            self._buf.append((name, cat, t0 - self._origin, dur, tid, args))
+
+    # -- inspection ----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """Snapshot of recorded spans as dicts (seconds, origin-relative)."""
+        with self._lock:
+            raw = list(self._buf)
+        return [
+            {
+                "name": name,
+                "cat": cat,
+                "ts": ts,
+                "dur": dur,
+                "tid": tid,
+                **({"args": args} if args else {}),
+            }
+            for name, cat, ts, dur, tid, args in raw
+        ]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._buf)
+        return {
+            "enabled": self.enabled,
+            "spans": n,
+            "dropped": self.dropped,
+            "capacity": self.capacity,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self.dropped = 0
+            self._origin = time.perf_counter()
+
+    # -- export --------------------------------------------------------
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome ``trace_event`` JSON object format (Perfetto-loadable)."""
+        import os
+
+        pid = os.getpid()
+        with self._lock:
+            raw = list(self._buf)
+        events = []
+        for name, cat, ts, dur, tid, args in raw:
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": round(ts * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+            }
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "mythril_tpu.observability",
+                "dropped_spans": self.dropped,
+            },
+        }
+
+    def export_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+    def export_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for rec in self.spans():
+                f.write(json.dumps(rec) + "\n")
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def span(name: str, cat: str = "host", **args):
+    """Module-level shorthand for ``get_tracer().span(...)``."""
+    if not _tracer.enabled:
+        return _NULL_CONTEXT
+    return _SpanContext(_tracer, name, cat, args or None)
+
+
+def traced(name: Optional[str] = None, cat: str = "host") -> Callable:
+    """Decorator form: time every call of the wrapped function as a span."""
+
+    def deco(fn: Callable) -> Callable:
+        span_name = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            if not _tracer.enabled:
+                return fn(*a, **kw)
+            with _SpanContext(_tracer, span_name, cat, None):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def device_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` when tracing is on, else a no-op.
+
+    Lets our span names show up inside XLA's own profiler timeline so a
+    ``jax.profiler`` capture can be overlaid with the host-side trace.
+    jax is imported lazily and failures degrade to the no-op context so
+    the tracer never hard-depends on a profiler-capable jax build.
+    """
+    if not _tracer.enabled:
+        return _NULL_CONTEXT
+    try:
+        from jax.profiler import TraceAnnotation  # local import: lazy
+
+        return TraceAnnotation(name)
+    except Exception:
+        return _NULL_CONTEXT

@@ -105,7 +105,7 @@ class BenchmarkPlugin(LaserPlugin):
         self._device_insns_at_start = 0
 
     def initialize(self, symbolic_vm) -> None:
-        self.begin = time.time()
+        self.begin = time.perf_counter()
         # the series tracks host-stepped instructions (execute_state hooks);
         # device-frontier segments bypass those hooks, so their instruction
         # total is reported separately from FrontierStatistics
@@ -115,10 +115,10 @@ class BenchmarkPlugin(LaserPlugin):
 
         def execute_state_hook(_):
             self.nr_of_executed_insns += 1
-            self.points.append((time.time() - self.begin, self.nr_of_executed_insns))
+            self.points.append((time.perf_counter() - self.begin, self.nr_of_executed_insns))
 
         def stop_hook():
-            self.end = time.time()
+            self.end = time.perf_counter()
             duration = self.end - self.begin
             rate = self.nr_of_executed_insns / duration if duration > 0 else 0.0
             log.info(

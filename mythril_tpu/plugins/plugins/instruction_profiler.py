@@ -10,6 +10,7 @@ import time
 from collections import defaultdict
 from typing import Optional, Tuple
 
+from mythril_tpu.observability.metrics import get_registry
 from mythril_tpu.plugins.interface import LaserPlugin, PluginBuilder
 
 log = logging.getLogger(__name__)
@@ -25,7 +26,7 @@ class InstructionProfiler(LaserPlugin):
     def initialize(self, symbolic_vm) -> None:
         def pre_hook(global_state):
             op = global_state.get_current_instruction()["opcode"]
-            self._current = (op, time.time())
+            self._current = (op, time.perf_counter())
 
         def post_hook(global_state):
             # a pre with no post (exception path) is simply overwritten by
@@ -34,7 +35,7 @@ class InstructionProfiler(LaserPlugin):
                 return
             op, t0 = self._current
             self._current = None
-            dt = time.time() - t0
+            dt = time.perf_counter() - t0
             rec = self._sums[op]
             rec[0] += dt
             rec[1] = min(rec[1], dt)
@@ -45,6 +46,7 @@ class InstructionProfiler(LaserPlugin):
             report = self.to_string()
             if report:
                 log.info("Instruction profile:\n%s", report)
+            self.publish_metrics()
 
         symbolic_vm.register_instr_hooks("pre", None, pre_hook)
         symbolic_vm.register_instr_hooks("post", None, post_hook)
@@ -56,12 +58,27 @@ class InstructionProfiler(LaserPlugin):
         for op, (s, mn, mx, n) in sorted(
             self._sums.items(), key=lambda kv: -kv[1][0]
         ):
+            # a pre-hook with no matching post (exception path at the very
+            # end of a run) leaves n == 0: report the op without an average
+            # rather than dividing by zero
+            avg = s / n if n else 0.0
             lines.append(
-                f"[{op:14}] {s:.6f}s total, n={n}, avg={s / n:.6f}, min={mn:.6f}, max={mx:.6f}"
+                f"[{op:14}] {s:.6f}s total, n={n}, avg={avg:.6f}, min={mn:.6f}, max={mx:.6f}"
             )
             total += s
         lines.append(f"Total: {total:.6f}s")
         return "\n".join(lines)
+
+    def publish_metrics(self) -> None:
+        """Mirror per-opcode totals into the observability registry, so the
+        profile rides report meta / ``--metrics-out`` next to the frontier
+        and solver blocks instead of living only in a log line."""
+        reg = get_registry()
+        time_by_op = reg.labeled_counter("profiler.host_s_by_opcode")
+        count_by_op = reg.labeled_counter("profiler.count_by_opcode")
+        for op, (s, _mn, _mx, n) in self._sums.items():
+            time_by_op[op] += round(s, 6)
+            count_by_op[op] += n
 
 
 class InstructionProfilerBuilder(PluginBuilder):

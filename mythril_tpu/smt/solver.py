@@ -29,6 +29,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.concrete_eval import ArrayValue, Assignment, evaluate
 from mythril_tpu.smt.terms import Term, mask
@@ -46,24 +47,60 @@ UNKNOWN = "unknown"
 # ---------------------------------------------------------------------------
 
 
+def _solver_counter_prop(attr: str, initial=0, doc: str = ""):
+    name = "solver." + attr
+
+    def fget(self):
+        return _metrics_registry().counter(name, initial=initial).value
+
+    def fset(self, v):
+        _metrics_registry().counter(name, initial=initial).set(v)
+
+    return property(fget, fset, doc=doc)
+
+
+def _metrics_registry():
+    from mythril_tpu.observability.metrics import get_registry
+
+    return get_registry()
+
+
 class SolverStatistics:
-    """Process-wide counters for solver usage (singleton)."""
+    """Process-wide counters for solver usage (singleton).
+
+    Thin facade over the ``solver.*`` metrics in the observability
+    registry: each attribute is a property over a named counter, so the
+    ``stats.query_count += 1`` call sites (and tests that assign
+    directly) work unchanged while the numbers flow into
+    ``--metrics-out`` / ``meta.observability`` snapshots.  ``enabled``
+    is plain instance state, not telemetry, and survives resets.
+    """
 
     _instance = None
+
+    query_count = _solver_counter_prop("query_count")
+    solver_time = _solver_counter_prop("solver_time_s", initial=0.0)
+    probe_hits = _solver_counter_prop("probe_hits")
+    cdcl_calls = _solver_counter_prop("cdcl_calls")
+    # completeness boundary: prune decisions taken on an UNKNOWN
+    # verdict (probe exhausted AND no exact CDCL answer) — every one
+    # is a potential recall loss, so runs should see this at 0
+    unknown_as_unsat = _solver_counter_prop("unknown_as_unsat")
 
     def __new__(cls):
         if cls._instance is None:
             cls._instance = super().__new__(cls)
             cls._instance.enabled = False
-            cls._instance.query_count = 0
-            cls._instance.solver_time = 0.0
-            cls._instance.probe_hits = 0
-            cls._instance.cdcl_calls = 0
-            # completeness boundary: prune decisions taken on an UNKNOWN
-            # verdict (probe exhausted AND no exact CDCL answer) — every one
-            # is a potential recall loss, so runs should see this at 0
-            cls._instance.unknown_as_unsat = 0
+            cls._instance.reset()
         return cls._instance
+
+    def reset(self) -> None:
+        """Zero the solver-scoped metrics (not the ``enabled`` switch)."""
+        _metrics_registry().reset(prefix="solver.")
+        # force-create the backing counters so snapshots always carry
+        # the full solver block even before the first query
+        _ = (self.query_count, self.solver_time, self.probe_hits,
+             self.cdcl_calls, self.unknown_as_unsat)
 
     def __repr__(self):
         return (
@@ -666,7 +703,7 @@ class CandidateGenerator:
     ) -> List[Assignment]:
         out = []
         for _ in range(n):
-            if out and deadline is not None and time.time() > deadline:
+            if out and deadline is not None and time.perf_counter() > deadline:
                 break
             out.append(self._build(self._index))
             self._index += 1
@@ -1145,6 +1182,7 @@ def _fast_path(
     return None, conj, key
 
 
+@_otrace.traced("smt.batch_check", cat="smt")
 def check_satisfiable_batch(
     constraint_sets: Sequence[Sequence[Term]],
     config: Optional["ProbeConfig"] = None,
@@ -1353,11 +1391,40 @@ def solve_conjunction(
     fresh model for a constraint set that may have been answered before
     (e.g. differential testing, or re-deriving a model after cache
     invalidation); normal solving should keep the caches on.
+
+    Thin telemetry wrapper: the solve itself lives in
+    ``_solve_conjunction_impl``; this layer records one ``smt.solve``
+    span (nested per independence-split bucket, since buckets recurse
+    through here) and a per-query latency histogram.
     """
+    if not _otrace.get_tracer().enabled:
+        t0 = time.perf_counter()
+        result = _solve_conjunction_impl(
+            conjuncts, config, extra_seeds, use_cache, replay
+        )
+        _metrics_registry().observe("smt.solve_s", time.perf_counter() - t0)
+        return result
+    with _otrace.span("smt.solve", cat="smt", conjuncts=len(conjuncts)) as sp:
+        t0 = time.perf_counter()
+        result = _solve_conjunction_impl(
+            conjuncts, config, extra_seeds, use_cache, replay
+        )
+        _metrics_registry().observe("smt.solve_s", time.perf_counter() - t0)
+        sp.set(status=result[0])
+        return result
+
+
+def _solve_conjunction_impl(
+    conjuncts: Sequence[Term],
+    config: Optional[ProbeConfig] = None,
+    extra_seeds: Optional[Sequence[Assignment]] = None,
+    use_cache: bool = True,
+    replay: bool = True,
+) -> Tuple[str, Optional[Assignment]]:
     config = config or ProbeConfig()
     stats = SolverStatistics()
     stats.query_count += 1
-    t0 = time.time()
+    t0 = time.perf_counter()
 
     # tiers 0 + memo + 0.5 (shared with check_satisfiable_batch)
     resolved, conjuncts, cache_key = _fast_path(conjuncts, use_cache, replay)
@@ -1380,7 +1447,7 @@ def solve_conjunction(
                 stats.probe_hits += 1
                 if use_cache:
                     _model_cache.remember(cache_key, SAT, asg)
-                stats.solver_time += time.time() - t0
+                stats.solver_time += time.perf_counter() - t0
                 return SAT, asg
 
     # tier 0.6: interval-bound refutation — exact UNSAT for range-impossible
@@ -1392,7 +1459,7 @@ def solve_conjunction(
     if _interval_refute(conjuncts):
         if use_cache:
             _model_cache.remember(cache_key, UNSAT, None)
-        stats.solver_time += time.time() - t0
+        stats.solver_time += time.perf_counter() - t0
         return UNSAT, None
 
     # tier 0.75: independence split (reference independence_solver.py:86-152)
@@ -1404,7 +1471,7 @@ def solve_conjunction(
         for bucket in buckets:
             # buckets share ONE query budget: each recursion gets only the
             # parent's remaining time, never a fresh full timeout
-            remaining_ms = max(1, int((whole_deadline - time.time()) * 1000))
+            remaining_ms = max(1, int((whole_deadline - time.perf_counter()) * 1000))
             sub_config = ProbeConfig(
                 max_rounds=config.max_rounds,
                 candidates_per_round=config.candidates_per_round,
@@ -1459,9 +1526,11 @@ def solve_conjunction(
 
             if bitblast.available():
                 stats.cdcl_calls += 1
-                status, asg = bitblast.solve(
-                    conjuncts, max(1.0, t0 + config.timeout_ms / 1000.0 - time.time())
-                )
+                with _otrace.span("smt.cdcl", cat="smt", forced=True):
+                    status, asg = bitblast.solve(
+                        conjuncts,
+                        max(1.0, t0 + config.timeout_ms / 1000.0 - time.perf_counter()),
+                    )
                 if status == SAT and asg is not None:
                     vals = evaluate(conjuncts, asg)
                     if all(vals[c] for c in conjuncts):
@@ -1472,7 +1541,7 @@ def solve_conjunction(
                     result = (UNSAT, None)
         except ImportError:
             pass
-        stats.solver_time += time.time() - t0
+        stats.solver_time += time.perf_counter() - t0
         return result
 
     if gen is None:
@@ -1515,12 +1584,13 @@ def solve_conjunction(
         _try_compile_device(conjuncts)
         if _device_backend_requested()
         and _device_worthwhile(conjuncts, total + len(candidates))
-        and time.time() < deadline
+        and time.perf_counter() < deadline
         else None
     )
     if compiled is not None:
         # the batched dispatch needs the whole pool upfront
-        candidates.extend(gen.generate(total, deadline))
+        with _otrace.span("smt.candidates", cat="smt", n=total):
+            candidates.extend(gen.generate(total, deadline))
 
     best_asg, best_score = None, -1
     if compiled is not None:
@@ -1529,7 +1599,10 @@ def solve_conjunction(
         import numpy as _np
 
         try:
-            truth = _evaluate_candidates_device(compiled, candidates)  # [B, C]
+            with _otrace.span(
+                "smt.device_probe", cat="device", batch=len(candidates)
+            ), _otrace.device_annotation("smt.device_probe"):
+                truth = _evaluate_candidates_device(compiled, candidates)  # [B, C]
         except Exception as e:
             log.warning(
                 "device probe evaluation failed, host fallback (%s): %s",
@@ -1544,10 +1617,10 @@ def solve_conjunction(
                     break
                 if check_asg(candidates[b]):
                     stats.probe_hits += 1
-                    stats.solver_time += time.time() - t0
+                    stats.solver_time += time.perf_counter() - t0
                     _model_cache.remember(cache_key, SAT, candidates[b])
                     return SAT, candidates[b]
-                if time.time() > deadline:
+                if time.perf_counter() > deadline:
                     break
             if len(candidates):
                 b = int(_np.argmax(scores))
@@ -1561,7 +1634,7 @@ def solve_conjunction(
             yield from candidates
             remaining = total - max(0, len(candidates) - len(extra_seeds or ()))
             for _ in range(max(0, remaining)):
-                if time.time() > deadline:
+                if time.perf_counter() > deadline:
                     return
                 yield gen.generate(1)[0]
 
@@ -1573,18 +1646,18 @@ def solve_conjunction(
             score = sum(1 for c in conjuncts if vals[c])
             if score == len(conjuncts):
                 stats.probe_hits += 1
-                stats.solver_time += time.time() - t0
+                stats.solver_time += time.perf_counter() - t0
                 _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
             if score > best_score:
                 best_score, best_asg = score, asg
-            if time.time() > deadline:
+            if time.perf_counter() > deadline:
                 break
 
     # local repair: mutate the best candidate on vars feeding failed conjuncts
     if best_asg is not None and scalar_vars:
         for _ in range(16 if cheap_exact else 64):
-            if time.time() > deadline:
+            if time.perf_counter() > deadline:
                 break
             asg = Assignment(
                 dict(best_asg.scalars),
@@ -1608,7 +1681,7 @@ def solve_conjunction(
             score = sum(1 for c in conjuncts if vals[c])
             if score == len(conjuncts):
                 stats.probe_hits += 1
-                stats.solver_time += time.time() - t0
+                stats.solver_time += time.perf_counter() - t0
                 _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
             if score >= best_score:
@@ -1620,7 +1693,7 @@ def solve_conjunction(
 
         if bitblast.available():
             stats.cdcl_calls += 1
-            budget = deadline - time.time()
+            budget = deadline - time.perf_counter()
             if compiled is not None or config.prune_critical:
                 # device-path queries may have burned the deadline on an XLA
                 # compile (first bucket in a cold process), and prune-critical
@@ -1629,8 +1702,9 @@ def solve_conjunction(
                 # a nonpositive timeout.  Other host-only queries keep strict
                 # wall-clock discipline (mutation pruner's 500ms etc.).
                 budget = max(1.0, budget)
-            status, asg = bitblast.solve(conjuncts, budget)
-            stats.solver_time += time.time() - t0
+            with _otrace.span("smt.cdcl", cat="smt", conjuncts=len(conjuncts)):
+                status, asg = bitblast.solve(conjuncts, budget)
+            stats.solver_time += time.perf_counter() - t0
             if status == SAT and asg is not None and check_asg(asg):
                 _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
@@ -1642,7 +1716,7 @@ def solve_conjunction(
     except ImportError:
         pass
 
-    stats.solver_time += time.time() - t0
+    stats.solver_time += time.perf_counter() - t0
     return UNKNOWN, None
 
 
@@ -1753,7 +1827,7 @@ class Optimize(Solver):
         def cfg_step() -> ProbeConfig:
             # clamp each step to the remaining overall budget so check()
             # cannot overrun its single deadline by a step's full slice
-            remaining_ms = max(1, int((deadline - time.time()) * 1000))
+            remaining_ms = max(1, int((deadline - time.perf_counter()) * 1000))
             return ProbeConfig(
                 max_rounds=self.config.max_rounds,
                 candidates_per_round=self.config.candidates_per_round,
@@ -1777,7 +1851,7 @@ class Optimize(Solver):
             if session is not None:
                 SolverStatistics().cdcl_calls += 1
                 budget = max(0.05, min(
-                    self.config.timeout_ms / 4000.0, deadline - time.time()
+                    self.config.timeout_ms / 4000.0, deadline - time.perf_counter()
                 ))
                 st, a2 = session.solve(
                     list(pins) + [(obj_idx, op, v)], budget,
@@ -1797,7 +1871,7 @@ class Optimize(Solver):
         best = value(asg)
         # fast path: the global optimum in one query
         target = 0 if want_min else top
-        if best != target and time.time() < deadline:
+        if best != target and time.perf_counter() < deadline:
             status, a2 = ask_op("eq", target)
             if status == SAT and a2 is not None:
                 return a2, True
@@ -1814,7 +1888,7 @@ class Optimize(Solver):
             # halvings; doubling from the current model reaches the optimum's
             # magnitude in log2(opt) SAT steps and one UNSAT caps the range
             lo, hi = best, top
-            while lo < hi and steps < max_steps and time.time() < deadline:
+            while lo < hi and steps < max_steps and time.perf_counter() < deadline:
                 steps += 1
                 probe_to = min(2 * best + 1, top)
                 status, a2 = ask_op("ge", probe_to)
@@ -1829,7 +1903,7 @@ class Optimize(Solver):
                 else:
                     return asg, False
         proven = best == target
-        while lo < hi and steps < max_steps and time.time() < deadline:
+        while lo < hi and steps < max_steps and time.perf_counter() < deadline:
             steps += 1
             if want_min:
                 mid = lo + (hi - 1 - lo) // 2  # strictly below current best
@@ -1859,7 +1933,7 @@ class Optimize(Solver):
         ]
         # ONE timeout budget covers the initial solve AND all refinement
         # (support/model.py sizes it against the remaining execution time)
-        deadline = time.time() + self.config.timeout_ms / 1000.0
+        deadline = time.perf_counter() + self.config.timeout_ms / 1000.0
         objectives = [(m, True) for m in self._minimize] + [
             (m, False) for m in self._maximize
         ]
@@ -1900,7 +1974,7 @@ class Optimize(Solver):
             SolverStatistics().cdcl_calls += 1
             st, a = session.solve(
                 [], max(0.05, min(self.config.timeout_ms / 2000.0,
-                                  deadline - time.time())),
+                                  deadline - time.perf_counter())),
                 enable=self._ext_enable if not owns_session else (),
             )
             if st == UNSAT:

@@ -1,0 +1,115 @@
+"""Metrics registry: counters/gauges/histograms, persistent scope, snapshot."""
+
+import pytest
+
+from mythril_tpu.observability.metrics import (
+    Counter,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry()
+
+
+def test_counter_inc_set_reset(reg):
+    c = reg.counter("t.count")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    c.set(2)
+    assert c.value == 2
+    c.reset()
+    assert c.value == 0
+
+
+def test_float_counter_keeps_type_through_reset(reg):
+    c = reg.counter("t.wall_s", initial=0.0)
+    c.inc(1.5)
+    c.reset()
+    assert c.value == 0.0 and isinstance(c.value, float)
+
+
+def test_gauge_object_default_not_shared_across_resets(reg):
+    g = reg.gauge("t.bench", default={})
+    g.value["k"] = 1
+    g.reset()
+    assert g.value == {}
+    g.value["j"] = 2
+    g.reset()
+    assert g.value == {}
+
+
+def test_labeled_counter_behaves_like_counter(reg):
+    lc = reg.labeled_counter("t.parks")
+    lc["CALL"] += 2
+    lc["SHA3"] += 1
+    assert lc.most_common()[0] == ("CALL", 2)
+    assert reg.snapshot()["t.parks"] == {"CALL": 2, "SHA3": 1}
+    lc.reset()
+    assert dict(lc) == {}
+
+
+def test_histogram_bucketing(reg):
+    h = reg.histogram("t.lat", buckets=(0.001, 0.01, 0.1, 1.0))
+    for v in (0.0005, 0.005, 0.005, 0.05, 5.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(5.0605)
+    assert h.min == pytest.approx(0.0005)
+    assert h.max == pytest.approx(5.0)
+    # one slot per observation: <=0.001, <=0.01 (x2), <=0.1, +Inf overflow
+    assert h.bucket_counts == [1, 2, 1, 0, 1]
+    snap = h.snapshot()
+    assert snap["buckets_le"] == {"0.001": 1, "0.01": 2, "0.1": 1, "+Inf": 1}
+    assert snap["avg"] == pytest.approx(5.0605 / 5)
+
+
+def test_histogram_boundary_lands_in_le_bucket(reg):
+    h = reg.histogram("t.edge", buckets=(1.0, 2.0))
+    h.observe(1.0)  # exactly on the bound counts as <= bound
+    assert h.bucket_counts == [1, 0, 0]
+
+
+def test_registry_get_or_create_returns_same_instance(reg):
+    assert reg.counter("t.a") is reg.counter("t.a")
+    with pytest.raises(TypeError):
+        reg.gauge("t.a")  # name already taken by a counter
+
+
+def test_persistent_scope_survives_reset(reg):
+    reg.counter("t.per_analysis").inc(7)
+    reg.counter("t.verdicts", persistent=True).inc(3)
+    reg.reset()
+    assert reg.counter("t.per_analysis").value == 0
+    assert reg.counter("t.verdicts", persistent=True).value == 3
+    reg.reset(include_persistent=True)
+    assert reg.counter("t.verdicts", persistent=True).value == 0
+
+
+def test_reset_prefix_scopes_the_sweep(reg):
+    reg.counter("a.x").inc()
+    reg.counter("b.y").inc()
+    reg.reset(prefix="a.")
+    assert reg.counter("a.x").value == 0
+    assert reg.counter("b.y").value == 1
+
+
+def test_snapshot_is_json_serializable(reg):
+    import json
+
+    reg.counter("t.c").inc()
+    reg.gauge("t.g", default={}).set({"k": [1, 2]})
+    reg.histogram("t.h").observe(0.2)
+    reg.labeled_counter("t.l")["OP"] += 1
+    json.dumps(reg.snapshot())  # must not raise
+
+
+def test_counter_metric_snapshot_is_plain_value():
+    c = Counter("x")
+    c.inc(3)
+    assert c.snapshot() == 3
+    h = Histogram("y")
+    assert h.snapshot() == {"count": 0, "sum": 0.0}
