@@ -65,12 +65,16 @@ KILLBILLY_CREATION = f"60{_L}600c60003960{_L}6000f3" + KILLBILLY
 def _clear_caches() -> None:
     from mythril_tpu.analysis.module.loader import ModuleLoader
     from mythril_tpu.analysis.security import reset_callback_modules
+    from mythril_tpu.querycache import reset_query_cache
     from mythril_tpu.smt.solver import clear_model_cache
     from mythril_tpu.support.model import _get_model_cached
 
     reset_callback_modules()
     clear_model_cache()
     _get_model_cached.cache_clear()
+    # drops the in-process query cache but keeps any configured disk store
+    # attached — warm runs in query_cache_compare hit via the disk tier
+    reset_query_cache()
     for module in ModuleLoader().get_detection_modules():
         module.cache.clear()
 
@@ -166,6 +170,64 @@ def run_analysis(probe_backend: str):
         contract, 0x0901D12E, 3, modules=["AccidentallyKillable"], timeout=300
     )
     return sym, issues, time.time() - t0
+
+
+def query_cache_compare(cache_dir=None) -> dict:
+    """Warm-vs-cold query-cache comparison on the killbilly workload.
+
+    Runs the analysis twice against one disk-backed cache directory: the
+    cold run populates the store, the warm run (fresh in-process cache via
+    ``_clear_caches``) must hit it.  Asserts a nonzero warm hit count and
+    an issue set identical to the cold run, then returns (and ``main``
+    prints) one JSON-able dict with walls, hit counters and the full
+    ``querycache.*`` registry snapshot.
+    """
+    import tempfile
+
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.querycache import configure, get_query_cache
+
+    def issue_set(issues):
+        return sorted((i.swc_id, i.address) for i in issues)
+
+    tmp = None
+    if cache_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="mythril-querycache-")
+        cache_dir = tmp.name
+    try:
+        configure(enabled=True, cache_dir=str(cache_dir))
+
+        get_registry().reset(prefix="querycache.")
+        _, cold_issues, cold_wall = run_analysis("host")
+        cold_stats = dict(get_query_cache().stats())
+
+        get_registry().reset(prefix="querycache.")
+        _, warm_issues, warm_wall = run_analysis("host")
+        warm_stats = dict(get_query_cache().stats())
+        warm_hits = get_query_cache().hits_total()
+
+        assert warm_hits > 0, f"warm run had zero cache hits: {warm_stats}"
+        assert issue_set(cold_issues) == issue_set(warm_issues), (
+            "warm issue set diverged from cold: "
+            f"{issue_set(cold_issues)} != {issue_set(warm_issues)}"
+        )
+        lookups = warm_stats.get("lookups", 0)
+        return {
+            "metric": "query_cache_compare",
+            "workload": "killbilly",
+            "cache_dir": str(cache_dir),
+            "cold_wall_s": round(cold_wall, 3),
+            "warm_wall_s": round(warm_wall, 3),
+            "warm_hits": warm_hits,
+            "warm_hit_rate": round(warm_hits / lookups, 4) if lookups else 0.0,
+            "issues": issue_set(cold_issues),
+            "cold": cold_stats,
+            "warm": warm_stats,
+        }
+    finally:
+        configure(enabled=True, cache_dir=None)
+        if tmp is not None:
+            tmp.cleanup()
 
 
 # ---------------------------------------------------------------------------
@@ -847,6 +909,14 @@ def main() -> None:
     # machines where the TPU is autodetected but the env var is unset, pin it
     # so the measured configuration actually exercises the device hybrid
     import os
+
+    if "--query-cache-compare" in sys.argv:
+        # standalone warm-vs-cold mode: skip the full suite, emit one line
+        idx = sys.argv.index("--query-cache-compare")
+        operand = sys.argv[idx + 1] if len(sys.argv) > idx + 1 else None
+        cache_dir = None if operand is None or operand.startswith("-") else operand
+        print(json.dumps(query_cache_compare(cache_dir)), flush=True)
+        return
 
     # suite-internal budget clock (monotonic); the per-workload t0 stamps
     # stay time.time() because _ttfe/_rebase_stamp compare them against the

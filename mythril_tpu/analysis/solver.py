@@ -19,6 +19,7 @@ from mythril_tpu.core.transaction.transaction_models import (
     ContractCreationTransaction,
 )
 from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.smt import UGE, ULE, symbol_factory
 from mythril_tpu.smt.solver import Model
 from mythril_tpu.support.model import get_model
@@ -50,12 +51,20 @@ def get_transaction_sequence(
     tx_constraints, minimize = _set_minimisation_constraints(
         transaction_sequence, constraints.copy(), [], 5000, global_state.world_state
     )
-    model = get_model(
-        tx_constraints,
-        minimize=minimize,
-        session=session,
-        session_enable=session_enable,
-    )
+    # issue confirmation is one of the query cache's three entry points
+    # (ISSUE/querycache.rst): the solve below flows through the solver's
+    # cache hook; the span records how much of it the cache absorbed
+    from mythril_tpu.querycache import get_query_cache
+
+    qc_hits_before = get_query_cache().hits_total()
+    with _otrace.span("analysis.confirm_solve", cat="analysis") as sp:
+        model = get_model(
+            tx_constraints,
+            minimize=minimize,
+            session=session,
+            session_enable=session_enable,
+        )
+        sp.set(querycache_hits=get_query_cache().hits_total() - qc_hits_before)
 
     # keccak terms evaluate concretely under the model — no sha replacement
     # pass needed (reference needed _replace_with_actual_sha, solver.py:128-164)
@@ -94,7 +103,15 @@ def _get_concrete_transaction(model: Model, transaction: BaseTransaction) -> Dic
     value = hex(int(model.eval(transaction.call_value)))
     if isinstance(transaction, ContractCreationTransaction):
         address = ""
-        input_ = transaction.code.bytecode.hex()
+        # deployment input = creation bytecode || ABI-encoded constructor
+        # arguments: the constructor reads them from the tail of the init
+        # input, so a creation step without the model's calldata suffix is
+        # not replayable (reference solver.py:195-198 emits both; calldata
+        # size is minimized, so argument-less constructors append nothing)
+        input_ = (
+            transaction.code.bytecode.hex()
+            + bytes(transaction.call_data.concrete(model)).hex()
+        )
     else:
         address = f"0x{int(model.eval(transaction.callee_account.address)):040x}"
         input_ = bytes(transaction.call_data.concrete(model)).hex()

@@ -233,12 +233,19 @@ class ContractCreationTransaction(BaseTransaction):
                 # runtime code with SYMBOLIC bytes: solc >= 0.8 writes
                 # immutable values into PUSH operands of the returned code
                 # before RETURN, and a constructor-argument-derived
-                # immutable is symbolic.  Deploy with those operand bytes
-                # concretized to zero rather than dropping the deployment
-                # (the reference accepts symbolic entries into its
-                # disassembly the same way, transaction_models.py:249-253;
-                # the code STRUCTURE is unaffected — only immutable reads
-                # lose their symbolic identity)
+                # immutable is symbolic.  DELIBERATE DEVIATION from the
+                # reference (ROADMAP.md "Known deviations"): Mythril keeps
+                # such entries symbolic — its Disassembly accepts BitVec
+                # operand bytes (reference transaction_models.py:249-253),
+                # so message-call analysis can still constrain the
+                # immutable's value through the PUSHed symbol.  This build
+                # concretizes the symbolic operand bytes to ZERO and
+                # deploys.  The code STRUCTURE (opcodes, jump targets) is
+                # identical, but any issue whose trigger depends on the
+                # actual immutable value (e.g. an owner-address immutable
+                # gating a selfdestruct) can be missed or mis-confirmed —
+                # a recall risk accepted to keep deployed code fully
+                # concrete for the device frontier's packed code buffers.
                 return_data = bytes(
                     (b.value or 0) if hasattr(b, "value") else int(b)
                     for b in return_data

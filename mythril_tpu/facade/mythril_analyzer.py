@@ -46,6 +46,8 @@ class AnalyzerArgs:
     probe_backend: str = "auto"
     frontier: bool = False
     frontier_width: int = 64
+    query_cache: bool = True
+    query_cache_dir: Optional[str] = None
 
 
 class MythrilAnalyzer:
@@ -97,6 +99,13 @@ class MythrilAnalyzer:
                 )
         args.frontier = getattr(cmd_args, "frontier", False)
         args.frontier_width = getattr(cmd_args, "frontier_width", 64)
+        args.query_cache = getattr(cmd_args, "query_cache", True)
+        args.query_cache_dir = getattr(cmd_args, "query_cache_dir", None)
+        from mythril_tpu.querycache import configure as _configure_query_cache
+
+        _configure_query_cache(
+            enabled=args.query_cache, cache_dir=args.query_cache_dir
+        )
 
     def _sym_exec(self, contract, run_analysis_modules: bool = True) -> SymExecWrapper:
         from mythril_tpu.support.loader import DynLoader

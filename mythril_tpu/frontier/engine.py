@@ -865,7 +865,13 @@ class FrontierEngine:
             stats.segments += 1
             seg_only = time.perf_counter() - t_seg
             if micro and n_exec_host > 0:
+                t_mb = time.perf_counter()
                 self._run_microbench(segment, micro_args, n_exec_host, st)
+                # the microbench re-dispatches the segment 1+reps times by
+                # design; that wall time is measurement overhead, not
+                # exploration — compensate the execution deadline so a
+                # microbenched run keeps the budget it was configured with
+                deadline += time.perf_counter() - t_mb
             stats.segment_s += seg_only
             _get_metrics().observe("frontier.segment_wall_s", seg_only)
             _WARM_PROGRAMS.add(program_key)  # a segment really dispatched
@@ -1269,10 +1275,18 @@ class FrontierEngine:
             from mythril_tpu.plugins.plugins.mutation_pruner import (
                 MUTATION_PROBE_CONFIG,
             )
+            from mythril_tpu.querycache import get_query_cache
 
-            check_satisfiable_batch(
-                queries, ProbeConfig(**MUTATION_PROBE_CONFIG)
-            )
+            qc_hits = get_query_cache().hits_total()
+            with _otrace.span(
+                "frontier.mutation_prefetch", cat="frontier", n=len(queries)
+            ) as sp:
+                check_satisfiable_batch(
+                    queries, ProbeConfig(**MUTATION_PROBE_CONFIG)
+                )
+                sp.set(
+                    querycache_hits=get_query_cache().hits_total() - qc_hits
+                )
 
     def _prune_running(self, st: FrontierState, records, walker: Walker,
                        ev_seen: np.ndarray) -> None:
@@ -1302,7 +1316,18 @@ class FrontierEngine:
             todo.append((slot, rec, n_cons, raws))
         if not todo:
             return
-        flags = check_satisfiable_batch([raws for _, _, _, raws in todo])
+        # harvest feasibility is one of the query cache's three entry points
+        # (ISSUE/querycache.rst): the batched check below takes the cache's
+        # exact/core tiers per set inside _fast_path; the span records how
+        # many of this sweep's decisions the cache absorbed
+        from mythril_tpu.querycache import get_query_cache
+
+        qc_hits = get_query_cache().hits_total()
+        with _otrace.span(
+            "frontier.prune_check", cat="frontier", n=len(todo)
+        ) as sp:
+            flags = check_satisfiable_batch([raws for _, _, _, raws in todo])
+            sp.set(querycache_hits=get_query_cache().hits_total() - qc_hits)
         for (slot, rec, n_cons, _), ok in zip(todo, flags):
             if ok:
                 rec._pruned_at = n_cons

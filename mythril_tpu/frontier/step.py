@@ -1136,7 +1136,21 @@ def build_segment(caps: Caps):
         k = jnp.minimum(cfg.k_limit, caps.K)
         return (t < k) & running.any() & room
 
-    @jax.jit
+    # NO-INPUT-DONATION INVARIANT: this jit must never donate its inputs.
+    # engine._run_microbench re-dispatches the compiled segment on the SAME
+    # device buffers (micro_args are captured before the timed call and
+    # reused 1+reps times), and the engine re-pushes state across nested
+    # drains the same way; donate_argnums would let XLA alias those buffers
+    # into the outputs and the second dispatch would read garbage.  Kept as
+    # an explicit empty tuple + assert so a future "optimization" trips
+    # loudly instead of corrupting microbench numbers silently.
+    _SEGMENT_DONATE_ARGNUMS: tuple = ()
+    assert _SEGMENT_DONATE_ARGNUMS == (), (
+        "frontier segment must not donate inputs: _run_microbench and the "
+        "engine's re-dispatch paths reuse the pushed device buffers"
+    )
+
+    @partial(jax.jit, donate_argnums=_SEGMENT_DONATE_ARGNUMS)
     def segment(state: FrontierState, arena: ArenaDev, arena_len,
                 visited, code: CodeDev, cfg: CfgScalars):
         carry = (state, arena, jnp.asarray(arena_len, I32),

@@ -140,6 +140,19 @@ def _add_analysis_options(parser) -> None:
         help="device frontier batch width (paths held on device)",
     )
     group.add_argument(
+        "--query-cache-dir",
+        metavar="DIR",
+        help="persist solver verdicts in DIR and reuse them across runs "
+        "(exact-hit, model-reuse and unsat-core-subsumption tiers); safe "
+        "for concurrent corpus shards via atomic write-then-rename",
+    )
+    group.add_argument(
+        "--no-query-cache",
+        action="store_true",
+        help="disable the SMT query cache entirely (in-process LRU "
+        "included)",
+    )
+    group.add_argument(
         "--trace-out",
         metavar="FILE",
         help="enable span tracing and write a Chrome-trace/Perfetto JSON "
@@ -317,6 +330,8 @@ def _build_analyzer(parsed, query_signature: bool = False):
         probe_backend=getattr(parsed, "probe_backend", "auto"),
         frontier=getattr(parsed, "frontier", False),
         frontier_width=getattr(parsed, "frontier_width", 64),
+        query_cache=not getattr(parsed, "no_query_cache", False),
+        query_cache_dir=getattr(parsed, "query_cache_dir", None),
     )
     analyzer = MythrilAnalyzer(
         disassembler, cmd_args, strategy=parsed.strategy, address=address

@@ -45,10 +45,12 @@ def observability_meta() -> dict:
     # carries the full frontier.*/solver.* key set, even for runs where a
     # stage never executed (e.g. narrow workloads that bail off-device).
     from mythril_tpu.frontier.stats import FrontierStatistics
+    from mythril_tpu.querycache.cache import materialize_counters
     from mythril_tpu.smt.solver import SolverStatistics
 
     FrontierStatistics()._materialize()
     SolverStatistics()
+    materialize_counters()
     tracer = get_tracer()
     meta = {"metrics": get_registry().snapshot()}
     if tracer.enabled or len(tracer):

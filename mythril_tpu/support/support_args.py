@@ -65,6 +65,11 @@ class Args:
     # re-dispatch subtraction, tunnel-independent) into
     # FrontierStatistics().microbench — bench.py's device_microbench block
     frontier_microbench: bool = False
+    # persistent SMT query cache (mythril_tpu/querycache): the in-process
+    # LRU + reuse tiers run whenever query_cache is True; setting a dir
+    # adds the disk-backed cross-run/cross-shard store
+    query_cache: bool = True
+    query_cache_dir: Optional[str] = None
     # partition each symbolic tx's selector space into one seed per
     # function-table entry + a complement seed (core/transaction/symbolic.
     # seed_message_call): same state space, but the work list starts

@@ -1,0 +1,68 @@
+"""End-to-end query cache: a warm re-analysis against a shared disk store
+must serve from the cache (nonzero hit-rate) and produce the identical
+issue set — the acceptance criterion for cached-verdict soundness."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[2]))
+
+import bench  # noqa: E402
+from mythril_tpu.observability import get_registry, observability_meta  # noqa: E402
+from mythril_tpu.querycache import configure, get_query_cache, \
+    reset_query_cache  # noqa: E402
+
+
+def _issue_keys(issues):
+    return sorted((i.swc_id, i.address) for i in issues)
+
+
+def test_warm_run_hits_and_matches_cold_issue_set(tmp_path):
+    try:
+        configure(enabled=True, cache_dir=str(tmp_path))
+
+        get_registry().reset(prefix="querycache.")
+        _, cold_issues, _ = bench.run_analysis("host")
+        bench.check_recall(cold_issues)
+        cold_stats = get_query_cache().stats()
+        assert cold_stats["stores"] > 0, "cold run recorded nothing"
+
+        # run_analysis -> _clear_caches drops the in-process layer, so the
+        # warm run's exact hits can only come through the disk store
+        get_registry().reset(prefix="querycache.")
+        _, warm_issues, _ = bench.run_analysis("host")
+        bench.check_recall(warm_issues)
+
+        warm_hits = get_query_cache().hits_total()
+        warm_stats = get_query_cache().stats()
+        assert warm_hits > 0, f"warm run had zero cache hits: {warm_stats}"
+        assert warm_stats["disk_reads"] > 0, \
+            f"warm hits bypassed the disk store: {warm_stats}"
+        assert _issue_keys(cold_issues) == _issue_keys(warm_issues)
+
+        # the hit counters must surface in report meta via observability
+        meta = observability_meta()
+        assert meta["metrics"]["querycache.lookups"] > 0
+        assert sum(
+            meta["metrics"][k]
+            for k in (
+                "querycache.exact_hits",
+                "querycache.model_hits",
+                "querycache.core_hits",
+                "querycache.unknown_hits",
+            )
+        ) == warm_hits
+    finally:
+        configure(enabled=True, cache_dir=None)
+        reset_query_cache()
+
+
+def test_query_cache_compare_mode(tmp_path):
+    """bench.py --query-cache-compare: the machine-checkable warm-vs-cold
+    artifact (asserts internally; shape-checked here)."""
+    out = bench.query_cache_compare(str(tmp_path))
+    assert out["metric"] == "query_cache_compare"
+    assert out["warm_hits"] > 0
+    assert 0 < out["warm_hit_rate"] <= 1
+    assert out["issues"], "killbilly exploit missing from compare mode"
+    assert out["cold"]["stores"] > 0

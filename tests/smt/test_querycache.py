@@ -1,0 +1,401 @@
+"""Query cache unit tests: canonicalization, the three reuse tiers,
+UNKNOWN-budget semantics, and the concurrent disk store."""
+
+import json
+import threading
+
+import pytest
+
+from mythril_tpu.querycache import canon
+from mythril_tpu.querycache.cache import SAT, UNKNOWN, UNSAT, QueryCache
+from mythril_tpu.querycache.store import DiskStore
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.concrete_eval import Assignment, evaluate
+
+
+@pytest.fixture(autouse=True)
+def _clean_memos():
+    canon.clear_memos()
+    yield
+    canon.clear_memos()
+
+
+def _cache(**kw) -> QueryCache:
+    qc = QueryCache(**kw)
+    # isolate counters per test
+    from mythril_tpu.observability import get_registry
+
+    get_registry().reset(prefix="querycache.")
+    return qc
+
+
+def _gt(x, v):
+    return terms.ugt(x, terms.const(v, 256))
+
+
+def _lt(x, v):
+    return terms.ult(x, terms.const(v, 256))
+
+
+# ---------------------------------------------------------------------------
+# canonicalization
+# ---------------------------------------------------------------------------
+
+
+def test_renamed_queries_hash_equal():
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    p, q = terms.var("p_7", 256), terms.var("q_9", 256)
+    a = canon.fingerprint([_gt(x, 5), _lt(y, 3)])
+    b = canon.fingerprint([_gt(p, 5), _lt(q, 3)])
+    assert a.qhash == b.qhash
+
+
+def test_shared_variable_identity_breaks_equality():
+    # {x>5, x<3} (unsat) must NOT collide with {x>5, y<3} (sat)
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    shared = canon.fingerprint([_gt(x, 5), _lt(x, 3)])
+    split = canon.fingerprint([_gt(x, 5), _lt(y, 3)])
+    assert shared.qhash != split.qhash
+
+
+def test_conjunct_order_does_not_matter():
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    a = canon.fingerprint([_gt(x, 5), _lt(y, 3)])
+    b = canon.fingerprint([_lt(y, 3), _gt(x, 5)])
+    assert a.qhash == b.qhash
+
+
+def test_different_structure_differs():
+    x = terms.var("x", 256)
+    assert (
+        canon.fingerprint([_gt(x, 5)]).qhash
+        != canon.fingerprint([_lt(x, 5)]).qhash
+    )
+    assert (
+        canon.fingerprint([_gt(x, 5)]).qhash
+        != canon.fingerprint([_gt(x, 6)]).qhash
+    )
+
+
+def test_named_conjunct_hash_preserves_names():
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    fx = canon.conjunct_fingerprint(_gt(x, 5))
+    fy = canon.conjunct_fingerprint(_gt(y, 5))
+    assert fx[0] == fy[0]  # same shape
+    assert fx[2] != fy[2]  # different named digest
+
+
+# ---------------------------------------------------------------------------
+# exact-hit tier (incl. model rebuild onto renamed queries)
+# ---------------------------------------------------------------------------
+
+
+def test_exact_unsat_hit():
+    qc = _cache()
+    x = terms.var("x", 256)
+    query = [_gt(x, 5), _lt(x, 3)]
+    assert qc.lookup(query, budget_ms=1000) is None
+    qc.record(query, UNSAT)
+    out = qc.lookup(query, budget_ms=1000)
+    assert out == (UNSAT, None)
+    assert qc.stats()["exact_hits"] == 1
+
+
+def test_exact_sat_hit_rebuilds_model_onto_renamed_query():
+    qc = _cache()
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    query = [_gt(x, 5), _lt(y, 3)]
+    asg = Assignment({x: 6, y: 1}, {})
+    qc.record(query, SAT, asg)
+
+    a, b = terms.var("a_99", 256), terms.var("b_99", 256)
+    renamed = [_gt(a, 5), _lt(b, 3)]
+    out = qc.lookup(renamed, budget_ms=1000, probe_models=False)
+    assert out is not None and out[0] == SAT
+    model = out[1]
+    vals = evaluate(renamed, model)
+    assert all(vals[c] for c in renamed)
+    assert qc.stats()["exact_hits"] == 1
+
+
+def test_sat_entry_without_model_is_not_stored():
+    qc = _cache()
+    x = terms.var("x", 256)
+    qc.record([_gt(x, 5)], SAT, None)
+    assert qc.stats()["stores"] == 0
+    assert qc.lookup([_gt(x, 5)], budget_ms=1000, probe_models=False) is None
+
+
+def test_decided_verdict_never_downgraded():
+    qc = _cache()
+    x = terms.var("x", 256)
+    query = [_gt(x, 5), _lt(x, 3)]
+    qc.record(query, UNSAT)
+    qc.record(query, UNKNOWN, budget_ms=99999)
+    assert qc.lookup(query, budget_ms=1) == (UNSAT, None)
+
+
+# ---------------------------------------------------------------------------
+# unsat-core subsumption tier
+# ---------------------------------------------------------------------------
+
+
+def test_core_subsumes_superset_query():
+    qc = _cache()
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    qc.record([_gt(x, 5), _lt(x, 3)], UNSAT)
+    # superset (extra independent conjunct) is a different qhash, but the
+    # stored core {x>5, x<3} is a subset of its conjuncts
+    superset = [_gt(x, 5), _lt(x, 3), _gt(y, 100)]
+    out = qc.lookup(superset, budget_ms=1000)
+    assert out == (UNSAT, None)
+    assert qc.stats()["core_hits"] == 1
+
+
+def test_core_does_not_match_renamed_variables():
+    # the unsat core {x>5, x<3} must not refute {x>5, y<3}
+    qc = _cache()
+    x, y, z = terms.var("x", 256), terms.var("y", 256), terms.var("z", 256)
+    qc.record([_gt(x, 5), _lt(x, 3)], UNSAT)
+    sat_query = [_gt(x, 5), _lt(y, 3), _gt(z, 0)]
+    out = qc.lookup(sat_query, budget_ms=1000, probe_models=False)
+    assert out is None
+    assert qc.stats()["core_hits"] == 0
+
+
+def test_core_minimization_drops_irrelevant_conjuncts():
+    qc = _cache()
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    # y>7 is irrelevant to the contradiction; minimization should drop it,
+    # so the core then subsumes queries that never mention y
+    qc.record([_gt(y, 7), _gt(x, 5), _lt(x, 3)], UNSAT)
+    out = qc.lookup([_gt(x, 5), _lt(x, 3), _gt(x, 1)], budget_ms=1000)
+    assert out == (UNSAT, None)
+    assert qc.stats()["core_hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# model-reuse probing tier
+# ---------------------------------------------------------------------------
+
+
+def test_model_reuse_answers_different_query_with_shared_vars():
+    qc = _cache()
+    x = terms.var("x", 256)
+    qc.record([_gt(x, 5), _lt(x, 10)], SAT, Assignment({x: 7}, {}))
+    # structurally different query satisfied by the same model
+    other = [_gt(x, 6), _lt(x, 9)]
+    out = qc.lookup(other, budget_ms=1000)
+    assert out is not None and out[0] == SAT
+    vals = evaluate(other, out[1])
+    assert all(vals[c] for c in other)
+    assert qc.stats()["model_hits"] == 1
+
+
+def test_model_reuse_never_serves_unsatisfying_model():
+    qc = _cache()
+    x = terms.var("x", 256)
+    qc.record([_gt(x, 5), _lt(x, 10)], SAT, Assignment({x: 7}, {}))
+    out = qc.lookup([_gt(x, 100)], budget_ms=1000)
+    assert out is None  # x=7 does not satisfy; must fall through to miss
+
+
+def test_probe_models_flag_gates_the_tier():
+    qc = _cache()
+    x = terms.var("x", 256)
+    qc.record([_gt(x, 5), _lt(x, 10)], SAT, Assignment({x: 7}, {}))
+    assert qc.lookup([_gt(x, 6)], budget_ms=1000, probe_models=False) is None
+
+
+# ---------------------------------------------------------------------------
+# UNKNOWN budget semantics
+# ---------------------------------------------------------------------------
+
+
+def test_unknown_served_only_within_budget():
+    qc = _cache()
+    x = terms.var("x", 256)
+    query = [terms.eq(terms.mul(x, x), terms.const(17, 256))]
+    qc.record(query, UNKNOWN, budget_ms=1000)
+    assert qc.lookup(query, budget_ms=500) == (UNKNOWN, None)
+    assert qc.lookup(query, budget_ms=1000) == (UNKNOWN, None)
+    # a larger budget must retry the solve
+    assert qc.lookup(query, budget_ms=2000) is None
+    s = qc.stats()
+    assert s["unknown_hits"] == 2 and s["unknown_retries"] == 1
+
+
+def test_unknown_keeps_largest_budget():
+    qc = _cache()
+    x = terms.var("x", 256)
+    query = [terms.eq(terms.mul(x, x), terms.const(17, 256))]
+    qc.record(query, UNKNOWN, budget_ms=1000)
+    qc.record(query, UNKNOWN, budget_ms=3000)
+    qc.record(query, UNKNOWN, budget_ms=500)  # never shrinks
+    assert qc.lookup(query, budget_ms=3000) == (UNKNOWN, None)
+
+
+def test_unknown_without_request_budget_is_never_served():
+    qc = _cache()
+    x = terms.var("x", 256)
+    query = [terms.eq(terms.mul(x, x), terms.const(17, 256))]
+    qc.record(query, UNKNOWN, budget_ms=1000)
+    assert qc.lookup(query, budget_ms=None) is None
+
+
+# ---------------------------------------------------------------------------
+# disk store
+# ---------------------------------------------------------------------------
+
+
+def test_disk_round_trip_into_fresh_cache(tmp_path):
+    x = terms.var("x", 256)
+    unsat_q = [_gt(x, 5), _lt(x, 3)]
+    sat_q = [_gt(x, 5), _lt(x, 10)]
+
+    warmer = _cache()
+    warmer.configure(cache_dir=str(tmp_path))
+    warmer.record(unsat_q, UNSAT)
+    warmer.record(sat_q, SAT, Assignment({x: 7}, {}))
+    assert warmer.stats()["disk_writes"] == 2
+
+    fresh = _cache()
+    fresh.configure(cache_dir=str(tmp_path))
+    assert fresh.lookup(unsat_q, budget_ms=1000) == (UNSAT, None)
+    out = fresh.lookup(sat_q, budget_ms=1000, probe_models=False)
+    assert out is not None and out[0] == SAT
+    s = fresh.stats()
+    assert s["exact_hits"] == 2 and s["disk_reads"] == 2
+
+
+def test_disk_cores_reload_after_reset(tmp_path):
+    x, y = terms.var("x", 256), terms.var("y", 256)
+    qc = _cache()
+    qc.configure(cache_dir=str(tmp_path))
+    qc.record([_gt(x, 5), _lt(x, 3)], UNSAT)
+    qc.reset()  # drops memory; cores re-index from disk
+    out = qc.lookup([_gt(x, 5), _lt(x, 3), _gt(y, 0)], budget_ms=1000)
+    assert out == (UNSAT, None)
+    assert qc.stats()["core_hits"] == 1
+
+
+def test_two_concurrent_writers_leave_no_torn_files(tmp_path):
+    store_a = DiskStore(tmp_path)
+    store_b = DiskStore(tmp_path)
+    qhash = "ab" + "0" * 62
+    entry = {"verdict": "unsat"}
+    errors = []
+
+    def hammer(store):
+        try:
+            for _ in range(200):
+                assert store.write_entry(qhash, entry)
+                got = store.read_entry(qhash)
+                # readers may race the very first write, never see torn JSON
+                assert got is None or got == entry
+        except Exception as e:  # pragma: no cover - surfaced via errors
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(s,))
+               for s in (store_a, store_b)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert store_a.read_entry(qhash) == entry
+    # atomic rename cleaned up after itself
+    assert not list(tmp_path.rglob("*.tmp"))
+
+
+def test_corrupt_disk_entry_degrades_to_miss(tmp_path):
+    qc = _cache()
+    qc.configure(cache_dir=str(tmp_path))
+    x = terms.var("x", 256)
+    query = [_gt(x, 5), _lt(x, 3)]
+    qc.record(query, UNSAT)
+    fp = canon.fingerprint(query)
+    path = tmp_path / "entries" / fp.qhash[:2] / (fp.qhash + ".json")
+    path.write_text("{not json")
+
+    fresh = _cache()
+    fresh.configure(cache_dir=str(tmp_path))
+    # exact tier misses on the corrupt entry; the core (separate file)
+    # still proves unsat
+    out = fresh.lookup(query, budget_ms=1000)
+    assert out == (UNSAT, None)
+    assert fresh.stats()["core_hits"] == 1
+
+
+def test_unusable_cache_dir_disables_disk_layer(tmp_path):
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    qc = _cache()
+    qc.configure(cache_dir=str(blocker))  # not a directory
+    assert qc.stats()["disk"] is False
+    x = terms.var("x", 256)
+    qc.record([_gt(x, 5), _lt(x, 3)], UNSAT)  # memory layer still works
+    assert qc.lookup([_gt(x, 5), _lt(x, 3)], budget_ms=1) == (UNSAT, None)
+
+
+# ---------------------------------------------------------------------------
+# LRU bounds + misc
+# ---------------------------------------------------------------------------
+
+
+def test_entry_lru_eviction():
+    qc = _cache(max_entries=2)
+    x = terms.var("x", 256)
+    q1, q2, q3 = [_gt(x, 1), _lt(x, 0)], [_gt(x, 2), _lt(x, 0)], \
+        [_gt(x, 3), _lt(x, 0)]
+    for q in (q1, q2, q3):
+        qc.record(q, UNSAT)
+    assert qc.stats()["entries"] == 2
+
+
+def test_disabled_cache_is_inert():
+    qc = _cache()
+    qc.configure(enabled=False)
+    x = terms.var("x", 256)
+    qc.record([_gt(x, 5), _lt(x, 3)], UNSAT)
+    assert qc.lookup([_gt(x, 5), _lt(x, 3)], budget_ms=1) is None
+    assert qc.stats()["lookups"] == 0
+
+
+# ---------------------------------------------------------------------------
+# solver integration: warm solve served from cache
+# ---------------------------------------------------------------------------
+
+
+def test_solver_records_and_serves_from_disk(tmp_path):
+    from mythril_tpu.querycache import configure, get_query_cache, \
+        reset_query_cache
+    from mythril_tpu.smt.solver import ProbeConfig, solve_conjunction
+
+    x = terms.var("qc_solver_x", 256)
+    query = [_gt(x, 5), _lt(x, 10)]
+    try:
+        configure(enabled=True, cache_dir=str(tmp_path))
+        reset_query_cache()
+        from mythril_tpu.observability import get_registry
+
+        get_registry().reset(prefix="querycache.")
+        status, asg = solve_conjunction(query, ProbeConfig())
+        assert status == SAT
+        assert get_query_cache().stats()["stores"] >= 1
+
+        # fresh in-process cache: the warm answer must come via disk
+        reset_query_cache()
+        from mythril_tpu.smt.solver import clear_model_cache
+
+        clear_model_cache()
+        get_registry().reset(prefix="querycache.")
+        status2, asg2 = solve_conjunction(query, ProbeConfig())
+        assert status2 == SAT
+        vals = evaluate(query, asg2)
+        assert all(vals[c] for c in query)
+        s = get_query_cache().stats()
+        assert s["exact_hits"] >= 1 and s["disk_reads"] >= 1
+    finally:
+        configure(enabled=True, cache_dir=None)
+        reset_query_cache()
