@@ -230,6 +230,77 @@ def query_cache_compare(cache_dir=None) -> dict:
             tmp.cleanup()
 
 
+def staticpass_compare() -> dict:
+    """Static-pass on-vs-off comparison on the killbilly workload.
+
+    Runs the full-module analysis twice — once with the static pre-analysis
+    gate enabled, once with ``--no-staticpass`` semantics — and asserts the
+    over-approximation contract: the issue sets are IDENTICAL while the
+    gated run skipped a nonzero number of modules and elided a nonzero
+    number of hooks.  Returns (and ``main`` prints) one JSON-able dict with
+    both walls, both issue sets and the ``staticpass.*`` registry snapshot
+    of the gated run.
+    """
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.staticpass import clear_cache, reset_views
+    from mythril_tpu.support.support_args import args as global_args
+
+    def issue_set(issues):
+        return sorted((i.swc_id, i.address) for i in issues)
+
+    def one_run(enabled: bool):
+        global_args.staticpass = enabled
+        _clear_caches()
+        clear_cache()
+        reset_views()
+        get_registry().reset(prefix="staticpass.")
+        contract = EVMContract(
+            code=KILLBILLY, creation_code=KILLBILLY_CREATION, name="KillBilly"
+        )
+        t0 = time.time()
+        # all 14 modules: the gate needs irrelevant detectors to skip
+        _, issues = _analyze(contract, 0x0901D12E, 3, modules=None, timeout=300)
+        wall = time.time() - t0
+        snap = {
+            k: v
+            for k, v in get_registry().snapshot().items()
+            if k.startswith("staticpass.")
+        }
+        return issue_set(issues), wall, snap
+
+    prev = global_args.staticpass
+    try:
+        on_issues, on_wall, on_snap = one_run(True)
+        off_issues, off_wall, off_snap = one_run(False)
+    finally:
+        global_args.staticpass = prev
+
+    assert on_snap.get("staticpass.modules_skipped", 0) > 0, (
+        f"static pass skipped zero modules: {on_snap}"
+    )
+    assert on_snap.get("staticpass.hooks_elided", 0) > 0, (
+        f"static pass elided zero hooks: {on_snap}"
+    )
+    assert off_snap.get("staticpass.modules_skipped", 0) == 0, (
+        f"--no-staticpass run still gated modules: {off_snap}"
+    )
+    assert on_issues == off_issues, (
+        "static pass changed the issue set (over-approximation broken): "
+        f"{on_issues} != {off_issues}"
+    )
+    return {
+        "metric": "staticpass_compare",
+        "workload": "killbilly",
+        "on_wall_s": round(on_wall, 3),
+        "off_wall_s": round(off_wall, 3),
+        "modules_skipped": on_snap.get("staticpass.modules_skipped", 0),
+        "hooks_elided": on_snap.get("staticpass.hooks_elided", 0),
+        "issues": on_issues,
+        "staticpass": on_snap,
+    }
+
+
 # ---------------------------------------------------------------------------
 # workloads
 # ---------------------------------------------------------------------------
@@ -870,6 +941,7 @@ def _emit_snapshot(table: dict, budget_meta: dict, partial: bool) -> None:
     from mythril_tpu.frontier.stats import FrontierStatistics
 
     headline = table.get("corpus_sweep")
+    obs = _observability_snapshot()
     obj = {
         "metric": "corpus_sweep_states_per_sec",
         "value": headline["production"] if headline else None,
@@ -892,7 +964,12 @@ def _emit_snapshot(table: dict, budget_meta: dict, partial: bool) -> None:
         # machine-readable per-stage breakdown: the full metrics-registry
         # snapshot (frontier/solver counters plus the segment/harvest/
         # smt-solve wall-time histograms) accumulated over the sweep
-        "observability": _observability_snapshot(),
+        "observability": obs,
+        # the static pre-analysis counters broken out for quick grepping
+        # (they also appear inside the full observability snapshot)
+        "staticpass": {
+            k: v for k, v in obs.items() if k.startswith("staticpass.")
+        },
     }
     if partial:
         obj["partial"] = True
@@ -916,6 +993,11 @@ def main() -> None:
         operand = sys.argv[idx + 1] if len(sys.argv) > idx + 1 else None
         cache_dir = None if operand is None or operand.startswith("-") else operand
         print(json.dumps(query_cache_compare(cache_dir)), flush=True)
+        return
+
+    if "--staticpass-compare" in sys.argv:
+        # standalone on-vs-off mode: skip the full suite, emit one line
+        print(json.dumps(staticpass_compare()), flush=True)
         return
 
     # suite-internal budget clock (monotonic); the per-workload t0 stamps

@@ -58,6 +58,22 @@ class DetectionModule:
     # output, and carrier memory is rebuilt from the device word table at
     # terminals/parks instead of per-write replay.
     value_gated_hooks: frozenset = frozenset()
+    # -- static-pass gating declarations (mythril_tpu/staticpass/gate) ----
+    # Over-approximate CLAIMS about when the module can raise an issue;
+    # the static pre-analysis skips a module (and never registers its
+    # hooks) when a claim is statically refuted for a contract.  Declare
+    # conservatively: a wrong claim silently disables the detector.
+    #
+    # any-of occurrence: the module can only raise when at least one of
+    # these opcodes occurs on a statically reachable instruction.  None
+    # disables the gate (undeclared/custom modules are never skipped).
+    static_required_ops: Optional[frozenset] = None
+    # taint flow: the module only raises when a source opcode's value
+    # (carrying the mapped frontier/taint bit) may influence a sink
+    # opcode.  Skipped when no reachable source may_reach any sink.
+    # Both must be declared for the gate to apply.
+    static_taint_sources: Mapping[str, int] = MappingProxyType({})
+    static_taint_sinks: frozenset = frozenset()
 
     def __init__(self):
         self.issues: List[Issue] = []

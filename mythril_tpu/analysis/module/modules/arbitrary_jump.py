@@ -23,6 +23,8 @@ class ArbitraryJump(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMP", "JUMPI"]
+    # staticpass: issues come only from jump-target checks
+    static_required_ops = frozenset({"JUMP", "JUMPI"})
     # _analyze_state returns [] for a concrete jump destination; the device
     # executes only concrete-dest JUMPs (symbolic dests park to the host),
     # so device JUMP events exist purely for this hook and can be suppressed

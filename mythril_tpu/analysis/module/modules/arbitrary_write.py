@@ -25,6 +25,8 @@ class ArbitraryStorage(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SSTORE"]
+    # staticpass: a write-to-arbitrary-slot issue needs an SSTORE
+    static_required_ops = frozenset({"SSTORE"})
 
     def _execute(self, state: GlobalState) -> None:
         if self._cache_key(state) in self.cache:

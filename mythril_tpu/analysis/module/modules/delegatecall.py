@@ -23,6 +23,8 @@ class ArbitraryDelegateCall(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["DELEGATECALL"]
+    # staticpass: nothing to report without a DELEGATECALL
+    static_required_ops = frozenset({"DELEGATECALL"})
 
     def _execute(self, state: GlobalState) -> None:
         if self._cache_key(state) in self.cache:

@@ -42,6 +42,11 @@ class TxOrigin(DetectionModule):
     # reproduces it from the seeded taint bit on the origin env row, so
     # device-executed ORIGINs ship no event (frontier/taint.py)
     taint_source_hooks = {"ORIGIN": taint.TAINT_ORIGIN}
+    # staticpass: issues only exist where an ORIGIN value may influence a
+    # JUMPI condition
+    static_required_ops = frozenset({"ORIGIN"})
+    static_taint_sources = {"ORIGIN": taint.TAINT_ORIGIN}
+    static_taint_sinks = frozenset({"JUMPI"})
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
         if self._cache_key(state) in self.cache:

@@ -70,6 +70,11 @@ class PredictableVariables(DetectionModule):
     taint_source_hooks = {
         op: _TAINT_OPS[op][0] for op in PREDICTABLE_OPS
     }
+    # staticpass: issues only exist where a predictable value (BLOCKHASH
+    # included — its host hook annotates too) may influence a JUMPI
+    static_required_ops = frozenset(_TAINT_OPS)
+    static_taint_sources = {op: bit for op, (bit, _) in _TAINT_OPS.items()}
+    static_taint_sinks = frozenset({"JUMPI"})
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
         if self._cache_key(state) in self.cache:

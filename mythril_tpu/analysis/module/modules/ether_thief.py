@@ -33,6 +33,8 @@ class EtherThief(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
+    # staticpass: value exfiltration needs a CALL
+    static_required_ops = frozenset({"CALL"})
 
     def _execute(self, state: GlobalState) -> None:
         if self._cache_key(state) in self.cache:

@@ -50,6 +50,8 @@ class Exceptions(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["INVALID", "REVERT"]
+    # staticpass: assert-violation issues come only from these halts
+    static_required_ops = frozenset({"INVALID", "ASSERT_FAIL", "REVERT"})
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
         # solc >= 0.8 routes EVERY assert through one shared panic block,

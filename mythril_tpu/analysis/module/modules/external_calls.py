@@ -27,6 +27,8 @@ class ExternalCalls(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
+    # staticpass: external-call issues need a CALL
+    static_required_ops = frozenset({"CALL"})
 
     def _execute(self, state: GlobalState) -> None:
         if self._cache_key(state) in self.cache:

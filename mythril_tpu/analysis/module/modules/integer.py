@@ -88,6 +88,9 @@ class IntegerArithmetics(DetectionModule):
     # concrete (a.value/b.value not None) — the device suppresses those
     # events (solc code is dominated by concrete pointer arithmetic)
     concrete_nop_hooks = frozenset({"ADD", "MUL", "SUB", "EXP"})
+    # staticpass: the SSTORE/JUMPI/CALL/RETURN hooks only verify overflow
+    # annotations installed by the arithmetic hooks
+    static_required_ops = frozenset({"ADD", "MUL", "SUB", "EXP"})
 
     def _execute(self, state: GlobalState) -> None:
         opcode = state.get_current_instruction()["opcode"]

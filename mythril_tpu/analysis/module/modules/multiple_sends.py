@@ -34,6 +34,9 @@ class MultipleSends(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE", "RETURN", "STOP"]
+    # staticpass: the RETURN/STOP hooks only report sends recorded by the
+    # call hooks, so no call-family op means no possible issue
+    static_required_ops = frozenset({"CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"})
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:
         if self._cache_key(state) in self.cache:

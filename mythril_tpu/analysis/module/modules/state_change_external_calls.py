@@ -41,6 +41,8 @@ class StateChangeAfterCall(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = CALL_LIST + STATE_READ_WRITE_LIST
+    # staticpass: a state change AFTER a call needs one of the calls
+    static_required_ops = frozenset(CALL_LIST)
 
     def _execute(self, state: GlobalState) -> None:
         # NO cache short-circuit here: this module is STATEFUL — the

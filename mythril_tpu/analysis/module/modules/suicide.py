@@ -34,6 +34,8 @@ class AccidentallyKillable(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SELFDESTRUCT"]
+    # staticpass: nothing to report without a SELFDESTRUCT
+    static_required_ops = frozenset({"SELFDESTRUCT"})
 
     def __init__(self):
         super().__init__()

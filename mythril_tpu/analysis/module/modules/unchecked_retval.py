@@ -43,6 +43,9 @@ class UncheckedRetval(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["STOP", "RETURN"]
+    # staticpass: STOP/RETURN only check retvals recorded by the call
+    # post-hooks, so no call-family op means no possible issue
+    static_required_ops = frozenset({"CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"})
     post_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
 
     def _execute(self, state: GlobalState) -> Optional[List[Issue]]:

@@ -31,6 +31,8 @@ class UserAssertions(DetectionModule):
     description = DESCRIPTION
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["LOG1", "MSTORE"]
+    # staticpass: panic-MSTORE / assertion-LOG1 are the only triggers
+    static_required_ops = frozenset({"LOG1", "MSTORE"})
     # the MSTORE hook observes ONLY concrete values whose top 32 bits are
     # the Panic(uint256) selector (line 51; symbolic values no-op too):
     # the device may skip the event for every other store

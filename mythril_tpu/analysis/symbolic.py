@@ -147,8 +147,17 @@ class SymExecWrapper:
             ModuleLoader().load_custom_modules(custom_modules_directory)
 
         if run_analysis_modules:
+            # static pre-analysis gate: modules statically proven
+            # irrelevant for this contract never register their hooks
+            # (mythril_tpu/staticpass — over-approximate, so the issue
+            # set is unchanged; --no-staticpass restores blind wiring)
+            from mythril_tpu.staticpass import gate_view_for_contract
+
+            static_view = gate_view_for_contract(
+                contract, dynloader=dynloader, resume_from=self._resume_from
+            )
             analysis_modules = ModuleLoader().get_detection_modules(
-                EntryPoint.CALLBACK, white_list=modules
+                EntryPoint.CALLBACK, white_list=modules, static_view=static_view
             )
             self.laser.register_hooks(
                 hook_type="pre",

@@ -48,6 +48,7 @@ class AnalyzerArgs:
     frontier_width: int = 64
     query_cache: bool = True
     query_cache_dir: Optional[str] = None
+    staticpass: bool = True
 
 
 class MythrilAnalyzer:
@@ -101,6 +102,7 @@ class MythrilAnalyzer:
         args.frontier_width = getattr(cmd_args, "frontier_width", 64)
         args.query_cache = getattr(cmd_args, "query_cache", True)
         args.query_cache_dir = getattr(cmd_args, "query_cache_dir", None)
+        args.staticpass = getattr(cmd_args, "staticpass", True)
         from mythril_tpu.querycache import configure as _configure_query_cache
 
         _configure_query_cache(

@@ -80,6 +80,7 @@ class CodeTables:
         code_size: Optional[int] = None,
         conc_nop_opcodes: Optional[Iterable[str]] = None,
         value_gate_opcodes: Optional[Iterable[str]] = None,
+        static_summary=None,
     ):
         from mythril_tpu.support.opcodes import OPCODES
 
@@ -112,6 +113,24 @@ class CodeTables:
         self.loop_id = np.full(n + 1, -1, np.int32)
         n_loops = 0
 
+        # static pre-analysis (mythril_tpu/staticpass): statically
+        # unreachable instructions leave the packed event set (they can
+        # never execute, so no walker replay depends on them) and their
+        # JUMPDESTs claim no loop slot (the _LOOPS_CAP budget goes to
+        # code that can actually loop).  jumpmap keeps EVERY JUMPDEST —
+        # dynamic jump validity is the device's own check, not the
+        # pass's.  ``static_target`` exports statically resolved
+        # JUMP/JUMPI destinations (instruction index, -1 = dynamic) so
+        # device/host consumers can skip the jumpmap fallback path.
+        reach = None
+        if (
+            static_summary is not None
+            and static_summary.n_instructions == n
+        ):
+            reach = static_summary.instr_reachable
+        self.static_target = np.full(n + 1, -1, np.int32)
+        events_pruned = 0
+
         for i, ins in enumerate(instruction_list):
             name = ins.opcode
             self.opcode_names.append(name)
@@ -120,15 +139,29 @@ class CodeTables:
             if info is not None:
                 _, arity, _, g0, g1 = info
                 self.arity[i], self.gmin[i], self.gmax[i] = arity, g0, g1
-            self.event[i] = name in _ALWAYS_EVENT or name in hooked
+            reachable = reach is None or bool(reach[i])
+            event = name in _ALWAYS_EVENT or name in hooked
+            self.event[i] = event and reachable
+            if event and not reachable:
+                events_pruned += 1
             self.concskip[i] = name in conc_nop
             self.valgate[i] = name in val_gate
             fam, aux = self._classify(ins, arena, code_size)
             self.fam[i], self.aux[i] = fam, aux
             if name == "JUMPDEST":
                 self.jumpmap[ins.address] = i
-                self.loop_id[i] = n_loops
-                n_loops += 1
+                if reachable:
+                    self.loop_id[i] = n_loops
+                    n_loops += 1
+            if reach is not None and reachable:
+                self.static_target[i] = static_summary.static_target[i]
+
+        if events_pruned:
+            from mythril_tpu.observability import get_registry
+
+            get_registry().counter("staticpass.events_pruned").inc(
+                events_pruned
+            )
 
         # implicit STOP past the end of code (reference svm.py:281-284)
         self.fam[n] = O.F_STOP

@@ -397,8 +397,18 @@ class FrontierEngine:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _hook_info(laser) -> Tuple[set, set, set]:
+    def _hook_info(laser, summary=None) -> Tuple[set, set, set]:
         """(hooked, concrete-nop, value-gated) opcode sets for this laser.
+
+        When a static summary for the code being packed is supplied
+        (mythril_tpu/staticpass), two further elisions apply per code:
+        opcodes with no statically reachable instruction leave the hooked
+        set (their events could never fire), and an opcode whose EVERY
+        hook belongs to a module with a statically dead declared taint
+        flow is dropped too — safe because such modules only raise at
+        their declared sinks, and those sinks (JUMPI) are _ALWAYS_EVENT
+        ops whose events and host hook replays are unaffected by this
+        set.
 
         An opcode is concrete-nop when EVERY hook on it (pre and post) is a
         bound method of a module that declares it in ``concrete_nop_hooks``
@@ -460,7 +470,36 @@ class FrontierEngine:
                 for hook in reg.get(op, [])
             )
         }
-        return hooked - taint_src, conc_nop, val_gate
+        hooked = hooked - taint_src
+        if summary is not None:
+            from mythril_tpu.staticpass import GateView, module_relevant
+
+            view = GateView([summary])
+            dropped = {
+                op for op in hooked if op not in summary.reachable_opcodes
+            }
+            for op in hooked - dropped:
+                owners = {
+                    getattr(hook, "__self__", None)
+                    for reg in (laser._pre_hooks, laser._post_hooks)
+                    for hook in reg.get(op, [])
+                }
+                if owners and all(
+                    m is not None
+                    and getattr(m, "static_taint_sources", None)
+                    and getattr(m, "static_taint_sinks", None)
+                    and not module_relevant(m, view)
+                    for m in owners
+                ):
+                    dropped.add(op)
+            if dropped:
+                from mythril_tpu.observability import get_registry
+
+                get_registry().counter(
+                    "staticpass.hooks_elided_device"
+                ).inc(len(dropped))
+                hooked -= dropped
+        return hooked, conc_nop, val_gate
 
     def _seed_ctx(self, arena: HostArena, gs, seed_idx: int) -> np.ndarray:
         from mythril_tpu.smt import symbol_factory
@@ -641,7 +680,14 @@ class FrontierEngine:
             if ci is None:
                 ci = len(tables)
                 table_idx[key] = ci
-                hooked, conc_nop, val_gate = self._hook_info(laser)
+                # once-per-bytecode static pre-analysis (cached): prunes
+                # events on statically unreachable instructions and feeds
+                # the per-code hook elision below; None = pass disabled
+                # or failed, packing proceeds exactly as before
+                from mythril_tpu.staticpass import summary_for_code
+
+                summary = summary_for_code(code)
+                hooked, conc_nop, val_gate = self._hook_info(laser, summary)
                 tables.append(
                     CodeTables(
                         code.instruction_list,
@@ -651,6 +697,7 @@ class FrontierEngine:
                         or None,
                         conc_nop_opcodes=conc_nop,
                         value_gate_opcodes=val_gate,
+                        static_summary=summary,
                     )
                 )
                 table_laser.append(laser)

@@ -59,6 +59,20 @@ ENV_SOURCE_SLOTS = {
 }
 SEEDED_BITS = frozenset(ENV_SOURCE_SLOTS)
 
+# bit -> the opcode whose result carries it.  The static pre-analysis
+# (mythril_tpu/staticpass) keys its may_reach relation on this table:
+# a bit's flow starts at every reachable instruction of its source
+# opcode.  BLOCKHASH appears here even though it is not device-seeded
+# (it parks): static reachability covers host-installed annotations too.
+SOURCE_OPCODES = {
+    TAINT_ORIGIN: "ORIGIN",
+    TAINT_TIMESTAMP: "TIMESTAMP",
+    TAINT_NUMBER: "NUMBER",
+    TAINT_COINBASE: "COINBASE",
+    TAINT_GASLIMIT: "GASLIMIT",
+    TAINT_BLOCKHASH: "BLOCKHASH",
+}
+
 
 def suppressible(bit: int) -> bool:
     """True when dropping a source hook's device events is safe: the engine
@@ -76,9 +90,22 @@ _matchers: List[Tuple[int, Callable[[object], bool]]] = []
 
 def register(bit: int, factory: Callable[[], object],
              matcher: Callable[[object], bool]) -> None:
-    """Bind a taint bit to its annotation class (idempotent per bit)."""
+    """Bind a taint bit to its annotation class.
+
+    Idempotent for the SAME factory object (module re-imports).  A
+    different factory on an already-bound bit raises: two detectors
+    sharing one bit would synthesize the wrong annotation class at every
+    sink, and the static pass keys its reachability on these bits — the
+    invariant must be enforced, not assumed.
+    """
+    if bit <= 0 or (bit & (bit - 1)):
+        raise ValueError(f"taint bit must be a single set bit, got {bit:#x}")
     if bit in _factories:
-        return
+        if _factories[bit] is factory:
+            return
+        raise ValueError(
+            f"taint bit {bit:#x} already registered with a different factory"
+        )
     _factories[bit] = factory
     _matchers.append((bit, matcher))
 

@@ -153,6 +153,21 @@ def _add_analysis_options(parser) -> None:
         "included)",
     )
     group.add_argument(
+        "--no-staticpass",
+        action="store_true",
+        help="disable the static bytecode pre-analysis pass (CFG + abstract-"
+        "interpretation pruning of detector hooks and packed device events); "
+        "the issue set is identical either way, this only removes the "
+        "pruning",
+    )
+    group.add_argument(
+        "--staticpass-report",
+        metavar="FILE",
+        help="write the static pre-analysis summary (per-contract CFG "
+        "blocks/edges, unreachable spans, taint reachability, skipped "
+        "modules) to FILE as JSON after the run",
+    )
+    group.add_argument(
         "--trace-out",
         metavar="FILE",
         help="enable span tracing and write a Chrome-trace/Perfetto JSON "
@@ -332,6 +347,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         frontier_width=getattr(parsed, "frontier_width", 64),
         query_cache=not getattr(parsed, "no_query_cache", False),
         query_cache_dir=getattr(parsed, "query_cache_dir", None),
+        staticpass=not getattr(parsed, "no_staticpass", False),
     )
     analyzer = MythrilAnalyzer(
         disassembler, cmd_args, strategy=parsed.strategy, address=address
@@ -367,6 +383,12 @@ def _export_observability(parsed) -> None:
         with open(metrics_out, "w") as f:
             json.dump(observability_meta(), f, indent=2, sort_keys=True)
         log.info("wrote metrics snapshot to %s", metrics_out)
+    staticpass_report = getattr(parsed, "staticpass_report", None)
+    if staticpass_report:
+        from mythril_tpu.staticpass import export_report
+
+        export_report(staticpass_report)
+        log.info("wrote static pre-analysis report to %s", staticpass_report)
 
 
 def execute_command(parsed) -> None:

@@ -1,0 +1,51 @@
+"""Static bytecode pre-analysis (once per contract, before any execution).
+
+Three vectorized passes over the decoded instruction stream — the same
+flat tables ``frontier/code.py`` builds its device dispatch from:
+
+1. **CFG recovery** (:mod:`cfg`): basic blocks, static resolution of
+   PUSH-then-JUMP/JUMPI targets via a bounded abstract constant stack,
+   reachability from entry, unreachable-code spans.
+2. **Abstract stack height** (:mod:`stackheight`): per-block max-entry-
+   height fixpoint; a statically guaranteed underflow marks the rest of
+   the block (and its edges) dead.
+3. **Static taint reachability** (:mod:`taintflow`): per
+   ``frontier/taint.py`` source bit, the set of opcodes its value may
+   influence (``may_reach``), with global-channel escalation for flows
+   the CFG cannot order (storage, calls, creation returns).
+
+Everything is OVER-approximate: a may_reach miss or a reachable
+instruction marked dead is impossible by construction, so issue sets are
+identical with and without the pass (asserted in tests and by
+``bench.py --staticpass-compare``).  Consumers:
+
+* ``analysis/module/loader.py`` skips statically irrelevant detectors,
+* ``analysis/symbolic.py`` never registers their hooks (hooks elided),
+* ``frontier/engine.py`` / ``frontier/code.py`` clear event bits on
+  unreachable instructions, skip their loop slots, and export statically
+  resolved jump targets,
+* ``--staticpass-report`` dumps the CFG/taint summary as JSON, and the
+  ``staticpass.*`` counters flow through the observability registry into
+  report meta, ``--metrics-out`` and bench JSON.
+
+``--no-staticpass`` (args.staticpass = False) disables all of it.
+"""
+
+from mythril_tpu.staticpass.gate import (  # noqa: F401
+    GateView,
+    filter_modules,
+    gate_view_for_contract,
+    module_relevant,
+)
+from mythril_tpu.staticpass.report import (  # noqa: F401
+    export_report,
+    report_dict,
+    reset_views,
+)
+from mythril_tpu.staticpass.summary import (  # noqa: F401
+    StaticSummary,
+    clear_cache,
+    record_summary_metrics,
+    summarize,
+    summary_for_code,
+)

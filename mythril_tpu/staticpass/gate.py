@@ -1,0 +1,130 @@
+"""Detector gating: skip modules statically proven irrelevant.
+
+Two over-approximate gates, both declared by the module itself
+(analysis/module/base.py):
+
+* occurrence gate — ``static_required_ops``: the module can only raise an
+  issue when at least one of these opcodes occurs on a reachable
+  instruction.  None disables the gate (custom/undeclared modules are
+  never skipped).
+* taint gate — ``static_taint_sources``/``static_taint_sinks``: the
+  module only raises when a source's value influences a sink; skipped
+  when no reachable source bit may_reach any declared sink.
+
+The gate sees the contract's WHOLE static code set (creation + runtime)
+through a GateView: a bit escalated in one code (it hit a global channel,
+e.g. a constructor SSTORE) may reach sinks in every other code.  When any
+executable code is statically unknown — dynloader active, creation-only
+inputs, checkpoint resume — no view is built and nothing is skipped.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from mythril_tpu.staticpass.summary import (
+    StaticSummary,
+    record_summary_metrics,
+    summary_for_code,
+)
+
+log = logging.getLogger(__name__)
+
+
+class GateView:
+    """Union view over every code object a contract can execute."""
+
+    def __init__(self, summaries: List[StaticSummary], contract_name: str = "?"):
+        self.summaries = summaries
+        self.contract_name = contract_name
+        self.reachable_opcodes = frozenset().union(
+            *(s.reachable_opcodes for s in summaries)
+        ) if summaries else frozenset()
+        self.skipped_modules: List[str] = []
+
+    def taint_reach(self, bit: int) -> frozenset:
+        reached = frozenset().union(
+            *(s.taint_reach(bit) for s in self.summaries)
+        ) if self.summaries else frozenset()
+        if any(bit in s.escalated_bits for s in self.summaries):
+            # an escalated bit crosses code boundaries (storage persists
+            # between the constructor and every runtime tx)
+            reached |= self.reachable_opcodes
+        return reached
+
+
+def module_relevant(module, view: GateView) -> bool:
+    """Can ``module`` possibly raise an issue on this contract?"""
+    required = getattr(module, "static_required_ops", None)
+    if required is not None and not (view.reachable_opcodes & required):
+        return False
+    sources = getattr(module, "static_taint_sources", None)
+    sinks = getattr(module, "static_taint_sinks", None)
+    if sources and sinks:
+        return any(
+            src_op in view.reachable_opcodes and (view.taint_reach(bit) & sinks)
+            for src_op, bit in sources.items()
+        )
+    return True
+
+
+def filter_modules(modules: List, view: Optional[GateView]) -> Tuple[List, List]:
+    """(kept, skipped) — identity when no view is available."""
+    if view is None:
+        return modules, []
+    kept, skipped = [], []
+    for m in modules:
+        (kept if module_relevant(m, view) else skipped).append(m)
+    if skipped:
+        view.skipped_modules = sorted(type(m).__name__ for m in skipped)
+        log.info(
+            "static pass: skipping statically irrelevant modules for %s: %s",
+            view.contract_name, ", ".join(view.skipped_modules),
+        )
+    return kept, skipped
+
+
+def gate_view_for_contract(contract, dynloader=None,
+                           resume_from=None) -> Optional[GateView]:
+    """Build the gating view for one contract, or None when the full
+    executable code set is not statically known (then nothing is gated)."""
+    from mythril_tpu.support.support_args import args
+
+    if not getattr(args, "staticpass", True):
+        return None
+    if resume_from:
+        return None  # restored states may sit mid-flow past a gate point
+    if dynloader is not None and getattr(dynloader, "active", False):
+        return None  # on-chain code loading: other bytecode can run
+    try:
+        summaries: List[StaticSummary] = []
+        if isinstance(contract, (bytes, bytearray)):
+            from mythril_tpu.frontend.disassembler import Disassembly
+
+            summaries.append(summary_for_code(Disassembly(bytes(contract))))
+        else:
+            runtime = getattr(contract, "disassembly", None)
+            creation = getattr(contract, "creation_disassembly", None)
+            if creation is not None and runtime is None:
+                # creation-only input: the deployed runtime code is the
+                # creation tx's return value, not statically available
+                return None
+            if runtime is not None:
+                summaries.append(summary_for_code(runtime))
+            if creation is not None:
+                summaries.append(summary_for_code(creation, is_creation=True))
+        if not summaries or any(s is None for s in summaries):
+            return None
+        for s in summaries:
+            record_summary_metrics(s)
+        view = GateView(
+            summaries, contract_name=getattr(contract, "name", "Unknown")
+        )
+        from mythril_tpu.staticpass import report as sp_report
+
+        sp_report.record_view(view)
+        return view
+    except Exception as e:  # never fatal: analysis continues ungated
+        log.warning("static gate unavailable for this contract: %s", e)
+        return None

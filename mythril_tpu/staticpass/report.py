@@ -1,0 +1,97 @@
+"""JSON export of the static pass (--staticpass-report).
+
+Blocks and edges are serialized through the same ``core/cfg.py``
+Node/Edge structures the dynamic engine uses, so downstream tooling
+consumes one CFG schema for both.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from mythril_tpu.core.cfg import Edge, JumpType, Node
+from mythril_tpu.staticpass.summary import StaticSummary
+
+# unresolved-jump fans (edges to every JUMPDEST) can be quadratic; the
+# JSON export caps them and says so rather than ballooning the artifact
+_MAX_EDGES = 4096
+
+_EDGE_TYPE = {
+    "jump": JumpType.UNCONDITIONAL,
+    "fall": JumpType.CONDITIONAL,
+    "dyn": JumpType.UNCONDITIONAL,
+}
+
+_VIEWS: List = []  # GateView per analyzed contract, in analysis order
+
+
+def record_view(view) -> None:
+    _VIEWS.append(view)
+
+
+def reset_views() -> None:
+    del _VIEWS[:]
+
+
+def summary_to_dict(summary: StaticSummary) -> dict:
+    from mythril_tpu.frontier import taint
+
+    nodes = []
+    for b in range(summary.n_blocks):
+        node = Node(
+            contract_name="static",
+            start_addr=int(summary.block_addrs[b]),
+            function_name=f"block_{b}",
+        )
+        d = node.get_dict()
+        d["reachable"] = bool(summary.instr_reachable[summary.block_starts[b]])
+        nodes.append(d)
+    edges = []
+    for frm, to, kind in summary.edges[:_MAX_EDGES]:
+        e = Edge(frm, to, edge_type=_EDGE_TYPE.get(kind, JumpType.UNCONDITIONAL))
+        d = e.as_dict()
+        d["kind"] = kind
+        edges.append(d)
+    bit_names = {bit: name for bit, name in taint.SOURCE_OPCODES.items()}
+    return {
+        "is_creation": summary.is_creation,
+        "code_size": summary.code_size,
+        "instructions": summary.n_instructions,
+        "blocks": summary.n_blocks,
+        "reachable_blocks": summary.n_reachable_blocks,
+        "jumps_resolved": summary.n_resolved_jumps,
+        "underflow_blocks": summary.underflow_blocks,
+        "unreachable_bytes": summary.unreachable_bytes,
+        "unreachable_spans": [list(s) for s in summary.unreachable_spans],
+        "nodes": nodes,
+        "edges": edges,
+        "edges_truncated": len(summary.edges) > _MAX_EDGES,
+        "may_reach": {
+            f"{bit_names.get(bit, bit)}": sorted(ops)
+            for bit, ops in sorted(summary.may_reach.items())
+        },
+        "escalated_sources": sorted(
+            bit_names.get(bit, str(bit)) for bit in summary.escalated_bits
+        ),
+        "wall_s": round(summary.wall_s, 6),
+    }
+
+
+def report_dict() -> dict:
+    """Everything recorded since process start, one entry per contract."""
+    return {
+        "contracts": [
+            {
+                "name": view.contract_name,
+                "modules_skipped": view.skipped_modules,
+                "codes": [summary_to_dict(s) for s in view.summaries],
+            }
+            for view in _VIEWS
+        ]
+    }
+
+
+def export_report(path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(report_dict(), f, indent=2, sort_keys=True)

@@ -1,0 +1,184 @@
+"""StaticSummary: one immutable result object per analyzed bytecode.
+
+``summarize`` runs the three passes (CFG recovery, abstract stack height,
+taint reachability) once over a decoded instruction stream;
+``summary_for_code`` adds a process-wide cache keyed by bytecode hash so
+the frontier engine, the detector gate and the CLI report all share one
+computation per contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mythril_tpu.staticpass.cfg import StaticCFG
+from mythril_tpu.staticpass.stackheight import underflow_points
+from mythril_tpu.staticpass.taintflow import may_reach
+
+log = logging.getLogger(__name__)
+
+_CACHE: Dict[tuple, "StaticSummary"] = {}
+_CACHE_CAP = 512
+
+
+@dataclass(frozen=True)
+class StaticSummary:
+    n_instructions: int
+    code_size: int
+    n_blocks: int
+    n_reachable_blocks: int
+    block_starts: np.ndarray  # instr idx per block
+    block_addrs: np.ndarray  # byte addr per block
+    edges: List[Tuple[int, int, str]]  # (from_block, to_block, kind)
+    instr_reachable: np.ndarray  # bool [n]
+    reachable_opcodes: frozenset
+    static_target: np.ndarray  # int32 [n]: resolved jump dest instr or -1
+    n_resolved_jumps: int
+    underflow_blocks: int
+    unreachable_spans: List[Tuple[int, int]]  # [start_addr, end_addr) bytes
+    unreachable_bytes: int
+    may_reach: Dict[int, frozenset] = field(default_factory=dict)
+    escalated_bits: frozenset = frozenset()
+    is_creation: bool = False
+    wall_s: float = 0.0
+
+    def taint_reach(self, bit: int) -> frozenset:
+        return self.may_reach.get(bit, frozenset())
+
+
+def summarize(instruction_list: List, code_size: int = 0,
+              is_creation: bool = False) -> StaticSummary:
+    """Run the full static pass over one decoded instruction stream."""
+    from mythril_tpu.frontier import taint
+    from mythril_tpu.staticpass.tables import InstrTables
+
+    t0 = time.perf_counter()
+    tables = InstrTables(instruction_list)
+    cfg = StaticCFG(tables)
+    under = underflow_points(cfg)
+    halting = under >= 0
+    block_reach = cfg.reachable_blocks(halting=halting)
+
+    n = tables.n
+    instr_reach = np.zeros(n, bool)
+    for b in np.flatnonzero(block_reach):
+        s, e = int(cfg.block_start[b]), int(cfg.block_end[b])
+        if halting[b]:
+            # the underflowing instruction itself executes (and halts);
+            # everything after it in the block is dead
+            instr_reach[s: int(under[b]) + 1] = True
+        else:
+            instr_reach[s:e] = True
+
+    spans: List[Tuple[int, int]] = []
+    unreachable_bytes = 0
+    dead = np.flatnonzero(~instr_reach)
+    if len(dead):
+        unreachable_bytes = int(tables.width[dead].sum())
+        run_start = dead[0]
+        prev = dead[0]
+        for i in dead[1:]:
+            if i != prev + 1:
+                spans.append(_span(tables, run_start, prev))
+                run_start = i
+            prev = i
+        spans.append(_span(tables, run_start, prev))
+
+    reach_ops = frozenset(tables.names[i] for i in np.flatnonzero(instr_reach))
+    flows, escalated = may_reach(
+        cfg, block_reach, instr_reach, halting,
+        taint.SOURCE_OPCODES, is_creation=is_creation,
+    )
+    # resolved targets on unreachable jumps are meaningless downstream
+    static_target = np.where(instr_reach, cfg.static_target, -1).astype(np.int32)
+
+    return StaticSummary(
+        n_instructions=n,
+        code_size=code_size or (int(tables.addr[-1] + tables.width[-1]) if n else 0),
+        n_blocks=cfg.n_blocks,
+        n_reachable_blocks=int(block_reach.sum()),
+        block_starts=cfg.block_start,
+        block_addrs=tables.addr[cfg.block_start] if cfg.n_blocks else np.zeros(0, np.int32),
+        edges=cfg.edge_list(),
+        instr_reachable=instr_reach,
+        reachable_opcodes=reach_ops,
+        static_target=static_target,
+        n_resolved_jumps=cfg.n_resolved,
+        underflow_blocks=int((halting & block_reach).sum()),
+        unreachable_spans=spans,
+        unreachable_bytes=unreachable_bytes,
+        may_reach=flows,
+        escalated_bits=escalated,
+        is_creation=is_creation,
+        wall_s=time.perf_counter() - t0,
+    )
+
+
+def _span(tables, first: int, last: int) -> Tuple[int, int]:
+    return (int(tables.addr[first]),
+            int(tables.addr[last] + tables.width[last]))
+
+
+def summary_for_code(code, is_creation: bool = False) -> Optional[StaticSummary]:
+    """Cached summary for a Disassembly-like object (``.bytecode`` bytes +
+    ``.instruction_list``).  Returns None when the pass is disabled or
+    fails — every consumer treats None as "no static information"."""
+    from mythril_tpu.support.support_args import args
+
+    if not getattr(args, "staticpass", True):
+        return None
+    try:
+        bytecode = getattr(code, "bytecode", None) or b""
+        if isinstance(bytecode, str):
+            bytecode = bytes.fromhex(
+                bytecode[2:] if bytecode.startswith("0x") else bytecode
+            )
+        instruction_list = code.instruction_list
+        key = (
+            hashlib.sha1(bytecode).hexdigest(),
+            len(instruction_list),
+            is_creation,
+        )
+        hit = _CACHE.get(key)
+        if hit is not None:
+            _count("staticpass.cache_hits")
+            return hit
+        _count("staticpass.cache_misses")
+        summary = summarize(
+            instruction_list, code_size=len(bytecode), is_creation=is_creation
+        )
+        if len(_CACHE) >= _CACHE_CAP:
+            _CACHE.clear()
+        _CACHE[key] = summary
+        return summary
+    except Exception as e:  # over-approximation escape hatch: never fatal
+        log.warning("static pass failed (analysis continues without it): %s", e)
+        return None
+
+
+def _count(name: str, n: int = 1) -> None:
+    from mythril_tpu.observability import get_registry
+
+    get_registry().counter(name).inc(n)
+
+
+def record_summary_metrics(summary: StaticSummary) -> None:
+    """Publish one summary's counters (report meta / --metrics-out)."""
+    _count("staticpass.contracts")
+    _count("staticpass.blocks", summary.n_blocks)
+    _count("staticpass.unreachable_bytes", summary.unreachable_bytes)
+    _count("staticpass.jumps_resolved", summary.n_resolved_jumps)
+    _count("staticpass.underflow_blocks", summary.underflow_blocks)
+    from mythril_tpu.observability import get_registry
+
+    get_registry().counter("staticpass.wall_time_s").inc(round(summary.wall_s, 6))
+
+
+def clear_cache() -> None:
+    _CACHE.clear()

@@ -75,6 +75,11 @@ class Args:
     # seed_message_call): same state space, but the work list starts
     # |selectors|+1 wide so the device frontier gets width up front
     multi_selector_seeding: bool = False
+    # static bytecode pre-analysis (mythril_tpu/staticpass): CFG recovery +
+    # abstract stack-height + taint reachability, gating detector hooks and
+    # packed device events.  Over-approximate — the issue set is identical
+    # either way; --no-staticpass is the escape hatch
+    staticpass: bool = True
 
 
 args = Args()

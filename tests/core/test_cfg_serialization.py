@@ -1,0 +1,67 @@
+"""Node/Edge dict serialization and JumpType kinds (core/cfg.py)."""
+
+from mythril_tpu.core.cfg import Edge, JumpType, Node, NodeFlags
+
+
+def test_node_get_dict_round_trips_fields():
+    node = Node("Token", start_addr=0x42, function_name="transfer")
+    node.flags = NodeFlags.FUNC_ENTRY
+    node.states = [object(), object()]
+    d = node.get_dict()
+    assert d == {
+        "contract_name": "Token",
+        "start_addr": 0x42,
+        "function_name": "transfer",
+        "uid": node.uid,
+        "flags": NodeFlags.FUNC_ENTRY,
+        "num_states": 2,
+    }
+
+
+def test_node_uids_are_unique_and_increasing():
+    a, b = Node("A"), Node("B")
+    assert b.uid == a.uid + 1
+    assert a.get_dict()["uid"] != b.get_dict()["uid"]
+
+
+def test_node_defaults():
+    node = Node("C")
+    d = node.get_dict()
+    assert d["start_addr"] == 0
+    assert d["function_name"] == "unknown"
+    assert d["flags"] == 0
+    assert d["num_states"] == 0
+    assert node.constraints is not None
+
+
+def test_edge_as_dict_uses_type_name():
+    edge = Edge(3, 7, JumpType.CONDITIONAL)
+    assert edge.as_dict() == {"from": 3, "to": 7, "type": "CONDITIONAL"}
+
+
+def test_edge_default_type_is_unconditional():
+    edge = Edge(1, 2)
+    assert edge.type is JumpType.UNCONDITIONAL
+    assert edge.as_dict()["type"] == "UNCONDITIONAL"
+    assert edge.condition is None
+
+
+def test_jump_type_kinds_are_stable():
+    # the statespace JSON exporter and the staticpass report both key on
+    # these names; renaming one is a format break
+    assert {t.name for t in JumpType} == {
+        "CONDITIONAL",
+        "UNCONDITIONAL",
+        "CALL",
+        "RETURN",
+        "Transaction",
+    }
+    assert JumpType.CONDITIONAL.value == 1
+    assert JumpType.Transaction.value == 5
+
+
+def test_repr_is_informative():
+    node = Node("X", start_addr=9, function_name="f")
+    assert "f@9" in repr(node)
+    edge = Edge(0, 1, JumpType.CALL)
+    assert "0 -> 1" in repr(edge) and "CALL" in repr(edge)

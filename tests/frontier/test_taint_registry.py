@@ -1,0 +1,81 @@
+"""Taint-bit registry self-checks (frontier/taint.py register())."""
+
+import pytest
+
+from mythril_tpu.frontier import taint
+
+
+class _AnnoA:
+    pass
+
+
+class _AnnoB:
+    pass
+
+
+@pytest.fixture
+def _scratch_registry():
+    """Run against a copy so the process-global registry (already populated
+    by detector imports) is untouched."""
+    saved_f = dict(taint._factories)
+    saved_m = list(taint._matchers)
+    saved_s = dict(taint._singletons)
+    yield
+    taint._factories.clear()
+    taint._factories.update(saved_f)
+    taint._matchers[:] = saved_m
+    taint._singletons.clear()
+    taint._singletons.update(saved_s)
+
+
+def test_register_rejects_non_single_bit(_scratch_registry):
+    for bad in (0, -1, 3, 6, 1 << 8 | 1):
+        with pytest.raises(ValueError, match="single set bit"):
+            taint.register(bad, _AnnoA, lambda a: False)
+
+
+def test_register_same_factory_is_idempotent(_scratch_registry):
+    bit = 1 << 20
+    taint.register(bit, _AnnoA, lambda a: isinstance(a, _AnnoA))
+    taint.register(bit, _AnnoA, lambda a: isinstance(a, _AnnoA))  # no raise
+    assert taint._factories[bit] is _AnnoA
+    # the matcher list must not grow on the no-op re-registration
+    assert sum(1 for b, _ in taint._matchers if b == bit) == 1
+
+
+def test_register_different_factory_raises(_scratch_registry):
+    bit = 1 << 21
+    taint.register(bit, _AnnoA, lambda a: isinstance(a, _AnnoA))
+    with pytest.raises(ValueError, match="different factory"):
+        taint.register(bit, _AnnoB, lambda a: isinstance(a, _AnnoB))
+
+
+def test_unknown_bit_synthesizes_nothing(_scratch_registry):
+    # seeding an unregistered bit is harmless: the walker synthesizes no
+    # annotation for it (module disabled -> its bit is inert)
+    unknown = 1 << 22
+    assert taint.annotations_for_mask(unknown) == ()
+    assert taint.annotations_for_mask(0) == ()
+
+
+def test_registered_bit_synthesizes_singleton(_scratch_registry):
+    bit = 1 << 23
+    taint.register(bit, _AnnoA, lambda a: isinstance(a, _AnnoA))
+    (first,) = taint.annotations_for_mask(bit)
+    (second,) = taint.annotations_for_mask(bit)
+    assert isinstance(first, _AnnoA)
+    assert first is second  # singleton, never re-instantiated
+
+
+def test_mask_for_annotations_round_trip(_scratch_registry):
+    bit = 1 << 24
+    taint.register(bit, _AnnoA, lambda a: isinstance(a, _AnnoA))
+    assert taint.mask_for_annotations([_AnnoA()]) == bit
+    assert taint.mask_for_annotations([_AnnoB()]) == 0
+
+
+def test_source_opcodes_cover_all_seeded_bits():
+    # the static pass keys may_reach on SOURCE_OPCODES: every seedable bit
+    # must have a source opcode or its flows would be invisible to the gate
+    for bit in taint.SEEDED_BITS:
+        assert bit in taint.SOURCE_OPCODES

@@ -1,0 +1,105 @@
+"""CFG recovery: blocks, static jump resolution, reachability, dead spans."""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.staticpass.cfg import E_DYN, E_FALL, E_JUMP, StaticCFG
+from mythril_tpu.staticpass.summary import summarize
+from mythril_tpu.staticpass.tables import InstrTables
+
+
+def _cfg(hexcode: str) -> StaticCFG:
+    return StaticCFG(InstrTables(Disassembly(bytes.fromhex(hexcode)).instruction_list))
+
+
+def _summary(hexcode: str):
+    code = bytes.fromhex(hexcode)
+    return summarize(Disassembly(code).instruction_list, code_size=len(code))
+
+
+def test_single_block_no_edges():
+    # PUSH1 0; PUSH1 0; REVERT
+    cfg = _cfg("60006000fd")
+    assert cfg.n_blocks == 1
+    assert cfg.edge_list() == []
+
+
+def test_resolved_jump_and_dead_pad():
+    # PUSH1 4; JUMP; INVALID; JUMPDEST; STOP
+    cfg = _cfg("600456fe5b00")
+    assert cfg.n_blocks == 3  # [PUSH,JUMP] [INVALID] [JUMPDEST,STOP]
+    assert cfg.n_resolved == 1
+    assert (0, 2, E_JUMP) in cfg.edge_list()
+    # the INVALID pad gets no incoming edge
+    assert not any(to == 1 for _, to, _k in cfg.edge_list())
+    reach = cfg.reachable_blocks()
+    assert list(reach) == [True, False, True]
+
+
+def test_unreachable_span_bytes():
+    s = _summary("600456fe5b00")
+    assert s.n_resolved_jumps == 1
+    assert s.unreachable_bytes == 1  # just the INVALID pad byte
+    assert s.unreachable_spans == [(3, 4)]
+    # static_target exported per instruction: the JUMP (index 1) resolves
+    # to the JUMPDEST's instruction index (3)
+    assert s.static_target[1] == 3
+
+
+def test_unresolved_jump_overapproximates_to_all_jumpdests():
+    # PUSH1 0; CALLDATALOAD; JUMP; JUMPDEST; STOP; JUMPDEST; STOP
+    cfg = _cfg("60003556" + "5b00" + "5b00")
+    dyn = [(b, to) for b, to, k in cfg.edge_list() if k == E_DYN]
+    # both JUMPDEST blocks receive a dyn edge from the jump block
+    assert sorted(to for _, to in dyn) == sorted(cfg.jumpdest_blocks)
+    assert cfg.n_resolved == 0
+    assert cfg.reachable_blocks().all()
+
+
+def test_resolved_invalid_target_halts():
+    # PUSH1 3; JUMP; STOP  -- target 3 is STOP, not a JUMPDEST: the VM
+    # halts at the jump, so nothing downstream is reachable
+    cfg = _cfg("60035600")
+    assert cfg.edge_list() == []
+    assert list(cfg.reachable_blocks()) == [True, False]
+
+
+def test_jumpi_keeps_fallthrough():
+    # PUSH1 1; PUSH1 7; JUMPI; STOP; INVALID; JUMPDEST(7); STOP
+    cfg = _cfg("6001600757" + "00" + "fe" + "5b00")
+    kinds = {(b, to): k for b, to, k in cfg.edge_list()}
+    jumpi_block = 0
+    assert kinds[(jumpi_block, 3)] == E_JUMP  # JUMPDEST block
+    assert kinds[(jumpi_block, 1)] == E_FALL  # STOP block
+    reach = cfg.reachable_blocks()
+    assert reach[1] and reach[3] and not reach[2]
+
+
+def test_constant_folding_resolves_computed_target():
+    # PUSH1 2; PUSH1 4; ADD; JUMP; JUMPDEST; STOP  -- target = 2 + 4 = 6
+    cfg = _cfg("600260040156" + "5b00")
+    assert cfg.n_resolved == 1
+    assert (0, 1, E_JUMP) in cfg.edge_list()
+
+
+def test_implicit_trailing_stop_is_a_block():
+    # code falling off the end: disassembler appends nothing, but the
+    # final PUSH block simply has no successor beyond the last instr
+    cfg = _cfg("6000")
+    assert cfg.n_blocks == 1
+    assert cfg.edge_list() == []
+
+
+def test_summary_is_deterministic():
+    a = _summary("600456fe5b00")
+    b = _summary("600456fe5b00")
+    assert np.array_equal(a.instr_reachable, b.instr_reachable)
+    assert a.reachable_opcodes == b.reachable_opcodes
+    assert a.edges == b.edges
+
+
+@pytest.mark.parametrize("hexcode", ["", "00", "5b", "fe"])
+def test_degenerate_codes_do_not_crash(hexcode):
+    s = _summary(hexcode)
+    assert s.n_blocks in (0, 1)

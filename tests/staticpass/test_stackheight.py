@@ -1,0 +1,55 @@
+"""Abstract stack-height analysis: statically guaranteed underflows."""
+
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.staticpass.cfg import StaticCFG
+from mythril_tpu.staticpass.stackheight import underflow_points
+from mythril_tpu.staticpass.summary import summarize
+from mythril_tpu.staticpass.tables import InstrTables
+
+
+def _under(hexcode: str):
+    cfg = StaticCFG(InstrTables(Disassembly(bytes.fromhex(hexcode)).instruction_list))
+    return cfg, underflow_points(cfg)
+
+
+def test_pop_on_empty_stack_underflows():
+    # POP; STOP -- a fresh frame starts with an empty stack
+    cfg, under = _under("5000")
+    assert under[0] == 0  # the POP itself
+
+
+def test_balanced_block_is_clean():
+    # PUSH1 0; POP; STOP
+    _, under = _under("60005000")
+    assert list(under) == [-1]
+
+
+def test_max_entry_height_is_the_join():
+    # two paths into one JUMPDEST with different heights: the deeper one
+    # (1 item) must win or the shared ADD would be declared an underflow
+    # PUSH1 1; PUSH1 7; JUMPI; PUSH1 5; JUMPDEST(7); PUSH1 2; ADD; STOP
+    # false path pushes an extra item before reaching the JUMPDEST
+    hexcode = "6001600757" + "6005" + "5b" + "600201" + "00"
+    cfg, under = _under(hexcode)
+    # the JUMPI path enters the JUMPDEST block with height 0, the fall
+    # path with height 1; ADD needs 2 and only PUSH1 2 precedes it, so
+    # max height 1 + 1 = 2 suffices -> no guaranteed underflow
+    jd_block = cfg.jumpdest_blocks[0]
+    assert under[jd_block] == -1
+
+
+def test_guaranteed_underflow_on_every_path():
+    # JUMPDEST; ADD; STOP reached only with an empty stack
+    # PUSH1 3; JUMP; JUMPDEST(3); ADD; STOP
+    cfg, under = _under("600356" + "5b0100")
+    jd_block = cfg.jumpdest_blocks[0]
+    assert under[jd_block] == int(cfg.block_start[jd_block]) + 1  # the ADD
+
+
+def test_underflow_truncates_instr_reachability():
+    code = bytes.fromhex("5000")  # POP; STOP
+    s = summarize(Disassembly(code).instruction_list, code_size=len(code))
+    # the POP executes (and halts); the STOP after it never runs
+    assert bool(s.instr_reachable[0]) is True
+    assert bool(s.instr_reachable[1]) is False
+    assert s.underflow_blocks == 1

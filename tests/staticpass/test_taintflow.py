@@ -1,0 +1,70 @@
+"""Static taint reachability: forward closure + global-channel escalation."""
+
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.frontier import taint
+from mythril_tpu.staticpass.summary import summarize
+
+
+def _summary(hexcode: str, is_creation: bool = False):
+    code = bytes.fromhex(hexcode)
+    return summarize(
+        Disassembly(code).instruction_list,
+        code_size=len(code),
+        is_creation=is_creation,
+    )
+
+
+def test_source_reaches_downstream_sink():
+    # ORIGIN; PUSH1 6; JUMPI; STOP; INVALID; JUMPDEST; STOP
+    s = _summary("32600657" + "00" + "fe" + "5b00")
+    assert "JUMPI" in s.taint_reach(taint.TAINT_ORIGIN)
+    assert taint.TAINT_ORIGIN not in s.escalated_bits
+
+
+def test_absent_source_reaches_nothing():
+    s = _summary("32600657" + "00" + "fe" + "5b00")
+    assert s.taint_reach(taint.TAINT_TIMESTAMP) == frozenset()
+
+
+def test_sink_before_source_not_reached_without_channel():
+    # PUSH1 1; PUSH1 7; JUMPI; STOP; INVALID; JUMPDEST(7); TIMESTAMP; POP; STOP
+    # the only JUMPI executes strictly before TIMESTAMP and nothing global
+    # carries the value backwards -> unreachable from the source
+    s = _summary("6001600757" + "00" + "fe" + "5b425000")
+    assert "JUMPI" not in s.taint_reach(taint.TAINT_TIMESTAMP)
+    assert taint.TAINT_TIMESTAMP not in s.escalated_bits
+
+
+def test_sstore_escalates_to_all_reachable_ops():
+    # dispatch JUMPI first, then TIMESTAMP -> SSTORE: storage persists
+    # across transactions, so the bit may reach EVERY reachable sink,
+    # including the JUMPI that executed before the source this tx
+    # PUSH1 1; PUSH1 7; JUMPI; STOP; INVALID; JUMPDEST(7); TIMESTAMP; PUSH1 0; SSTORE; STOP
+    s = _summary("6001600757" + "00" + "fe" + "5b4260005500")
+    assert taint.TAINT_TIMESTAMP in s.escalated_bits
+    assert "JUMPI" in s.taint_reach(taint.TAINT_TIMESTAMP)
+
+
+def test_call_family_escalates():
+    # ORIGIN feeding a CALL: re-entry can run this code from pc 0 within
+    # the influenced frame, so the bit escalates
+    # ORIGIN; PUSH1 0 x5; GAS; CALL; STOP  (stack: gas to value in out inout)
+    s = _summary("32" + "6000" * 5 + "5a" + "f1" + "00")
+    assert taint.TAINT_ORIGIN in s.escalated_bits
+
+
+def test_creation_code_treats_return_as_channel():
+    # TIMESTAMP; PUSH1 0; MSTORE; PUSH1 32; PUSH1 0; RETURN — in creation
+    # code the returned bytes BECOME the runtime code: channel hit
+    code = "42600052" + "60206000f3"
+    s_runtime = _summary(code)
+    s_creation = _summary(code, is_creation=True)
+    assert taint.TAINT_TIMESTAMP not in s_runtime.escalated_bits
+    assert taint.TAINT_TIMESTAMP in s_creation.escalated_bits
+
+
+def test_unreachable_source_reaches_nothing():
+    # PUSH1 4; JUMP; ORIGIN(dead); JUMPDEST; STOP — the ORIGIN sits in the
+    # statically dead pad, so its bit has no reachable source instruction
+    s = _summary("600456" + "32" + "5b00")
+    assert s.taint_reach(taint.TAINT_ORIGIN) == frozenset()
