@@ -301,6 +301,113 @@ def staticpass_compare() -> dict:
     }
 
 
+def pipeline_compare() -> dict:
+    """Pipelined vs synchronous frontier on two small workloads.
+
+    Runs each workload twice with the device frontier forced on — once with
+    the pipelined runner (chained dispatch + background feasibility pool),
+    once with ``--no-pipeline`` semantics — and asserts the correctness
+    contract: the issue sets are IDENTICAL while the pipelined run actually
+    overlapped a nonzero number of segments.  Also asserts time-to-first-
+    exploit parity (generous bound — CPU-backend walls jitter) so the
+    opening-dispatch fix and the pipeline never push the first event behind
+    a big-bucket compile again.  Returns (and ``main`` prints) one
+    JSON-able dict with both walls, both issue sets and the ``pipeline.*``
+    registry snapshot of the pipelined run.
+    """
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.frontier import engine as _eng
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.support.support_args import args as global_args
+
+    def issue_set(issues):
+        return sorted((i.swc_id, i.address) for i in issues)
+
+    suicide = bytes.fromhex("60003560e01c6341c0e1b51460145760006000fd5b33ff")
+    workloads = [
+        # (name, contract-or-code, tx_count, modules, recall swc)
+        ("suicide", suicide, 1, ["AccidentallyKillable"], "106"),
+        ("killbilly",
+         EVMContract(code=KILLBILLY, creation_code=KILLBILLY_CREATION,
+                     name="KillBilly"),
+         3, ["AccidentallyKillable"], "106"),
+    ]
+
+    def one_run(target, txs, modules, pipelined: bool):
+        global_args.pipeline = pipelined
+        _clear_caches()
+        # the per-code slow/narrow verdicts and program-warm markers are
+        # deliberately process-persistent; a verdict learned in run A must
+        # not change run B's control flow when comparing the two modes
+        _eng._SLOW_CODES.clear()
+        _eng._NARROW_CODES.clear()
+        _eng._SLOW_SEGMENTS.clear()
+        get_registry().reset(prefix="pipeline.")
+        t0 = time.time()
+        _, issues = _analyze(target, 0x0901D12E, txs, modules=modules,
+                             timeout=300)
+        wall = time.time() - t0
+        snap = {
+            k: v
+            for k, v in get_registry().snapshot().items()
+            if k.startswith("pipeline.")
+        }
+        return issue_set(issues), wall, _ttfe(issues, t0), snap
+
+    prev = (global_args.pipeline, global_args.frontier,
+            global_args.frontier_force, global_args.frontier_width)
+    results = {}
+    try:
+        global_args.probe_backend = "auto"
+        global_args.frontier = True
+        global_args.frontier_force = True  # tiny contracts: bypass gates
+        global_args.frontier_width = 64
+        # warm both programs outside the timers: the pipelined and
+        # synchronous paths jit different programs (chained-dispatch merge
+        # vs plain push) and a cold XLA compile inside either timed run
+        # would swamp the wall/ttfe comparison
+        for pipelined in (True, False):
+            one_run(suicide, 1, ["AccidentallyKillable"], pipelined)
+        for name, target, txs, modules, swc in workloads:
+            on_issues, on_wall, on_ttfe, on_snap = one_run(
+                target, txs, modules, True
+            )
+            off_issues, off_wall, off_ttfe, off_snap = one_run(
+                target, txs, modules, False
+            )
+            assert any(s == swc for s, _ in on_issues), (
+                f"{name}: pipelined run lost recall: {on_issues}"
+            )
+            assert on_issues == off_issues, (
+                f"{name}: pipeline changed the issue set: "
+                f"{on_issues} != {off_issues}"
+            )
+            assert on_snap.get("pipeline.segments_pipelined", 0) > 0, (
+                f"{name}: pipelined run overlapped zero segments: {on_snap}"
+            )
+            assert off_snap.get("pipeline.segments_pipelined", 0) == 0, (
+                f"{name}: --no-pipeline run still pipelined: {off_snap}"
+            )
+            # parity, not a race: generous bound absorbs CPU-backend jitter
+            if on_ttfe == on_ttfe and off_ttfe == off_ttfe:
+                assert on_ttfe <= 3.0 * off_ttfe + 2.0, (
+                    f"{name}: pipelined ttfe_s regressed: "
+                    f"{on_ttfe:.2f}s vs {off_ttfe:.2f}s synchronous"
+                )
+            results[name] = {
+                "pipelined_wall_s": round(on_wall, 3),
+                "sync_wall_s": round(off_wall, 3),
+                "pipelined_ttfe_s": round(on_ttfe, 3),
+                "sync_ttfe_s": round(off_ttfe, 3),
+                "issues": on_issues,
+                "pipeline": on_snap,
+            }
+    finally:
+        (global_args.pipeline, global_args.frontier,
+         global_args.frontier_force, global_args.frontier_width) = prev
+    return {"metric": "pipeline_compare", "workloads": results}
+
+
 # ---------------------------------------------------------------------------
 # workloads
 # ---------------------------------------------------------------------------
@@ -998,6 +1105,11 @@ def main() -> None:
     if "--staticpass-compare" in sys.argv:
         # standalone on-vs-off mode: skip the full suite, emit one line
         print(json.dumps(staticpass_compare()), flush=True)
+        return
+
+    if "--pipeline-compare" in sys.argv:
+        # standalone pipelined-vs-sync parity mode: skip the suite, one line
+        print(json.dumps(pipeline_compare()), flush=True)
         return
 
     # suite-internal budget clock (monotonic); the per-workload t0 stamps

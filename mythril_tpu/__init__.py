@@ -1,32 +1,85 @@
 __version__ = "0.1.0"
 
+_compile_cache_armed = False
+_compile_cache_listener_armed = False
 
-def enable_persistent_compilation_cache() -> None:
+
+def enable_persistent_compilation_cache(cache_dir=None) -> None:
     """Cache compiled XLA programs on disk across processes.
 
-    The tape-VM interpreter (mythril_tpu/ops/tape_vm.py) and the Pallas
-    keccak kernel compile once per shape bucket; over a tunneled TPU that
-    first compile costs tens of seconds.  JAX's persistent compilation cache
-    turns that into a one-time-per-machine cost.  Best-effort: unsupported
-    backends or read-only homes silently skip it.
+    The tape-VM interpreter (mythril_tpu/ops/tape_vm.py), the Pallas keccak
+    kernel and the frontier's ``cached_segment`` programs compile once per
+    shape bucket; over a tunneled TPU that first compile costs tens of
+    seconds.  JAX's persistent compilation cache turns that into a
+    one-time-per-machine cost.  Best-effort: unsupported backends or
+    read-only homes silently skip it.
 
-    Called from the device-path modules at import time (they import jax
-    anyway); NOT from this package __init__ — host-only workflows must not
-    pay the jax import at startup.
+    Default **off**: the no-argument form (called from the device-path
+    modules at import time — they import jax anyway, and host-only
+    workflows must not pay the jax import at startup) only arms the cache
+    when the ``MYTHRIL_TPU_COMPILATION_CACHE`` env var opts in.  Passing
+    ``cache_dir`` (the ``--compile-cache-dir`` flag) arms it explicitly
+    and drops the min-compile-time floor so even small CPU-backend
+    programs (CI parity runs, the opening-dispatch segment) are cached.
+
+    Cache hits/misses are mirrored into the ``compilecache.hits`` /
+    ``compilecache.misses`` counters via ``jax.monitoring`` so
+    ``--metrics-out`` snapshots show whether warm starts actually skipped
+    the recompile.
     """
+    global _compile_cache_armed
     import os
 
     try:
+        explicit = cache_dir is not None
+        if not explicit:
+            cache_dir = os.environ.get("MYTHRIL_TPU_COMPILATION_CACHE")
+            if not cache_dir:
+                return  # default off: nobody opted in
+        if _compile_cache_armed and not explicit:
+            return
         import jax
 
-        cache_dir = os.environ.get(
-            "MYTHRIL_TPU_COMPILATION_CACHE",
-            os.path.join(
-                os.path.expanduser("~"), ".cache", "mythril_tpu", "xla"
-            ),
-        )
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            0.0 if explicit else 2.0,
+        )
+        _compile_cache_armed = True
+        _arm_compile_cache_listener()
+    except Exception:
+        pass
+
+
+def _arm_compile_cache_listener() -> None:
+    """Mirror jax's compilation-cache hit/miss events into the registry."""
+    global _compile_cache_listener_armed
+    if _compile_cache_listener_armed:
+        return
+    try:
+        import jax.monitoring
+
+        from mythril_tpu.observability.metrics import get_registry
+
+        reg = get_registry()
+        # persistent scope: hits accumulate across the per-contract metric
+        # sweeps — warm-start evidence is process-wide, like the frontier's
+        # slow/narrow-code verdicts.  Force-create so --metrics-out shows
+        # the block even at 0.
+        reg.counter("compilecache.hits", persistent=True)
+        reg.counter("compilecache.misses", persistent=True)
+
+        def _on_event(event, **kwargs):
+            # exact event names vary across jax releases; match loosely
+            if "compilation_cache" not in event:
+                return
+            if event.endswith("cache_hits"):
+                reg.counter("compilecache.hits", persistent=True).inc()
+            elif event.endswith("cache_misses"):
+                reg.counter("compilecache.misses", persistent=True).inc()
+
+        jax.monitoring.register_event_listener(_on_event)
+        _compile_cache_listener_armed = True
     except Exception:
         pass

@@ -49,6 +49,9 @@ class AnalyzerArgs:
     query_cache: bool = True
     query_cache_dir: Optional[str] = None
     staticpass: bool = True
+    pipeline: bool = True
+    solver_workers: int = 2
+    compile_cache_dir: Optional[str] = None
 
 
 class MythrilAnalyzer:
@@ -103,11 +106,18 @@ class MythrilAnalyzer:
         args.query_cache = getattr(cmd_args, "query_cache", True)
         args.query_cache_dir = getattr(cmd_args, "query_cache_dir", None)
         args.staticpass = getattr(cmd_args, "staticpass", True)
+        args.pipeline = getattr(cmd_args, "pipeline", True)
+        args.solver_workers = getattr(cmd_args, "solver_workers", 2)
+        args.compile_cache_dir = getattr(cmd_args, "compile_cache_dir", None)
         from mythril_tpu.querycache import configure as _configure_query_cache
 
         _configure_query_cache(
             enabled=args.query_cache, cache_dir=args.query_cache_dir
         )
+        if args.compile_cache_dir:
+            from mythril_tpu import enable_persistent_compilation_cache
+
+            enable_persistent_compilation_cache(args.compile_cache_dir)
 
     def _sym_exec(self, contract, run_analysis_modules: bool = True) -> SymExecWrapper:
         from mythril_tpu.support.loader import DynLoader

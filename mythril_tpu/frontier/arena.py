@@ -45,10 +45,26 @@ def _bitvec_fns():
 LIMBS = 16  # 256 bits as 16-bit limbs in uint32
 
 
+# monotonically increasing arena generation ids: the pipelined engine keeps
+# two arena generations logically in flight (segment N's pulled rows, segment
+# N+1's pending device appends) and asserts they never alias host buffers
+_GENERATION = [0]
+
+
 class HostArena:
-    """Append-only row table with host-side interning and decode memo."""
+    """Append-only row table with host-side interning and decode memo.
+
+    Every instance owns FRESH numpy columns (no shared/aliased buffers
+    between generations — the pipelined engine depends on this) and carries
+    a process-unique ``generation`` id.  ``freeze()`` guards the pipelined
+    loop's no-append window: while a device segment is in flight the device
+    appends rows at the same indices the host would, so host-side appends
+    raise until ``thaw()`` at a sync point."""
 
     def __init__(self, cap: int = 1 << 17):
+        _GENERATION[0] += 1
+        self.generation = _GENERATION[0]
+        self._frozen = False
         self.cap = cap
         self.op = np.zeros(cap, np.int32)
         self.a = np.full(cap, -1, np.int32)
@@ -78,7 +94,20 @@ class HostArena:
     # row creation (host side)
     # ------------------------------------------------------------------
 
+    def freeze(self) -> None:
+        """Forbid host appends (a device segment is in flight and owns the
+        append indices); decode/read stays allowed."""
+        self._frozen = True
+
+    def thaw(self) -> None:
+        self._frozen = False
+
     def _append(self, op, a=-1, b=-1, c=-1, width=0, value: Optional[int] = None) -> int:
+        if self._frozen:
+            raise RuntimeError(
+                "arena is frozen: host appends while a device segment is "
+                "in flight would alias the device's append indices"
+            )
         if self.length >= self.cap:
             raise MemoryError("arena capacity exhausted")
         i = self.length

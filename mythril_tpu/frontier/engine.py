@@ -704,7 +704,8 @@ class FrontierEngine:
                 table_code.append(code)
             seed_code_idx.append(ci)
 
-        bucket = multi_size_bucket(tables)
+        natural_bucket = multi_size_bucket(tables)
+        bucket = natural_bucket
         if bucket_floor is not None:
             bucket = tuple(max(b, f) for b, f in zip(bucket, bucket_floor))
         code_cap, instr_cap, addr_cap, loops_cap = bucket
@@ -862,7 +863,97 @@ class FrontierEngine:
         slow_bailed = False
 
         width_verdict_valid = True  # False when the run was cut short
-        while True:
+        skip_loop = False
+
+        # TTFE fix: a floored bucket shares one compiled program across a
+        # cooperative corpus, but a COLD program then pays the big bucket's
+        # XLA compile before the first event can harvest (ttfe_s regression
+        # in BENCH_r05).  Run the OPENING dispatch at the program's natural
+        # bucket — a small program that compiles fast — harvest it, and only
+        # then enter the floored-bucket loop.  Time-to-first-event now rides
+        # the small compile; the big compile amortizes over the rest.
+        if mesh is None and bucket != natural_bucket and not program_warm:
+            nat_cc, nat_ic, _nat_ac, nat_lc = natural_bucket
+            stats = FrontierStatistics()
+            nat_segment = cached_segment(caps, *natural_bucket)
+            nat_code_dev = CodeDev(*[
+                jax.device_put(a)
+                for a in stacked_device_tables(tables, natural_bucket)
+            ])
+            nat_visited = jax.device_put(np.zeros((nat_cc, nat_ic), bool))
+            cfg0 = cfg._replace(
+                k_limit=np.int32(min(caps.K, 96 << min(stats.segments, 4)))
+            )
+            st_nat = st._replace(loops=st.loops[:, :nat_lc])
+            t_seg = time.perf_counter()
+            with _otrace.span(
+                "frontier.segment", cat="device", segment=-1,
+                warm=(caps, natural_bucket) in _WARM_PROGRAMS, opening=True,
+            ), _otrace.device_annotation("frontier.segment"):
+                out_state, dev_arena, out_len, n_exec, seg_ml, nat_visited = (
+                    nat_segment(push_state(st_nat), dev_arena, arena_len,
+                                nat_visited, nat_code_dev, cfg0)
+                )
+                st_p, arena_len, n_exec_host, seg_ml_host = pull_harvest(
+                    out_state, out_len, n_exec, seg_ml
+                )
+            max_live = max(max_live, seg_ml_host)
+            arena.pull_from_device(dev_arena, arena_len)
+            executed += n_exec_host
+            stats.device_instructions += n_exec_host
+            stats.segments += 1
+            seg_only = time.perf_counter() - t_seg
+            stats.segment_s += seg_only
+            _get_metrics().observe("frontier.segment_wall_s", seg_only)
+            _get_metrics().counter("frontier.opening_dispatches").inc()
+            _WARM_PROGRAMS.add((caps, natural_bucket))
+            st = st_p._replace(loops=np.ascontiguousarray(np.pad(
+                st_p.loops, ((0, 0), (0, loops_cap - nat_lc))
+            )))
+            t_har = time.perf_counter()
+            with _otrace.span("frontier.harvest", cat="frontier",
+                              segment=-1):
+                self._harvest(st, records, walker, ev_seen)
+            ev_seen.fill(0)
+            har_only = time.perf_counter() - t_har
+            stats.harvest_s += har_only
+            _get_metrics().observe("frontier.harvest_wall_s", har_only)
+            # the opening coverage lives in the natural bucket's corner of
+            # the floored bitmap (same code order, smaller caps)
+            import jax.numpy as jnp
+
+            visited = visited.at[:nat_cc, :nat_ic].set(
+                jnp.asarray(nat_visited)
+            )
+            live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
+            max_live = max(max_live, live)
+            if live == 0 and not seed_queue:
+                skip_loop = True  # nothing left for the floored program
+
+        if not skip_loop and args.pipeline and mesh is None:
+            from mythril_tpu.frontier.pipeline import PipelinedRunner
+
+            runner = PipelinedRunner(
+                self, st=st, records=records, walker=walker, arena=arena,
+                ev_seen=ev_seen, seeds=seeds, seed_lasers=seed_lasers,
+                lasers=lasers, ctxs=ctxs, seed_code_idx=seed_code_idx,
+                mid_enc=mid_enc, seed_queue=seed_queue, statics=statics,
+                beam=beam, tables=tables, table_code=table_code,
+                table_idx=table_idx, segment=segment, code_dev=code_dev,
+                cfg=cfg, dev_arena=dev_arena, arena_len=arena_len,
+                visited=visited, deadline=deadline,
+                program_key=program_key, program_warm=program_warm,
+            )
+            runner.run()
+            st = runner.st
+            executed = runner.executed + executed
+            arena_len = runner.arena_len
+            visited = runner.visited
+            max_live = max(max_live, runner.max_live)
+            slow_bailed = runner.slow_bailed
+            width_verdict_valid = runner.width_verdict_valid
+            skip_loop = True
+        while not skip_loop:
             if time.perf_counter() > deadline or time_handler.time_remaining() <= 0:
                 log.info("frontier: execution timeout; parking live paths")
                 self._park_all(st, records, walker, reason="timeout")
@@ -1091,7 +1182,11 @@ class FrontierEngine:
     # ------------------------------------------------------------------
 
     def _harvest(self, st: FrontierState, records, walker: Walker,
-                 ev_seen: np.ndarray) -> None:
+                 ev_seen: np.ndarray, pipe=None) -> None:
+        """``pipe`` is the PipelinedRunner when the pipelined loop drives
+        this harvest: slot mutations are reported to its correction ledger
+        (so they ride the next chained dispatch) and feasibility checks go
+        to its background pool instead of blocking here."""
         caps = self.caps
         # 1. append new events and create child records.  A fork event makes
         # a fresh slot scannable, and that child may itself have forked in
@@ -1141,7 +1236,7 @@ class FrontierEngine:
         # same check per segment over every still-running path whose
         # constraint list grew, freeing slots that can never terminate
         if not args.sparse_pruning:
-            self._prune_running(st, records, walker, ev_seen)
+            self._prune_running(st, records, walker, ev_seen, pipe)
 
         # 2c. batch the mutation-pruner's tx-end queries: walker replay fires
         # add_world_state once per terminal path, and each unmutated path
@@ -1166,6 +1261,8 @@ class FrontierEngine:
                 )
                 if still_free:
                     st.halt[slot] = O.H_RUNNING
+                    if pipe is not None:
+                        pipe.ledger.touch(slot)
                     continue
                 # batch saturated: spill to the host engine
             rec.final = snapshot_slot(st, slot)
@@ -1190,6 +1287,8 @@ class FrontierEngine:
             records[slot] = None
             clear_slot(st, slot)
             ev_seen[slot] = 0
+            if pipe is not None:
+                pipe.ledger.touch(slot)
 
     @staticmethod
     def _run_microbench(segment, micro_args, n_exec: int, st, reps: int = 4) -> None:
@@ -1336,7 +1435,7 @@ class FrontierEngine:
                 )
 
     def _prune_running(self, st: FrontierState, records, walker: Walker,
-                       ev_seen: np.ndarray) -> None:
+                       ev_seen: np.ndarray, pipe=None) -> None:
         from mythril_tpu.smt.solver import check_satisfiable_batch
 
         todo = []
@@ -1347,6 +1446,8 @@ class FrontierEngine:
             n_cons = int(st.cons_len[slot])
             if n_cons <= rec._pruned_at:
                 continue
+            if pipe is not None and n_cons <= rec._submitted_at:
+                continue  # verdict for this lineage depth still pending
             seed = walker.seeds[rec.seed_idx]
             raws = list(seed.world_state.constraints.get_all_raw())
             try:
@@ -1362,6 +1463,20 @@ class FrontierEngine:
                 continue
             todo.append((slot, rec, n_cons, raws))
         if not todo:
+            return
+        if pipe is not None:
+            # pipelined: the path keeps running SPECULATIVELY while the
+            # pool solves in the background; an UNSAT verdict rolls it
+            # back at a later harvest (pipeline.apply_verdicts).  The key
+            # mirrors the solver fast path's canonical identity, so the
+            # pool dedups in-flight twins and the worker hits the query
+            # cache for everything already decided.
+            for slot, rec, n_cons, raws in todo:
+                rec._submitted_at = n_cons
+                pipe.pool.submit(
+                    slot, rec, n_cons, raws,
+                    frozenset(t.tid for t in raws),
+                )
             return
         # harvest feasibility is one of the query cache's three entry points
         # (ISSUE/querycache.rst): the batched check below takes the cache's

@@ -1,0 +1,678 @@
+"""Pipelined frontier loop: overlap device segments with host harvest/solve.
+
+The synchronous loop in engine._run alternates strictly — dispatch a
+segment, block pulling its results, harvest on the host, repeat — so the
+device idles for the whole harvest (measured at 66-69% of iteration wall on
+the reentrance/bectoken workloads).  This module keeps ONE segment in
+flight at all times:
+
+  * dispatch N+1 is CHAINED onto dispatch N's un-materialized device
+    outputs (step.chain_dispatch) before the host ever blocks on N, so the
+    device starts segment N+1 the moment N retires while the host is still
+    pulling/harvesting N;
+  * host mutations from harvest N-1 (freed slots, resumed pending forks,
+    fresh seed injections) ride dispatch N+1 as a per-slot correction mask
+    merged on device — one packed upload, same cost the synchronous loop
+    pays for its full push;
+  * per-record feasibility checks (engine._prune_running) move into a
+    bounded background pool: running paths continue SPECULATIVELY while the
+    solver works, and an UNSAT verdict rolls the path (and any descendants
+    it forked meanwhile) back at the next harvest.  Pruning is a
+    performance optimization, not a soundness gate — issues are confirmed
+    by their own solver queries at detection time — so late rollback keeps
+    the issue set identical (args.sparse_pruning already disables the
+    sweep entirely).
+
+Correction protocol (the part that makes chaining sound):
+
+  * every host write to a slot is uploaded EXACTLY ONCE.  corrections from
+    harvest j ride dispatch j+2 (the first dispatch issued after harvest
+    j), so ``active_at[slot]`` records that dispatch index;
+  * until the pull of segment ``active_at[slot]`` the device's view of the
+    slot is stale, so each pull carries the slot's row forward from the
+    previous host mirror (pull_harvest builds a fresh mirror every
+    segment).  Carried slots get ``ev_len = 0``: their device events were
+    already drained at the harvest that mutated them, and re-draining the
+    stale buffer would duplicate events;
+  * a slot whose correction exposed it FREE becomes device-owned the
+    moment a chained dispatch consumes the mask: every later chained
+    segment may grant a fork into it, so the host never re-injects into it
+    until a sync point (no dispatch in flight) resets ownership.  Fork
+    grants into freed slots whose parent was meanwhile killed show up as
+    occupied device slots with no host record — the orphan scan clears
+    them and schedules the clear as a correction.
+
+Sync points (the only places the pipeline intentionally drains): the first
+microbenched dispatch, host arena appends for spill re-injection (an
+in-flight segment appends device rows at the same indices), reclaiming
+device-owned free slots for a backed-up seed queue, and the final drain —
+an in-flight segment is always pulled and harvested before the loop exits,
+never discarded.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.records import PathRecord
+from mythril_tpu.frontier.state import FrontierState, clear_slot
+from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability import tracer as _otrace
+from mythril_tpu.observability.metrics import get_registry as _get_metrics
+from mythril_tpu.support.support_args import args
+from mythril_tpu.support.time_handler import time_handler
+
+log = logging.getLogger(__name__)
+
+
+def _pc(name: str):
+    return _get_metrics().counter("pipeline." + name)
+
+
+class CorrectionLedger:
+    """Exactly-once correction bookkeeping for chained dispatches.
+
+    Tracks, per slot, the index of the first segment output that reflects
+    the host's latest write (``active_at``), the pending upload mask, and
+    device ownership of host-freed slots.  Kept free of engine state so the
+    protocol is unit-testable on its own."""
+
+    def __init__(self, n_slots: int):
+        self.corr_mask = np.zeros(n_slots, bool)
+        self.active_at = np.full(n_slots, -1, np.int64)
+        self.device_owned = np.zeros(n_slots, bool)
+        self.next_dispatch = 0  # index of the next dispatch to be issued
+        self.pulled = -1  # index of the last pulled segment
+
+    def touch(self, slot: int) -> None:
+        """Host mutated ``slot``: upload it with the next dispatch."""
+        self.corr_mask[slot] = True
+        self.active_at[slot] = self.next_dispatch
+        _pc("corrected_slots").inc()
+
+    def consume(self, host_seed: np.ndarray) -> np.ndarray:
+        """A dispatch is consuming the pending mask: return it (copy) and
+        mark host-freed slots device-owned (the device may fork-grant into
+        them from this dispatch on)."""
+        mask = self.corr_mask.copy()
+        self.device_owned |= mask & (host_seed < 0)
+        self.corr_mask[:] = False
+        self.next_dispatch += 1
+        return mask
+
+    def consume_all(self) -> None:
+        """A FULL push is being dispatched: every slot becomes device
+        authoritative at this dispatch's output."""
+        self.corr_mask[:] = False
+        self.active_at[:] = self.next_dispatch
+        self.next_dispatch += 1
+
+    def on_pull(self) -> np.ndarray:
+        """A segment was pulled; returns the slots whose host value is
+        newer than this output (to carry forward from the old mirror)."""
+        self.pulled += 1
+        return np.nonzero(self.active_at > self.pulled)[0]
+
+    def carry_forward(self, new_st: FrontierState, prev_st: FrontierState
+                      ) -> int:
+        slots = self.on_pull()
+        for slot in slots:
+            s = int(slot)
+            for name, dst, src in zip(new_st._fields, new_st, prev_st):
+                if name == "events":
+                    continue
+                dst[s] = src[s]
+            # host-authoritative slots have no undrained device events
+            new_st.ev_len[s] = 0
+        return len(slots)
+
+    def release_owned(self) -> None:
+        """Sync point (no dispatch in flight anywhere): nothing can grant
+        into host-freed slots anymore, the host may reclaim them."""
+        self.device_owned[:] = False
+
+
+class FeasibilityPool:
+    """Background solver pool for speculative feasibility checks.
+
+    Raws are decoded on the MAIN thread (the walker/arena decode path is
+    not thread-safe); workers only run check_satisfiable_batch, which is
+    query-cache-aware through the solver fast path.  In-flight queries are
+    deduplicated by the fast path's own canonical key (the frozenset of
+    constraint term ids), so identical lineages pending at the same time
+    solve once.  Actual solves are serialized under one lock: the solver's
+    memo caches are shared, and the win is moving the solve OFF the
+    dispatch critical path, not parallel solving."""
+
+    def __init__(self, workers: int):
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(1, int(workers)),
+            thread_name_prefix="mythril-feas",
+        )
+        self._solver_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._inflight: Dict[frozenset, list] = {}
+        self._done: list = []
+
+    def submit(self, slot: int, rec, n_cons: int, raws, key: frozenset
+               ) -> None:
+        with self._lock:
+            waiters = self._inflight.get(key)
+            if waiters is not None:
+                waiters.append((slot, rec, n_cons))
+                _pc("pool_inflight_dedup").inc()
+                return
+            self._inflight[key] = [(slot, rec, n_cons)]
+            depth = len(self._inflight)
+        _pc("pool_submitted").inc()
+        g = _get_metrics().gauge("pipeline.pool_queue_depth")
+        g.set(max(int(g.value or 0), depth))
+        self._executor.submit(self._work, key, raws)
+
+    def _work(self, key: frozenset, raws) -> None:
+        from mythril_tpu.smt.solver import check_satisfiable_batch
+
+        try:
+            with self._solver_lock:
+                ok = bool(check_satisfiable_batch([raws])[0])
+        except Exception as e:  # pragma: no cover - defensive
+            log.debug("background feasibility check failed: %s", e)
+            ok = True  # sound: the path just keeps running
+        with self._lock:
+            self._done.append((key, ok))
+
+    def drain(self) -> list:
+        """Verdicts that landed since the last drain as
+        (slot, rec, n_cons, ok) tuples."""
+        out = []
+        with self._lock:
+            done, self._done = self._done, []
+            for key, ok in done:
+                for item in self._inflight.pop(key, ()):
+                    out.append((*item, ok))
+        return out
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def shutdown(self) -> None:
+        self._executor.shutdown(wait=True)
+        # apply nothing: whatever verdicts are still queued are dropped
+        # with the run (speculation is sound without them)
+
+
+class PipelinedRunner:
+    """Drives engine._run's segment loop in pipelined (chained) form.
+
+    Constructed by engine._run with the run's prepared state; mutates the
+    shared mirrors/records in place and reports the loop outcome via
+    attributes (executed, max_live, slow_bailed, width_verdict_valid,
+    visited, arena_len)."""
+
+    def __init__(self, engine, *, st, records, walker, arena, ev_seen,
+                 seeds, seed_lasers, lasers, ctxs, seed_code_idx, mid_enc,
+                 seed_queue, statics, beam, tables, table_code, table_idx,
+                 segment, code_dev, cfg, dev_arena, arena_len, visited,
+                 deadline, program_key, program_warm):
+        self.engine = engine
+        self.caps = engine.caps
+        self.st = st
+        self.records = records
+        self.walker = walker
+        self.arena = arena
+        self.ev_seen = ev_seen
+        self.seeds = seeds
+        self.seed_lasers = seed_lasers
+        self.lasers = lasers
+        self.ctxs = ctxs
+        self.seed_code_idx = seed_code_idx
+        self.mid_enc = mid_enc
+        self.seed_queue = seed_queue
+        self.statics = statics
+        self.beam = beam
+        self.tables = tables
+        self.table_code = table_code
+        self.table_idx = table_idx
+        self.segment = segment
+        self.code_dev = code_dev
+        self.cfg = cfg
+        self.dev_arena = dev_arena
+        self.arena_len = arena_len
+        self.visited = visited
+        self.deadline = deadline
+        self.program_key = program_key
+        self.program_warm = program_warm
+
+        self.ledger = CorrectionLedger(self.caps.B)
+        self.pool = FeasibilityPool(args.solver_workers)
+        self.reinject_q: List[tuple] = []
+
+        self.executed = 0
+        self.max_live = 0
+        self.slow_bailed = False
+        self.width_verdict_valid = True
+
+    # -- walker park sink: catch re-runnable spills ---------------------
+
+    def _park_sink(self, laser, rec, carrier, snap) -> bool:
+        """Batch-full spills are perfectly re-runnable device states; queue
+        them for re-injection at the next sync point instead of bouncing
+        them to the host work list.  Semantic parks (the device provably
+        cannot execute the instruction) always go to the host."""
+        if snap.get("semantic_park"):
+            return False
+        from mythril_tpu.frontier.engine import _mid_eligible
+
+        if len(self.reinject_q) >= 2 * self.caps.B:
+            return False
+        if not _mid_eligible(carrier):
+            return False
+        self.reinject_q.append((laser, carrier))
+        _pc("reinject_queued").inc()
+        return True
+
+    # -- speculative verdicts ------------------------------------------
+
+    def apply_verdicts(self) -> None:
+        st, records = self.st, self.records
+        for slot, rec, n_cons, ok in self.pool.drain():
+            if ok:
+                if records[slot] is rec:
+                    rec._pruned_at = max(rec._pruned_at, n_cons)
+                continue
+            # UNSAT: roll back the speculatively-running path and every
+            # descendant it forked while the verdict was pending.  A path
+            # that already finished replayed its events, but its issues
+            # (if any) fail their own confirmation query — soundness does
+            # not depend on this rollback, only slot recycling does.
+            for s in range(self.caps.B):
+                r = records[s]
+                node = r
+                while node is not None and node is not rec:
+                    node = node.parent
+                if node is rec and r is not None:
+                    records[s] = None
+                    clear_slot(st, s)
+                    self.ev_seen[s] = 0
+                    self.ledger.touch(s)
+                    _pc("pool_unsat_rollbacks").inc()
+
+    def clear_orphans(self) -> None:
+        """Device-occupied slots with no host record are descendants of
+        paths killed while a segment was in flight: the fork event that
+        would have created their record was skipped (dead parent)."""
+        st, records = self.st, self.records
+        for slot in range(self.caps.B):
+            if records[slot] is not None:
+                continue
+            if self.ledger.active_at[slot] > self.ledger.pulled:
+                continue  # host-authoritative row, host knows it is free
+            if int(st.seed[slot]) >= 0:
+                clear_slot(st, slot)
+                self.ev_seen[slot] = 0
+                self.ledger.touch(slot)
+                _pc("orphan_rollbacks").inc()
+
+    # -- refill ---------------------------------------------------------
+
+    def _free_slot(self) -> Optional[int]:
+        for slot in range(self.caps.B):
+            if (self.records[slot] is None
+                    and not self.ledger.device_owned[slot]
+                    and int(self.st.seed[slot]) < 0):
+                return slot
+        return None
+
+    def refill(self) -> None:
+        """Queued seeds into host-reclaimable free slots.  Unlike the
+        synchronous loop, beam scores of LIVE slots are not refreshed:
+        uploading onto a device-advanced slot would clobber it."""
+        from mythril_tpu.frontier.engine import _beam_importance
+
+        eng = self.engine
+        for slot in range(self.caps.B):
+            if not self.seed_queue:
+                break
+            if (self.records[slot] is not None
+                    or self.ledger.device_owned[slot]
+                    or int(self.st.seed[slot]) >= 0):
+                continue
+            si = self.seed_queue.pop(0)
+            eng._inject(self.st, slot, si, self.ctxs[si],
+                        self.seed_code_idx[si],
+                        _beam_importance(self.seeds[si]) if self.beam else 0,
+                        static=self.statics[si])
+            if self.mid_enc[si] is not None:
+                with _otrace.span("frontier.mid_inject", cat="frontier",
+                                  seed=si):
+                    eng._apply_mid(self.st, slot, self.mid_enc[si])
+                FrontierStatistics().mid_injections += 1
+            self.records[slot] = PathRecord(seed_idx=si)
+            self.ev_seen[slot] = 0
+            self.ledger.touch(slot)
+
+    # -- sync-point spill re-injection ---------------------------------
+
+    def _reinject(self) -> bool:
+        """Encode queued spills back into free slots.  ONLY at a sync
+        point: seed-context/mid encoding appends host arena rows, which an
+        in-flight segment would race at the same indices.  Returns True
+        when device arena rows were appended (the next dispatch must use
+        the refreshed arena)."""
+        from mythril_tpu.frontier.engine import _beam_importance
+        from mythril_tpu.frontier.step import push_arena_rows
+
+        eng, arena = self.engine, self.arena
+        old_len = arena.length
+        q, self.reinject_q = self.reinject_q, []
+        for laser, carrier in q:
+            slot = self._free_slot()
+            ci = self.table_idx.get((id(laser), id(carrier.environment.code)))
+            if slot is None or ci is None:
+                laser.work_list.append(carrier)
+                continue
+            try:
+                si = len(self.seeds)
+                ctx = eng._seed_ctx(arena, carrier, si)
+                enc = eng._encode_mid(arena, carrier)
+            except MemoryError:
+                laser.work_list.append(carrier)
+                continue
+            if enc is None:
+                # stamp like a bounced seed so _mid_eligible stops
+                # re-offering the state at this pc
+                carrier._frontier_park_pc = carrier.mstate.pc
+                laser.work_list.append(carrier)
+                continue
+            self.walker.add_seed(laser, self.tables[ci], carrier)
+            self.ctxs.append(ctx)
+            self.seed_code_idx.append(ci)
+            self.mid_enc.append(enc)
+            self.statics.append(
+                1 if getattr(carrier.environment, "static", False) else 0
+            )
+            eng._inject(self.st, slot, si, ctx, ci,
+                        _beam_importance(carrier) if self.beam else 0,
+                        static=self.statics[-1])
+            eng._apply_mid(self.st, slot, enc)
+            FrontierStatistics().mid_injections += 1
+            self.records[slot] = PathRecord(seed_idx=si)
+            self.ev_seen[slot] = 0
+            self.ledger.touch(slot)
+            _pc("reinjected").inc()
+        if arena.length > old_len:
+            self.dev_arena = push_arena_rows(
+                self.dev_arena, arena, old_len, arena.length
+            )
+            self.arena_len = arena.length
+            return True
+        return False
+
+    def _flush_reinject_queue(self) -> None:
+        for laser, carrier in self.reinject_q:
+            laser.work_list.append(carrier)
+        self.reinject_q = []
+
+    # -- the loop -------------------------------------------------------
+
+    def _ramped_cfg(self):
+        caps = self.caps
+        return self.cfg._replace(
+            k_limit=np.int32(
+                min(caps.K, 96 << min(FrontierStatistics().segments, 4))
+            )
+        )
+
+    def _dispatch_full(self):
+        """Full push of the host mirror (dispatch 0 and sync points)."""
+        from mythril_tpu.frontier.step import push_state
+
+        cfg = self._ramped_cfg()
+        st_dev = push_state(self.st)
+        self.ledger.consume_all()
+        # every free slot is exposed to the device again
+        for slot in range(self.caps.B):
+            self.ledger.device_owned[slot] = self.records[slot] is None
+        full_args = (st_dev, self.dev_arena, self.arena_len, self.visited,
+                     self.code_dev, cfg)
+        return self.segment(*full_args), full_args
+
+    def _chain(self, inflight, arena_override=None):
+        from mythril_tpu.frontier.step import chain_dispatch
+
+        cfg = self._ramped_cfg()
+        mask = self.ledger.consume(self.st.seed)
+        out = chain_dispatch(self.segment, inflight, self.st, mask,
+                             self.code_dev, cfg,
+                             arena_override=arena_override)
+        _pc("segments_pipelined").inc()
+        return out
+
+    def run(self) -> None:
+        from mythril_tpu.frontier import engine as _eng
+        from mythril_tpu.frontier.step import pull_harvest
+
+        eng, caps = self.engine, self.caps
+        stats = FrontierStatistics()
+        reg = _get_metrics()
+        self.walker.park_sink = self._park_sink
+        narrow_harvests = 0
+        run_segments = 0
+        stop: Optional[str] = None
+        micro_pending = bool(args.frontier_microbench and not stats.microbench)
+
+        t0 = time.perf_counter()
+        inflight, full_args = self._dispatch_full()
+        dispatch_wall = time.perf_counter() - t0
+        prev_st = self.st
+        # while any dispatch is in flight the device owns the arena append
+        # indices; host encode paths must not race them (arena.freeze)
+        self.arena.freeze()
+        try:
+            while True:
+                deadline_hit = (time.perf_counter() > self.deadline
+                                or time_handler.time_remaining() <= 0)
+                # chain the next dispatch BEFORE blocking on the current
+                # one, unless this iteration must end at a sync point
+                free_owned = bool(
+                    (self.ledger.device_owned
+                     & np.fromiter((self.records[s] is None
+                                    for s in range(caps.B)), bool, caps.B)
+                     ).any()
+                )
+                want_sync = bool(
+                    micro_pending or self.reinject_q
+                    or (self.seed_queue and free_owned)
+                )
+                nxt = None
+                nxt_wall = 0.0
+                if stop is None and not deadline_hit and not want_sync:
+                    t_d = time.perf_counter()
+                    nxt = self._chain(inflight)
+                    nxt_wall = time.perf_counter() - t_d
+
+                # ---- pull: the pipeline's only blocking point
+                (out_state, out_arena, out_len, n_exec, seg_ml,
+                 out_visited) = inflight
+                t_pull = time.perf_counter()
+                with _otrace.span(
+                    "frontier.segment", cat="device", segment=run_segments,
+                    warm=self.program_warm, pipelined=True,
+                ), _otrace.device_annotation("frontier.segment"):
+                    new_st, arena_len_new, n_exec_host, seg_ml_host = (
+                        pull_harvest(out_state, out_len, n_exec, seg_ml)
+                    )
+                bubble = time.perf_counter() - t_pull
+                self.max_live = max(self.max_live, seg_ml_host)
+                self.arena.pull_from_device(out_arena, arena_len_new)
+                self.arena_len = arena_len_new
+                self.dev_arena = out_arena
+                self.visited = out_visited
+                self.executed += n_exec_host
+                stats.device_instructions += n_exec_host
+                stats.segments += 1
+                # host-visible device cost of this segment: its dispatch
+                # call plus the time the host actually waited on it — the
+                # harvest that overlapped it is NOT device time
+                seg_equiv = dispatch_wall + bubble
+                stats.segment_s += seg_equiv
+                reg.observe("frontier.segment_wall_s", seg_equiv)
+                reg.counter("pipeline.bubble_s").inc(bubble)
+                if nxt is not None:
+                    reg.counter("pipeline.overlap_segments").inc()
+                _eng._WARM_PROGRAMS.add(self.program_key)
+
+                if micro_pending and n_exec_host > 0:
+                    t_mb = time.perf_counter()
+                    eng._run_microbench(
+                        self.segment, full_args, n_exec_host, new_st
+                    )
+                    self.deadline += time.perf_counter() - t_mb
+                    micro_pending = False
+
+                # ---- harvest (overlaps the in-flight nxt segment)
+                carried = self.ledger.carry_forward(new_st, prev_st)
+                if carried:
+                    _pc("carried_slots").inc(carried)
+                self.st = new_st
+                prev_st = new_st
+                if nxt is None:
+                    self.ledger.release_owned()
+                t_har = time.perf_counter()
+                self.apply_verdicts()
+                with _otrace.span("frontier.harvest", cat="frontier",
+                                  segment=run_segments):
+                    eng._harvest(self.st, self.records, self.walker,
+                                 self.ev_seen, pipe=self)
+                self.clear_orphans()
+                for slot in range(caps.B):
+                    if self.records[slot] is not None:
+                        self.ledger.device_owned[slot] = False
+                self.ev_seen.fill(0)
+                har_only = time.perf_counter() - t_har
+                stats.harvest_s += har_only
+                reg.observe("frontier.harvest_wall_s", har_only)
+                if nxt is not None:
+                    reg.counter("pipeline.overlap_s").inc(har_only)
+
+                # ---- slow-bail accounting on the host-visible wall
+                bail_now = False
+                if ((run_segments > 0 or self.program_warm)
+                        and not args.frontier_force):
+                    host_rates = [
+                        r for r in (
+                            getattr(laser, "host_step_rate", lambda: None)()
+                            for laser in self.lasers
+                        ) if r
+                    ]
+                    bail_rate = (
+                        _eng._SLOW_BAIL_HOST_FACTOR * min(host_rates)
+                        if host_rates else _eng._SLOW_BAIL_FLOOR
+                    )
+                    code_keys = [_eng._code_key(c) for c in self.table_code]
+                    seg_rate = n_exec_host / max(seg_equiv, 1e-6)
+                    if seg_rate < bail_rate:
+                        counts = [
+                            _eng._SLOW_SEGMENTS.get(k, 0) + 1
+                            for k in code_keys
+                        ]
+                        for k, c in zip(code_keys, counts):
+                            _eng._SLOW_SEGMENTS[k] = c
+                        if (max(counts) >= _eng._SLOW_BAIL_SEGMENTS
+                                or seg_rate
+                                < _eng._SLOW_BAIL_DECISIVE * bail_rate):
+                            log.info(
+                                "frontier: %d instructions in %.2fs (below "
+                                "%.0f/s); host engine takes over",
+                                n_exec_host, seg_equiv, bail_rate,
+                            )
+                            bail_now = True
+                    else:
+                        for k in code_keys:
+                            _eng._SLOW_SEGMENTS.pop(k, None)
+                run_segments += 1
+
+                if stop is None:
+                    self.refill()
+                live = int(((self.st.halt == O.H_RUNNING)
+                            & (self.st.seed >= 0)).sum())
+                self.max_live = max(self.max_live, live)
+
+                # ---- exit decisions (first verdict wins; a later drain
+                # iteration must not overwrite it)
+                if stop is None:
+                    if deadline_hit:
+                        log.info(
+                            "frontier: execution timeout; parking live paths"
+                        )
+                        stop = "timeout"
+                    elif bail_now:
+                        stop = "slow-bail"
+                    elif (live == 0 and not self.seed_queue
+                          and not self.reinject_q):
+                        stop = "done"
+                    elif (self.arena_len + max(live, 1) * caps.R * 4
+                          >= caps.ARENA):
+                        # double the synchronous margin: up to two segments
+                        # of appends can be in flight before the next check
+                        log.warning(
+                            "frontier: arena nearly full; parking live paths"
+                        )
+                        stop = "arena-full"
+                    elif live < caps.MIN_LIVE:
+                        narrow_harvests += 1
+                        if narrow_harvests >= caps.NARROW_BAIL:
+                            log.info(
+                                "frontier: only %d live paths after %d "
+                                "segments; host engine takes over",
+                                live, narrow_harvests,
+                            )
+                            stop = "narrow-bail"
+                    else:
+                        narrow_harvests = 0
+
+                if nxt is not None:
+                    inflight = nxt
+                    dispatch_wall = nxt_wall
+                    continue
+                # sync point: no dispatch in flight anywhere
+                if stop is not None:
+                    break
+                self.ledger.release_owned()
+                self.arena.thaw()
+                if self.reinject_q:
+                    self._reinject()
+                self.refill()
+                t0 = time.perf_counter()
+                inflight, full_args = self._dispatch_full()
+                dispatch_wall = time.perf_counter() - t0
+                self.arena.freeze()
+        finally:
+            self.arena.thaw()
+            self.walker.park_sink = None
+            self._flush_reinject_queue()
+            self.pool.shutdown()
+            overlap = reg.counter("pipeline.overlap_s").value
+            total_har = overlap + reg.counter("pipeline.bubble_s").value
+            if total_har > 0:
+                reg.gauge("pipeline.overlap_ratio").set(
+                    round(overlap / total_har, 4)
+                )
+
+        if stop == "slow-bail":
+            self.slow_bailed = True
+        if stop in ("timeout", "slow-bail", "arena-full"):
+            self.width_verdict_valid = False
+        live = int(((self.st.halt == O.H_RUNNING)
+                    & (self.st.seed >= 0)).sum())
+        if stop != "done" or live > 0:
+            eng._park_all(self.st, self.records, self.walker,
+                          reason=stop or "drain")

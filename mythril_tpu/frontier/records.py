@@ -27,6 +27,7 @@ class PathRecord:
         "carrier_pos",
         "children_by_event",
         "_pruned_at",
+        "_submitted_at",
         "steps_seen",
     )
 
@@ -42,6 +43,7 @@ class PathRecord:
         self.carrier_pos = 0  # events processed so far
         self.children_by_event: Dict[int, "PathRecord"] = {}
         self._pruned_at = 0  # constraint count last proven satisfiable
+        self._submitted_at = 0  # constraint count last sent to the pool
         self.steps_seen = 0  # device step count already attributed
 
 

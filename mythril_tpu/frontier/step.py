@@ -1315,6 +1315,99 @@ def pull_arena_rows(dev_arena: ArenaDev, lo: int, hi: int):
     return [np.concatenate(c) if len(c) > 1 else c[0] for c in cols]
 
 
+# ---------------------------------------------------------------------------
+# Pipelined dispatch chaining (frontier/pipeline.py).  A chained dispatch
+# consumes the PREVIOUS segment's device outputs directly — no host sync —
+# and folds in the host's corrections (slots the last harvest mutated) via a
+# per-slot select.  Event buffers are rebuilt EMPTY for every slot at each
+# chained dispatch, exactly like push_state does for a full push: the
+# harvest drains them completely per segment, and letting them accumulate
+# across chained segments would overflow caps.EVT.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _merge_corrections(prev: FrontierState, corr: FrontierState,
+                       mask) -> FrontierState:
+    def pick(c, p):
+        m = mask.reshape((-1,) + (1,) * (p.ndim - 1))
+        return jnp.where(m, c, p)
+
+    merged = FrontierState(*[pick(c, p) for c, p in zip(corr, prev)])
+    return merged._replace(
+        events=jnp.full_like(prev.events, -1),
+        ev_len=jnp.zeros_like(prev.ev_len),
+    )
+
+
+def chain_dispatch(segment, prev_out, host_state: FrontierState,
+                   corr_mask: np.ndarray, code_dev, cfg,
+                   arena_override=None):
+    """Dispatch the next segment on the previous segment's device outputs.
+
+    ``prev_out`` is the 6-tuple a segment call returned (possibly still
+    un-materialized futures); ``host_state`` is the host mirror whose rows
+    are uploaded for the slots flagged in ``corr_mask``.  The upload is one
+    packed push_state transfer — the same cost the synchronous loop pays —
+    but the un-flagged slots keep the device's own (possibly further
+    advanced) values, so the device never waits for the host.
+    ``arena_override`` replaces the chained (dev_arena, arena_len) pair
+    after a sync-point host append (re-injection rows)."""
+    out_state, dev_arena, out_len, _n_exec, _max_live, visited = prev_out
+    if arena_override is not None:
+        dev_arena, out_len = arena_override
+    corr = push_state(host_state)
+    merged = _merge_corrections(out_state, corr, jax.device_put(corr_mask))
+    return segment(merged, dev_arena, out_len, visited, code_dev, cfg)
+
+
+# Host arena rows appended at a pipeline sync point (re-injected spills) are
+# shipped as fixed-shape chunks so the update program compiles once.
+REINJECT_CHUNK = 256
+
+
+@jax.jit
+def _write_arena_chunk(arena: ArenaDev, lo, op, a, b, c, width, val,
+                       isconst) -> ArenaDev:
+    def upd(dst, src):
+        return jax.lax.dynamic_update_slice_in_dim(dst, src, lo, 0)
+
+    return ArenaDev(
+        op=upd(arena.op, op), a=upd(arena.a, a), b=upd(arena.b, b),
+        c=upd(arena.c, c), width=upd(arena.width, width),
+        val=upd(arena.val, val), isconst=upd(arena.isconst, isconst),
+    )
+
+
+def push_arena_rows(dev_arena: ArenaDev, host_arena, lo: int,
+                    hi: int) -> ArenaDev:
+    """Write host arena rows [lo, hi) into the device arena.
+
+    ONLY safe at a pipeline sync point (no segment in flight): an in-flight
+    segment appends its own rows at the same indices.  Chunks are built from
+    the host mirror at a fixed REINJECT_CHUNK shape; rows below ``lo`` that
+    fall inside a clamped chunk are rewritten with their (identical) host
+    mirror values, rows beyond ``hi`` with the mirror's zero fill — both are
+    no-ops for decoding, which never follows references past arena length."""
+    cap = int(dev_arena.op.shape[0])
+    C = min(REINJECT_CHUNK, cap)
+    pos = lo
+    while pos < hi:
+        eff = min(pos, max(0, cap - C))  # dynamic_update_slice clamps
+        dev_arena = _write_arena_chunk(
+            dev_arena, eff,
+            jnp.asarray(host_arena.op[eff:eff + C]),
+            jnp.asarray(host_arena.a[eff:eff + C]),
+            jnp.asarray(host_arena.b[eff:eff + C]),
+            jnp.asarray(host_arena.c[eff:eff + C]),
+            jnp.asarray(host_arena.width[eff:eff + C]),
+            jnp.asarray(host_arena.val[eff:eff + C]),
+            jnp.asarray(host_arena.isconst[eff:eff + C]),
+        )
+        pos = eff + C
+    return dev_arena
+
+
 @lru_cache(maxsize=16)
 def cached_segment(caps: Caps, code_cap: int, instr_cap: int, addr_cap: int,
                    loops_cap: int):

@@ -81,6 +81,24 @@ class Walker:
         # arena row -> wrapper bound at a hook site (annotation carrier)
         self.bound: Dict[int, object] = {}
         self._anno_memo: Dict[int, frozenset] = {}
+        # optional park routing hook (frontier/pipeline.py): called as
+        # park_sink(laser, rec, carrier, snap) for parked carriers; a True
+        # return means the sink took ownership (e.g. queued the state for
+        # device re-injection) and the work-list append is skipped.  This
+        # decouples harvesting a park from injecting it back somewhere.
+        self.park_sink = None
+
+    def add_seed(self, laser, tables, carrier) -> int:
+        """Register a new seed mid-run (pipeline re-injection): appends to
+        every per-seed parallel list and returns the new seed index."""
+        idx = len(self.seeds)
+        self.seeds.append(carrier)
+        self.lasers.append(laser)
+        self.tables.append(tables)
+        self.gas_base.append(
+            (carrier.mstate.min_gas_used, carrier.mstate.max_gas_used)
+        )
+        return idx
 
     def laser_for(self, rec: PathRecord):
         return self.lasers[rec.seed_idx]
@@ -375,6 +393,13 @@ class Walker:
                 # engine._mid_eligible keeps the state host-side until the
                 # host engine advances it past the parking pc
                 carrier._frontier_park_pc = snap["pc"]
+            sink = self.park_sink
+            if sink is not None:
+                try:
+                    if sink(self.laser_for(rec), rec, carrier, snap):
+                        return
+                except Exception as e:  # pragma: no cover - defensive
+                    log.warning("park sink failed: %s", e)
             self.laser_for(rec).work_list.append(carrier)
             return
         log.warning("unhandled halt kind %d", halt)

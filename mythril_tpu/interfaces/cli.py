@@ -153,6 +153,31 @@ def _add_analysis_options(parser) -> None:
         "included)",
     )
     group.add_argument(
+        "--no-pipeline",
+        action="store_false",
+        dest="pipeline",
+        default=True,
+        help="disable the pipelined frontier (chained device dispatch + "
+        "background feasibility pool) and run the synchronous "
+        "segment/harvest loop; the issue set is identical either way",
+    )
+    group.add_argument(
+        "--solver-workers",
+        type=int,
+        default=2,
+        metavar="N",
+        help="feasibility-pool worker threads for the pipelined frontier "
+        "(solves are serialized by a shared lock — this moves solve "
+        "latency off the harvest critical path, not parallel solving)",
+    )
+    group.add_argument(
+        "--compile-cache-dir",
+        metavar="DIR",
+        help="persist XLA compilations in DIR and reuse them across "
+        "processes (skips segment recompiles on warm starts); default "
+        "off unless the MYTHRIL_TPU_COMPILATION_CACHE env var opts in",
+    )
+    group.add_argument(
         "--no-staticpass",
         action="store_true",
         help="disable the static bytecode pre-analysis pass (CFG + abstract-"
@@ -348,6 +373,9 @@ def _build_analyzer(parsed, query_signature: bool = False):
         query_cache=not getattr(parsed, "no_query_cache", False),
         query_cache_dir=getattr(parsed, "query_cache_dir", None),
         staticpass=not getattr(parsed, "no_staticpass", False),
+        pipeline=getattr(parsed, "pipeline", True),
+        solver_workers=getattr(parsed, "solver_workers", 2),
+        compile_cache_dir=getattr(parsed, "compile_cache_dir", None),
     )
     analyzer = MythrilAnalyzer(
         disassembler, cmd_args, strategy=parsed.strategy, address=address

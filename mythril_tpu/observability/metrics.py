@@ -17,10 +17,15 @@ deliberately process-persistent ``_SLOW_CODES`` / ``_NARROW_CODES``
 dicts in ``frontier/engine.py`` (a code that degenerated once must not
 be re-probed by the very next analysis in the same process).
 
-Thread-safety: metric mutation is plain attribute arithmetic guarded by
-the GIL, matching the guarantees of the singletons it replaces; registry
-*registration* is lock-protected because harvest worker threads may
-create metrics concurrently.
+Thread-safety: ``Counter.inc``, ``Histogram.observe`` and
+``LabeledCounter.inc`` are real read-modify-write cycles, and the
+pipelined frontier's feasibility pool mutates solver/querycache counters
+from worker threads — so all three take a shared module-level mutation
+lock (one uncontended lock acquire per increment; the hot paths increment
+at segment/query granularity, not per instruction).  Plain ``+=`` on a
+``LabeledCounter`` item and facade property writes remain main-thread
+constructs.  Registry *registration* is separately lock-protected because
+worker threads may create metrics concurrently.
 """
 
 from __future__ import annotations
@@ -41,6 +46,10 @@ __all__ = [
 
 Number = Union[int, float]
 
+# shared by every metric's mutators: increments are read-modify-write and
+# must be atomic across the feasibility-pool worker threads
+_MUTATION_LOCK = threading.Lock()
+
 
 class Counter:
     """Monotonic-by-convention accumulator; ``set()`` exists for facades.
@@ -59,7 +68,8 @@ class Counter:
         self.value: Number = initial
 
     def inc(self, n: Number = 1) -> None:
-        self.value += n
+        with _MUTATION_LOCK:
+            self.value += n
 
     def set(self, v: Number) -> None:
         self.value = v
@@ -109,6 +119,11 @@ class LabeledCounter(collections.Counter):
         self.name = name
         self.persistent = persistent
 
+    def inc(self, label: str, n: Number = 1) -> None:
+        """Thread-safe increment (``c[label] += n`` is not atomic)."""
+        with _MUTATION_LOCK:
+            self[label] = self.get(label, 0) + n
+
     def reset(self) -> None:
         self.clear()
 
@@ -152,13 +167,14 @@ class Histogram:
         self.max: Optional[float] = None
 
     def observe(self, v: float) -> None:
-        self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
-        self.count += 1
-        self.sum += v
-        if self.min is None or v < self.min:
-            self.min = v
-        if self.max is None or v > self.max:
-            self.max = v
+        with _MUTATION_LOCK:
+            self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
 
     def reset(self) -> None:
         self.bucket_counts = [0] * (len(self.buckets) + 1)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import os
 import random
+import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -70,7 +71,7 @@ class SolverStatistics:
 
     Thin facade over the ``solver.*`` metrics in the observability
     registry: each attribute is a property over a named counter, so the
-    ``stats.query_count += 1`` call sites (and tests that assign
+    ``stats.inc("query_count")`` call sites (and tests that assign
     directly) work unchanged while the numbers flow into
     ``--metrics-out`` / ``meta.observability`` snapshots.  ``enabled``
     is plain instance state, not telemetry, and survives resets.
@@ -93,6 +94,24 @@ class SolverStatistics:
             cls._instance.enabled = False
             cls._instance.reset()
         return cls._instance
+
+    # attribute -> (registry counter name, zero value); ``inc`` goes
+    # through ``Counter.inc`` (which holds the metrics mutation lock) so
+    # increments from feasibility-pool worker threads are atomic —
+    # ``stats.x += 1`` is a property get *then* set and can lose updates
+    # under concurrency
+    _counters = {
+        "query_count": ("solver.query_count", 0),
+        "solver_time": ("solver.solver_time_s", 0.0),
+        "probe_hits": ("solver.probe_hits", 0),
+        "cdcl_calls": ("solver.cdcl_calls", 0),
+        "unknown_as_unsat": ("solver.unknown_as_unsat", 0),
+    }
+
+    def inc(self, attr: str, n=1) -> None:
+        """Thread-safe ``attr += n`` (use instead of ``+=`` on solve paths)."""
+        name, initial = self._counters[attr]
+        _metrics_registry().counter(name, initial=initial).inc(n)
 
     def reset(self) -> None:
         """Zero the solver-scoped metrics (not the ``enabled`` switch)."""
@@ -1136,13 +1155,19 @@ def independence_split(conjuncts: Sequence[Term]) -> List[List[Term]]:
 
 _split_cache: Dict[frozenset, tuple] = {}
 
+# guards the compound mutations of the shared solver memos (_split_cache,
+# _ModelCache) against feasibility-pool worker threads; plain dict reads
+# stay lock-free (atomic under the GIL, and a stale miss is harmless)
+_cache_lock = threading.Lock()
+
 
 def _split_remember(key: frozenset, result: List[List[Term]]) -> None:
-    if len(_split_cache) >= 4096:
-        _split_cache.clear()
-    # tuples of tuples: the cache is shared, so accidental mutation by a
-    # future caller raises instead of corrupting unrelated queries
-    _split_cache[key] = tuple(tuple(group) for group in result)
+    with _cache_lock:
+        if len(_split_cache) >= 4096:
+            _split_cache.clear()
+        # tuples of tuples: the cache is shared, so accidental mutation by a
+        # future caller raises instead of corrupting unrelated queries
+        _split_cache[key] = tuple(tuple(group) for group in result)
 
 
 def _query_cache():
@@ -1198,7 +1223,7 @@ def _fast_path(
             except Exception:
                 continue
             if all(vals[c] for c in conj):
-                SolverStatistics().probe_hits += 1
+                SolverStatistics().inc("probe_hits")
                 _model_cache.remember(key, SAT, asg)
                 return (SAT, asg), conj, key
     return None, conj, key
@@ -1240,7 +1265,7 @@ def check_satisfiable_batch(
                 # a cached UNKNOWN served at this budget: the prune decision
                 # is the same unknown-as-unsat call the cold path would have
                 # made, and it must show in the same recall-risk counter
-                SolverStatistics().unknown_as_unsat += 1
+                SolverStatistics().inc("unknown_as_unsat")
             results[i] = resolved[0] == SAT
         else:
             pending.append((i, conj, key))
@@ -1273,7 +1298,7 @@ def check_satisfiable_batch(
                     still.append((i, conj, key))
                     continue
                 if sat_here:
-                    SolverStatistics().probe_hits += 1
+                    SolverStatistics().inc("probe_hits")
                     _model_cache.remember(key, SAT, asg)
                     results[i] = True
                 else:
@@ -1305,7 +1330,7 @@ def check_satisfiable_batch(
             # replay already happened batched above; don't repeat per set
             status, _ = solve_conjunction(conj, config, replay=False)
             if status == UNKNOWN:
-                SolverStatistics().unknown_as_unsat += 1
+                SolverStatistics().inc("unknown_as_unsat")
             results[i] = status == SAT
     return [bool(r) for r in results]
 
@@ -1345,7 +1370,7 @@ def _batch_probe_device(pending, results, config) -> None:
             except Exception:
                 continue
             if all(vals[c] for c in conj):
-                SolverStatistics().probe_hits += 1
+                SolverStatistics().inc("probe_hits")
                 _model_cache.remember(key, SAT, asg)
                 results[i] = True
                 break
@@ -1375,13 +1400,16 @@ class _ModelCache:
         self.max_results = max_results
 
     def remember(self, key: frozenset, status: str, asg: Optional[Assignment]):
-        if len(self.results) >= self.max_results:
-            self.results.clear()
-        self.results[key] = (status, asg)
-        if asg is not None:
-            self.models = [m for m in self.models if m is not asg]
-            self.models.append(asg)
-            del self.models[: -self.max_models]
+        with _cache_lock:
+            if len(self.results) >= self.max_results:
+                self.results = {}
+            self.results[key] = (status, asg)
+            if asg is not None:
+                # rebind rather than mutate in place: concurrent replay
+                # readers iterate whatever list they grabbed, untouched
+                models = [m for m in self.models if m is not asg]
+                models.append(asg)
+                self.models = models[-self.max_models:]
 
 
 _model_cache = _ModelCache()
@@ -1408,11 +1436,12 @@ def remember_model(conjuncts: Sequence[Term], assignment: Assignment) -> None:
 
 
 def clear_model_cache() -> None:
-    _model_cache.models.clear()
-    _model_cache.results.clear()
-    # the split memo holds Term DAGs: clear with the other solver caches so
-    # cold-cache measurements stay cold and dropped terms can be collected
-    _split_cache.clear()
+    with _cache_lock:
+        _model_cache.models = []
+        _model_cache.results = {}
+        # the split memo holds Term DAGs: clear with the other solver caches
+        # so cold-cache measurements stay cold and dropped terms collect
+        _split_cache.clear()
     # ditto the query cache's term-id-keyed fingerprint memos (its hash/
     # verdict layers hold no Terms and are reset separately — see
     # querycache.reset_query_cache)
@@ -1499,7 +1528,7 @@ def _solve_conjunction_impl(
 ) -> Tuple[str, Optional[Assignment]]:
     config = config or ProbeConfig()
     stats = SolverStatistics()
-    stats.query_count += 1
+    stats.inc("query_count")
     t0 = time.perf_counter()
 
     # tiers 0 + memo + query cache + 0.5 (shared with check_satisfiable_batch)
@@ -1522,10 +1551,10 @@ def _solve_conjunction_impl(
         for asg in gen.generate(8, deadline=t0 + config.timeout_ms / 2000.0):
             vals = evaluate(conjuncts, asg)
             if all(vals[c] for c in conjuncts):
-                stats.probe_hits += 1
+                stats.inc("probe_hits")
                 if use_cache:
                     _model_cache.remember(cache_key, SAT, asg)
-                stats.solver_time += time.perf_counter() - t0
+                stats.inc("solver_time", time.perf_counter() - t0)
                 return SAT, asg
 
     # tier 0.6: interval-bound refutation — exact UNSAT for range-impossible
@@ -1537,7 +1566,7 @@ def _solve_conjunction_impl(
     if _interval_refute(conjuncts):
         if use_cache:
             _model_cache.remember(cache_key, UNSAT, None)
-        stats.solver_time += time.perf_counter() - t0
+        stats.inc("solver_time", time.perf_counter() - t0)
         return UNSAT, None
 
     # tier 0.75: independence split (reference independence_solver.py:86-152)
@@ -1587,7 +1616,7 @@ def _solve_conjunction_impl(
         # the result cache for every later identical query)
         vals = evaluate(conjuncts, merged)
         if all(vals[c] for c in conjuncts):
-            stats.probe_hits += 1
+            stats.inc("probe_hits")
             if use_cache:
                 _model_cache.remember(cache_key, SAT, merged)
             return SAT, merged
@@ -1603,7 +1632,7 @@ def _solve_conjunction_impl(
             from mythril_tpu.native import bitblast
 
             if bitblast.available():
-                stats.cdcl_calls += 1
+                stats.inc("cdcl_calls")
                 with _otrace.span("smt.cdcl", cat="smt", forced=True):
                     status, asg = bitblast.solve(
                         conjuncts,
@@ -1619,7 +1648,7 @@ def _solve_conjunction_impl(
                     result = (UNSAT, None)
         except ImportError:
             pass
-        stats.solver_time += time.perf_counter() - t0
+        stats.inc("solver_time", time.perf_counter() - t0)
         return result
 
     if gen is None:
@@ -1694,8 +1723,8 @@ def _solve_conjunction_impl(
                 if scores[b] < len(conjuncts):
                     break
                 if check_asg(candidates[b]):
-                    stats.probe_hits += 1
-                    stats.solver_time += time.perf_counter() - t0
+                    stats.inc("probe_hits")
+                    stats.inc("solver_time", time.perf_counter() - t0)
                     _model_cache.remember(cache_key, SAT, candidates[b])
                     return SAT, candidates[b]
                 if time.perf_counter() > deadline:
@@ -1723,8 +1752,8 @@ def _solve_conjunction_impl(
                 continue
             score = sum(1 for c in conjuncts if vals[c])
             if score == len(conjuncts):
-                stats.probe_hits += 1
-                stats.solver_time += time.perf_counter() - t0
+                stats.inc("probe_hits")
+                stats.inc("solver_time", time.perf_counter() - t0)
                 _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
             if score > best_score:
@@ -1758,8 +1787,8 @@ def _solve_conjunction_impl(
             vals = evaluate(conjuncts, asg)
             score = sum(1 for c in conjuncts if vals[c])
             if score == len(conjuncts):
-                stats.probe_hits += 1
-                stats.solver_time += time.perf_counter() - t0
+                stats.inc("probe_hits")
+                stats.inc("solver_time", time.perf_counter() - t0)
                 _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
             if score >= best_score:
@@ -1770,7 +1799,7 @@ def _solve_conjunction_impl(
         from mythril_tpu.native import bitblast
 
         if bitblast.available():
-            stats.cdcl_calls += 1
+            stats.inc("cdcl_calls")
             budget = deadline - time.perf_counter()
             if compiled is not None or config.prune_critical:
                 # device-path queries may have burned the deadline on an XLA
@@ -1782,7 +1811,7 @@ def _solve_conjunction_impl(
                 budget = max(1.0, budget)
             with _otrace.span("smt.cdcl", cat="smt", conjuncts=len(conjuncts)):
                 status, asg = bitblast.solve(conjuncts, budget)
-            stats.solver_time += time.perf_counter() - t0
+            stats.inc("solver_time", time.perf_counter() - t0)
             if status == SAT and asg is not None and check_asg(asg):
                 _model_cache.remember(cache_key, SAT, asg)
                 return SAT, asg
@@ -1794,7 +1823,7 @@ def _solve_conjunction_impl(
     except ImportError:
         pass
 
-    stats.solver_time += time.perf_counter() - t0
+    stats.inc("solver_time", time.perf_counter() - t0)
     return UNKNOWN, None
 
 
@@ -1927,7 +1956,7 @@ class Optimize(Solver):
         def ask_op(op: str, v: int):
             bt = bound_term(op, v)
             if session is not None:
-                SolverStatistics().cdcl_calls += 1
+                SolverStatistics().inc("cdcl_calls")
                 budget = max(0.05, min(
                     self.config.timeout_ms / 4000.0, deadline - time.perf_counter()
                 ))
@@ -2049,7 +2078,7 @@ class Optimize(Solver):
                     log.debug("optimize session unavailable: %s", e)
                     session = None
         if status == UNKNOWN and session is not None:
-            SolverStatistics().cdcl_calls += 1
+            SolverStatistics().inc("cdcl_calls")
             st, a = session.solve(
                 [], max(0.05, min(self.config.timeout_ms / 2000.0,
                                   deadline - time.perf_counter())),

@@ -80,6 +80,17 @@ class Args:
     # packed device events.  Over-approximate — the issue set is identical
     # either way; --no-staticpass is the escape hatch
     staticpass: bool = True
+    # pipelined frontier (mythril_tpu/frontier/pipeline): overlap device
+    # segments with host harvest/solve via chained dispatch + a background
+    # feasibility pool.  Issue-set-identical to the synchronous loop;
+    # --no-pipeline is the escape hatch (and the parity baseline)
+    pipeline: bool = True
+    # feasibility-pool worker threads (solves share one lock — the win is
+    # moving solve latency off the harvest critical path, not parallelism)
+    solver_workers: int = 2
+    # persistent XLA compilation cache directory (None = off unless the
+    # MYTHRIL_TPU_COMPILATION_CACHE env var opts in)
+    compile_cache_dir: Optional[str] = None
 
 
 args = Args()

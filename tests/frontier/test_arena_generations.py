@@ -1,0 +1,60 @@
+"""HostArena generation isolation and the pipelined freeze guard.
+
+The double-buffered pipeline keeps two analyses' arenas alive at once
+(engine N's drain overlapping engine N+1's seeding); rows, memos and
+records must never alias across instances, and host appends must be
+impossible while a device segment owns the append indices.
+"""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.arena import HostArena
+
+
+def test_generations_share_no_buffers():
+    a = HostArena(cap=64)
+    b = HostArena(cap=64)
+    assert a.generation != b.generation, "generation ids must be unique"
+    for name in ("op", "a", "b", "c", "width", "val", "isconst", "taint"):
+        col_a, col_b = getattr(a, name), getattr(b, name)
+        assert col_a is not col_b
+        assert not np.shares_memory(col_a, col_b), (
+            f"column {name} aliases across generations"
+        )
+
+
+def test_const_interning_is_per_instance():
+    a = HostArena(cap=64)
+    b = HostArena(cap=64)
+    row_a = a.const_row(0xDEAD)
+    assert a.const_row(0xDEAD) == row_a, "interning memo broken"
+    # b never saw the append: its memo and columns are untouched
+    assert b.length < a.length
+    row_b = b.const_row(0xBEEF)
+    assert a.val[row_a, 0] != b.val[row_b, 0]
+    assert b._const_memo is not a._const_memo
+
+
+def test_freeze_blocks_appends_until_thaw():
+    arena = HostArena(cap=64)
+    arena.const_row(1)
+    arena.freeze()
+    with pytest.raises(RuntimeError, match="frozen"):
+        arena._append(O.A_CONST, width=256, value=99)
+    with pytest.raises(RuntimeError, match="frozen"):
+        arena.const_row(99)  # un-memoized const must append, so it raises
+    n = arena.length
+    arena.thaw()
+    arena.const_row(99)
+    assert arena.length == n + 1
+
+
+def test_freeze_does_not_block_memoized_reads():
+    arena = HostArena(cap=64)
+    row = arena.const_row(7)
+    arena.freeze()
+    # interned row already exists: lookup is read-only and must survive
+    assert arena.const_row(7) == row
+    arena.thaw()

@@ -1,0 +1,76 @@
+"""Concurrency: counters hammered from 8 threads must not lose updates.
+
+The pipelined frontier's feasibility pool mutates solver and querycache
+counters from worker threads; ``x += 1`` on a shared attribute is a lost
+update waiting to happen, so the registry's mutators (Counter.inc,
+Histogram.observe, LabeledCounter.inc) and the SolverStatistics facade's
+``inc`` must be atomic.  Exact totals are asserted — a single lost
+increment fails the test.
+"""
+
+import threading
+
+from mythril_tpu.observability.metrics import get_registry
+
+N_THREADS = 8
+N_ITER = 2000
+
+
+def _hammer(fn):
+    barrier = threading.Barrier(N_THREADS)
+
+    def run():
+        barrier.wait()  # maximize interleaving
+        for _ in range(N_ITER):
+            fn()
+
+    threads = [threading.Thread(target=run) for _ in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def test_counter_inc_is_atomic():
+    reg = get_registry()
+    c = reg.counter("test.concurrency.counter")
+    c.reset()
+    _hammer(lambda: c.inc())
+    assert c.value == N_THREADS * N_ITER
+
+
+def test_labeled_counter_inc_is_atomic():
+    reg = get_registry()
+    lc = reg.labeled_counter("test.concurrency.labeled")
+    lc.reset()
+    _hammer(lambda: lc.inc("x"))
+    assert lc["x"] == N_THREADS * N_ITER
+
+
+def test_histogram_observe_is_atomic():
+    reg = get_registry()
+    h = reg.histogram("test.concurrency.hist")
+    h.reset()
+    _hammer(lambda: h.observe(0.003))
+    assert h.count == N_THREADS * N_ITER
+    assert abs(h.sum - 0.003 * N_THREADS * N_ITER) < 1e-6
+    assert sum(h.bucket_counts) == N_THREADS * N_ITER
+
+
+def test_solver_statistics_inc_is_atomic():
+    from mythril_tpu.smt.solver import SolverStatistics
+
+    stats = SolverStatistics()
+    stats.reset()
+    _hammer(lambda: stats.inc("query_count"))
+    _hammer(lambda: stats.inc("solver_time", 0.001))
+    assert stats.query_count == N_THREADS * N_ITER
+    assert abs(stats.solver_time - 0.001 * N_THREADS * N_ITER) < 1e-6
+
+
+def test_querycache_counters_are_atomic():
+    reg = get_registry()
+    c = reg.counter("querycache.lookups")
+    base = c.value
+    _hammer(lambda: c.inc())
+    assert c.value - base == N_THREADS * N_ITER
