@@ -408,6 +408,120 @@ def pipeline_compare() -> dict:
     return {"metric": "pipeline_compare", "workloads": results}
 
 
+_HARVEST_PHASES = ("ingest", "solver", "replay", "commit")
+
+
+def harvest_compare() -> dict:
+    """Sharded vs serial harvest on a multi-tx and a fork-heavy workload.
+
+    Runs each workload twice with the device frontier forced on — once with
+    the sharded harvest executor (``--harvest-workers 4``), once serial
+    (``--harvest-workers 0``) — and asserts the correctness contract: the
+    issue sets are IDENTICAL while the sharded run actually dispatched
+    replays to the pool.  Reports per-mode walls, states/sec, the harvest
+    wall share, and the per-phase ``frontier.harvest.*_s`` attribution that
+    says where the remaining harvest time goes.  Returns (and ``main``
+    prints) one JSON-able dict."""
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.frontier import engine as _eng
+    from mythril_tpu.frontier.stats import FrontierStatistics
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.support.support_args import args as global_args
+
+    def issue_set(issues):
+        return sorted((i.swc_id, i.address) for i in issues)
+
+    workloads = [
+        # (name, contract-or-code, tx_count, modules, recall swc)
+        ("killbilly",
+         EVMContract(code=KILLBILLY, creation_code=KILLBILLY_CREATION,
+                     name="KillBilly"),
+         3, ["AccidentallyKillable"], "106"),
+        # 256 concurrent fork-chained paths: the harvest-bound shape
+        ("wide_fork", _wide_contract(8), 1, ["AccidentallyKillable"], "106"),
+    ]
+
+    def one_run(target, txs, modules, workers: int):
+        global_args.harvest_workers = workers
+        _clear_caches()
+        _eng._SLOW_CODES.clear()
+        _eng._NARROW_CODES.clear()
+        _eng._SLOW_SEGMENTS.clear()
+        reg = get_registry()
+        fstats = FrontierStatistics()
+        har_before = fstats.harvest_s
+        phases_before = {
+            p: reg.histogram("frontier.harvest.%s_s" % p).sum
+            for p in _HARVEST_PHASES
+        }
+        sharded_before = reg.counter("frontier.harvest.sharded_paths").value
+        t0 = time.time()
+        sym, issues = _analyze(target, 0x0901D12E, txs, modules=modules,
+                               timeout=300)
+        wall = time.time() - t0
+        phases = {
+            p: round(
+                reg.histogram("frontier.harvest.%s_s" % p).sum
+                - phases_before[p], 4,
+            )
+            for p in _HARVEST_PHASES
+        }
+        return {
+            "issues": issue_set(issues),
+            "wall_s": round(wall, 3),
+            "states_per_sec": round(sym.laser.total_states / wall, 1)
+            if wall > 0 else 0.0,
+            "harvest_share_pct": round(
+                100 * (fstats.harvest_s - har_before) / wall, 1
+            ) if wall > 0 else 0.0,
+            "harvest_phase_s": phases,
+            "sharded_paths": int(
+                reg.counter("frontier.harvest.sharded_paths").value
+                - sharded_before
+            ),
+        }
+
+    prev = (global_args.harvest_workers, global_args.frontier,
+            global_args.frontier_force, global_args.frontier_width)
+    results = {}
+    try:
+        global_args.probe_backend = "auto"
+        global_args.frontier = True
+        global_args.frontier_force = True  # small contracts: bypass gates
+        global_args.frontier_width = 64
+        # warm the jitted programs outside the timers (both modes run the
+        # SAME device program; only the host harvest differs)
+        one_run(_wide_contract(4), 1, ["AccidentallyKillable"], 4)
+        for name, target, txs, modules, swc in workloads:
+            sharded = one_run(target, txs, modules, 4)
+            serial = one_run(target, txs, modules, 0)
+            assert any(s == swc for s, _ in sharded["issues"]), (
+                f"{name}: sharded harvest lost recall: {sharded['issues']}"
+            )
+            assert sharded["issues"] == serial["issues"], (
+                f"{name}: sharded harvest changed the issue set: "
+                f"{sharded['issues']} != {serial['issues']}"
+            )
+            assert sharded["sharded_paths"] > 0, (
+                f"{name}: sharded run never dispatched a replay shard"
+            )
+            assert serial["sharded_paths"] == 0, (
+                f"{name}: serial run used the replay pool"
+            )
+            results[name] = {
+                "sharded": sharded,
+                "serial": serial,
+                "speedup": round(
+                    sharded["states_per_sec"]
+                    / max(serial["states_per_sec"], 1e-9), 3,
+                ),
+            }
+    finally:
+        (global_args.harvest_workers, global_args.frontier,
+         global_args.frontier_force, global_args.frontier_width) = prev
+    return {"metric": "harvest_compare", "workloads": results}
+
+
 # ---------------------------------------------------------------------------
 # workloads
 # ---------------------------------------------------------------------------
@@ -945,8 +1059,10 @@ def _new_row_data():
         "ttfrs": {"baseline": [], "production": []},
         "residency": [],
         "harvest_shares": [],
+        "harvest_phases": [],  # per-production-rep {phase: seconds} deltas
         "mids": [],  # per-production-rep (mid_reentered, mid_bounced, semantic_parked)
         "completed_reps": 0,
+        "trimmed_reps": [],  # rep numbers the budget clock dropped
     }
 
 
@@ -971,12 +1087,23 @@ def _row_summary(unit: str, d: dict) -> dict:
         if rates.get("baseline") and "production" in rates
         else None,
         "reps": d["completed_reps"],
-        # per-row spread: the honest error bars round 3 lacked
+        # per-row spread: the honest error bars round 3 lacked.  A spread
+        # over fewer samples than the workload's configured reps is marked
+        # by spread_n + the budget-trimmed rep numbers, so 2-rep data never
+        # silently reads as the full-rep figure again (BENCH_r05).
         "spread": {
             tag: [round(min(vals), 2), round(max(vals), 2)]
             for tag, vals in samples.items()
             if vals
         },
+        "spread_n": {
+            tag: len(vals) for tag, vals in samples.items() if vals
+        },
+        **(
+            {"trimmed_reps": list(d["trimmed_reps"])}
+            if d.get("trimmed_reps")
+            else {}
+        ),
         "ttfe_s": {
             tag: (round(v, 3) if v is not None else None)
             for tag, v in med_ttfe.items()
@@ -1006,6 +1133,19 @@ def _row_summary(unit: str, d: dict) -> dict:
             round(100 * _median(d["harvest_shares"]), 1)
             if d["harvest_shares"]
             else None
+        ),
+        # the harvest share split per executor phase (median across
+        # production reps of the frontier.harvest.*_s histogram deltas):
+        # which of ingest / solver / replay / commit owns the host cost
+        **(
+            {
+                "harvest_phase_s": {
+                    p: round(_median([h[p] for h in d["harvest_phases"]]), 3)
+                    for p in _HARVEST_PHASES
+                }
+            }
+            if d["harvest_phases"]
+            else {}
         ),
         # mid-frame residency (production runs): how many parked/resumed
         # states re-entered the device vs bounced at encoding vs stayed
@@ -1112,6 +1252,11 @@ def main() -> None:
         print(json.dumps(pipeline_compare()), flush=True)
         return
 
+    if "--harvest-compare" in sys.argv:
+        # standalone sharded-vs-serial harvest parity mode: one line
+        print(json.dumps(harvest_compare()), flush=True)
+        return
+
     # suite-internal budget clock (monotonic); the per-workload t0 stamps
     # stay time.time() because _ttfe/_rebase_stamp compare them against the
     # epoch-anchored report.StartTime discovery stamps
@@ -1153,16 +1298,27 @@ def main() -> None:
                 continue
             est = pair_cost.get(name, 0.0)
             if rep > 0 and time.perf_counter() + est > deadline:
-                # deterministic trim: later reps go first, rep 1 never does
+                # deterministic trim: later reps go first, rep 1 never does;
+                # the row's own summary carries the trimmed rep numbers so
+                # its spread is readable as N-rep data
                 trimmed.append({"workload": name, "rep": rep + 1})
+                data[name]["trimmed_reps"].append(rep + 1)
                 continue
             d = data[name]
             t_pair = time.perf_counter()
             for tag, production in (("baseline", False), ("production", True)):
+                from mythril_tpu.observability import get_registry
+
                 fstats = FrontierStatistics()
                 dev_before = fstats.device_instructions
                 har_before = fstats.harvest_s
                 mid_before = _mid_counters(fstats)
+                phases_before = {
+                    p: get_registry().histogram(
+                        "frontier.harvest.%s_s" % p
+                    ).sum
+                    for p in _HARVEST_PHASES
+                }
                 out = fn(production)
                 work, wall, ttfe = out[:3]
                 d["samples"][tag].append(work / wall if wall > 0 else 0.0)
@@ -1192,6 +1348,12 @@ def main() -> None:
                         else fstats.harvest_s - har_before
                     )
                     d["harvest_shares"].append(har / wall)
+                    d["harvest_phases"].append({
+                        p: get_registry().histogram(
+                            "frontier.harvest.%s_s" % p
+                        ).sum - phases_before[p]
+                        for p in _HARVEST_PHASES
+                    })
                 if production:
                     # a workload with an internal warm-up supplies its own
                     # timed-run delta (out[6]), mirroring out[3]/out[4]

@@ -28,6 +28,7 @@ is a fast path, never a semantics fork.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -57,6 +58,7 @@ from mythril_tpu.frontier.code import (
     multi_size_bucket,
     stacked_device_tables,
 )
+from mythril_tpu.frontier.harvest import HarvestExecutor
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
 from mythril_tpu.frontier.stats import FrontierStatistics
@@ -875,6 +877,36 @@ class FrontierEngine:
         if mesh is None and bucket != natural_bucket and not program_warm:
             nat_cc, nat_ic, _nat_ac, nat_lc = natural_bucket
             stats = FrontierStatistics()
+
+            # pre-compile the floored big-bucket program in the background
+            # while the opening natural-bucket segment runs: a dummy
+            # dispatch on an all-empty state (every seed -1, so the segment
+            # while_loop condition is false and zero steps execute) pays
+            # exactly the XLA compile.  cached_segment only builds the
+            # wrapper and jit.lower().compile() does not populate the
+            # dispatch cache, so a real dispatch is the reliable warmup.
+            # Inputs are shared with the live dispatch safely: the segment
+            # donates nothing (_SEGMENT_DONATE_ARGNUMS is empty).
+            def _precompile_floored():
+                t0 = time.perf_counter()
+                try:
+                    out = segment(
+                        push_state(empty_state(caps, loops_cap)), dev_arena,
+                        arena_len, visited, code_dev, cfg,
+                    )
+                    np.asarray(out[3])  # force completion
+                except Exception as e:  # pragma: no cover - diagnostics
+                    log.debug("floored-bucket precompile failed: %s", e)
+                _get_metrics().observe(
+                    "frontier.bucket_compile_s", time.perf_counter() - t0
+                )
+
+            precompile = threading.Thread(
+                target=_precompile_floored,
+                name="mythril-bucket-precompile",
+                daemon=True,
+            )
+            precompile.start()
             nat_segment = cached_segment(caps, *natural_bucket)
             nat_code_dev = CodeDev(*[
                 jax.device_put(a)
@@ -929,6 +961,11 @@ class FrontierEngine:
             max_live = max(max_live, live)
             if live == 0 and not seed_queue:
                 skip_loop = True  # nothing left for the floored program
+            else:
+                # the floored program dispatches next: join so its compile
+                # time lands in bucket_compile_s and the first real segment
+                # below measures dispatch, not compile
+                precompile.join()
 
         if not skip_loop and args.pipeline and mesh is None:
             from mythril_tpu.frontier.pipeline import PipelinedRunner
@@ -1186,109 +1223,12 @@ class FrontierEngine:
         """``pipe`` is the PipelinedRunner when the pipelined loop drives
         this harvest: slot mutations are reported to its correction ledger
         (so they ride the next chained dispatch) and feasibility checks go
-        to its background pool instead of blocking here."""
-        caps = self.caps
-        # 1. append new events and create child records.  A fork event makes
-        # a fresh slot scannable, and that child may itself have forked in
-        # the same segment — iterate until no new records appear.
-        changed = True
-        while changed:
-            changed = False
-            for slot in range(caps.B):
-                rec = records[slot]
-                if rec is None:
-                    continue
-                n_ev = int(st.ev_len[slot])
-                for k in range(int(ev_seen[slot]), n_ev):
-                    ev = st.events[slot, k].copy()
-                    ev_idx = len(rec.events)
-                    rec.events.append(ev)
-                    if (
-                        int(ev[O.EV_KIND]) == O.E_FORK
-                        and int(ev[O.EV_EXTRA]) >= 0
-                    ):
-                        child_slot = int(ev[O.EV_EXTRA])
-                        child = PathRecord(
-                            seed_idx=rec.seed_idx,
-                            parent=rec,
-                            fork_event_idx=ev_idx,
-                        )
-                        rec.children_by_event[ev_idx] = child
-                        records[child_slot] = child
-                        ev_seen[child_slot] = 0
-                        changed = True
-                ev_seen[slot] = n_ev
+        to its background pool instead of blocking here.
 
-        # 1b. per-laser total_states attribution from the device step
-        # counters (the host engine counts every state it steps; the device
-        # equivalent is instructions executed per path)
-        for slot in range(caps.B):
-            rec = records[slot]
-            if rec is None:
-                continue
-            delta = int(st.steps[slot]) - rec.steps_seen
-            if delta > 0:
-                rec.steps_seen = int(st.steps[slot])
-                walker.lasers[rec.seed_idx].total_states += delta
-
-        # 2b. feasibility prune: the host engine drops unsat successors at
-        # every fork (svm._prune_unsatisfiable); the frontier batches the
-        # same check per segment over every still-running path whose
-        # constraint list grew, freeing slots that can never terminate
-        if not args.sparse_pruning:
-            self._prune_running(st, records, walker, ev_seen, pipe)
-
-        # 2c. batch the mutation-pruner's tx-end queries: walker replay fires
-        # add_world_state once per terminal path, and each unmutated path
-        # asks the solver "can callvalue exceed 0 on this path?" — solved
-        # one at a time that is the harvest hot spot (profiled at ~80% of
-        # wide-frontier wall time).  One batched probe here warms the solver
-        # memo so the per-path hook hits cache.
-        self._prefetch_mutation_checks(st, records, walker)
-
-        # 3. finish halted paths (terminals park/replay through the walker)
-        for slot in range(caps.B):
-            rec = records[slot]
-            if rec is None:
-                continue
-            halt = int(st.halt[slot])
-            if halt == O.H_RUNNING:
-                continue
-            if halt == O.H_PENDING_FORK:
-                # slots freed this harvest: just resume next segment
-                still_free = any(
-                    records[s] is None for s in range(caps.B) if s != slot
-                )
-                if still_free:
-                    st.halt[slot] = O.H_RUNNING
-                    if pipe is not None:
-                        pipe.ledger.touch(slot)
-                    continue
-                # batch saturated: spill to the host engine
-            rec.final = snapshot_slot(st, slot)
-            stats = FrontierStatistics()
-            stats.device_paths += 1
-            if halt == O.H_PENDING_FORK:
-                rec.final["halt"] = O.H_PARK
-                stats.record_bulk_park("batch-full")
-            elif halt == O.H_PARK:
-                pc = int(rec.final["pc"])
-                names = walker.tables_for(rec).opcode_names
-                stats.record_park(names[pc] if pc < len(names) else "?")
-                # semantic park: re-injecting at this pc would immediately
-                # re-park — the walker stamps the carrier so _mid_eligible
-                # holds it host-side until the host steps past the pc
-                rec.final["semantic_park"] = True
-                stats.semantic_parks += 1
-            try:
-                walker.finish(rec)
-            except Exception as e:  # pragma: no cover - diagnostics
-                log.warning("frontier walker failed on a path: %s", e, exc_info=True)
-            records[slot] = None
-            clear_slot(st, slot)
-            ev_seen[slot] = 0
-            if pipe is not None:
-                pipe.ledger.touch(slot)
+        The phase work lives in frontier/harvest.py: vectorized event
+        ingestion, the laser-affinity replay pool (args.harvest_workers;
+        0 = serial), and the deterministic slot-order commit."""
+        HarvestExecutor(self).harvest(st, records, walker, ev_seen, pipe)
 
     @staticmethod
     def _run_microbench(segment, micro_args, n_exec: int, st, reps: int = 4) -> None:
@@ -1402,7 +1342,7 @@ class FrontierEngine:
             try:
                 raws = list(seed.world_state.constraints.get_all_raw())
                 raws += [
-                    walker.decode_wrapped(r).raw
+                    walker.decode_wrapped(r, rec.seed_idx).raw
                     for r in self._lineage_constraint_rows(rec)
                 ]
             except Exception as e:
@@ -1452,7 +1392,7 @@ class FrontierEngine:
             raws = list(seed.world_state.constraints.get_all_raw())
             try:
                 raws += [
-                    walker.decode_wrapped(int(r)).raw
+                    walker.decode_wrapped(int(r), rec.seed_idx).raw
                     for r in st.cons[slot, :n_cons]
                 ]
             except Exception as e:

@@ -1,0 +1,313 @@
+"""Sharded harvest executor: vectorized ingestion + affinity replay workers.
+
+The harvest — drain device events into path records, replay finished paths
+through the walker, recycle slots — is the host side of every segment and
+the measured critical path once the pipeline keeps the device busy
+(harvest_share_pct at 43-69% of wall on wide workloads, BENCH_r05).  This
+module replaces engine._harvest's three hot pieces:
+
+1. **Vectorized event ingestion** (``ingest_events``).  The per-slot
+   ``for slot / for k`` Python loops become one NumPy batch decode over the
+   event buffer: mask-select every unseen row in one fancy-index gather
+   (``np.nonzero`` yields them already sorted by slot, then k), split the
+   gather per slot, and detect fork events over the whole batch at once.
+   Fork->child chains — a child slot becoming scannable inside the same
+   segment that created it — resolve with an iterative frontier over the
+   newly created child slots instead of the old ``while changed`` rescan of
+   all B slots.  Each slot is scanned exactly once per harvest.
+
+2. **Seed-affinity replay workers** (``HarvestExecutor``).  Terminal
+   ``walker.finish`` replays shard across a persistent thread pool.  The
+   shard key is the *laser* owning ``rec.seed_idx``: every seed belongs to
+   exactly one laser, a laser's seeds always land in the same shard, and a
+   shard's records replay sequentially in slot order — so no two workers
+   ever touch the same LaserEVM/plugin state, no locks on laser internals.
+   Cross-laser shared state is covered elsewhere: metrics and the solver /
+   query-cache memos are lock-guarded (PR-4), the term intern table is
+   lock-guarded (this PR), and the walker's row-binding tables are
+   partitioned per laser (walker._binding) so decode closures never race.
+
+3. **Deterministic slot-order commit.**  Everything order-sensitive stays
+   on the main thread, in slot order, exactly like the serial sweep:
+   pending-fork resume decisions (which see the frees of earlier finishing
+   slots, replicated with a running free counter), final-state snapshots,
+   park stamps (``record_park`` / ``record_bulk_park``), walker ``commit``
+   (park-sink routing), slot clears and correction-ledger touches.  Issue
+   sets, park stamps, and ttfe events are bit-identical to
+   ``--harvest-workers 0``; the parity tests in
+   tests/frontier/test_harvest.py assert it differentially.
+
+Phase timings land in the ``frontier.harvest.{ingest,solver,replay,
+commit}_s`` histograms (the split of the old harvest_wall_s aggregate).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mythril_tpu.frontier import ops as O
+from mythril_tpu.frontier.records import PathRecord, snapshot_slot
+from mythril_tpu.frontier.state import FrontierState, clear_slot
+from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability.metrics import get_registry as _get_metrics
+from mythril_tpu.support.support_args import args
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# vectorized event ingestion
+# ---------------------------------------------------------------------------
+
+
+def ingest_events(st: FrontierState, records: Dict[int, Optional[PathRecord]],
+                  ev_seen: np.ndarray) -> int:
+    """Append every unseen event row to its slot's record; create child
+    records for granted forks.  Returns the number of rows ingested.
+
+    Equivalent to the serial reference (slot-order scan, repeated until no
+    new record appears) by construction: rows append to each record in
+    per-slot k order, ``children_by_event`` keys are the parent-stream
+    indices at append time, and a child created by a fork event joins the
+    next frontier wave with ``ev_seen = 0`` — its same-segment events are
+    scanned exactly once, just like the rescan would."""
+    B, EVT, _EVW = st.events.shape
+    ev_len = np.minimum(np.asarray(st.ev_len, np.int64), EVT)
+    frontier = [s for s in range(B) if records[s] is not None]
+    col = np.arange(EVT)
+    ingested = 0
+    while frontier:
+        sel = np.zeros(B, bool)
+        sel[frontier] = True
+        want = sel[:, None] & (col >= ev_seen[:, None]) & (col < ev_len[:, None])
+        slots, ks = np.nonzero(want)  # row-major: sorted by slot, then k
+        # one batch gather copies every new row at once; iterating the
+        # result yields per-event views of the copy (read-only downstream)
+        rows = st.events[slots, ks]
+        next_frontier: List[int] = []
+        if slots.size:
+            is_fork = (rows[:, O.EV_KIND] == O.E_FORK) & (rows[:, O.EV_EXTRA] >= 0)
+            uniq, starts = np.unique(slots, return_index=True)
+            bounds = np.append(starts, slots.size)
+            for i, s in enumerate(uniq):
+                lo, hi = int(bounds[i]), int(bounds[i + 1])
+                rec = records[s]
+                base = len(rec.events)
+                rec.events.extend(rows[lo:hi])
+                for j in np.flatnonzero(is_fork[lo:hi]):
+                    ev_idx = base + int(j)
+                    child_slot = int(rows[lo + j, O.EV_EXTRA])
+                    child = PathRecord(
+                        seed_idx=rec.seed_idx,
+                        parent=rec,
+                        fork_event_idx=ev_idx,
+                    )
+                    rec.children_by_event[ev_idx] = child
+                    records[child_slot] = child
+                    ev_seen[child_slot] = 0
+                    next_frontier.append(child_slot)
+            ingested += int(slots.size)
+        ev_seen[frontier] = ev_len[frontier]
+        frontier = next_frontier
+    return ingested
+
+
+def attribute_steps(st: FrontierState,
+                    records: Dict[int, Optional[PathRecord]],
+                    walker) -> None:
+    """Per-laser total_states attribution from the device step counters,
+    batch-computed (the host engine counts every state it steps; the device
+    equivalent is instructions executed per path)."""
+    B = st.steps.shape[0]
+    active = [s for s in range(B) if records[s] is not None]
+    if not active:
+        return
+    steps = np.asarray(st.steps)[active]
+    seen = np.fromiter(
+        (records[s].steps_seen for s in active), np.int64, len(active)
+    )
+    for i in np.flatnonzero(steps > seen):
+        s = active[int(i)]
+        rec = records[s]
+        rec.steps_seen = int(steps[i])
+        walker.lasers[rec.seed_idx].total_states += int(steps[i] - seen[i])
+
+
+# ---------------------------------------------------------------------------
+# the executor
+# ---------------------------------------------------------------------------
+
+
+def _replay_group(walker, recs: List[PathRecord]) -> None:
+    """Replay one laser shard's finished records, in slot order.  Exceptions
+    poison only the failing record (stored for the commit phase to log) —
+    the serial sweep's try/except around walker.finish, moved per record."""
+    for rec in recs:
+        try:
+            walker.replay(rec)
+        except Exception as e:
+            rec._replay_err = e
+
+
+# The replay pool is process-wide and persistent (spawning threads per
+# harvest would cost more than short replays take); it is resized lazily
+# when --harvest-workers changes between analyses (bench compare modes)
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_size = 0
+
+
+def _shared_pool(workers: int) -> Optional[ThreadPoolExecutor]:
+    global _pool, _pool_size
+    if workers <= 0:
+        return None
+    if _pool is None or _pool_size != workers:
+        if _pool is not None:
+            _pool.shutdown(wait=True)
+        _pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="mythril-harvest"
+        )
+        _pool_size = workers
+    return _pool
+
+
+def shutdown_replay_pool() -> None:
+    """Drain and drop the shared replay pool (test isolation hook)."""
+    global _pool, _pool_size
+    if _pool is not None:
+        _pool.shutdown(wait=True)
+        _pool = None
+        _pool_size = 0
+
+
+class HarvestExecutor:
+    """Drives the ingest -> solve -> replay -> commit phases of a harvest,
+    sharding the replay phase over the shared pool.
+
+    ``workers == 0`` is the serial escape hatch (``--harvest-workers 0``):
+    the same phase structure, replayed inline on the main thread — the
+    differential baseline the sharded mode must match bit-for-bit."""
+
+    def __init__(self, engine, workers: Optional[int] = None):
+        self.engine = engine
+        if workers is None:
+            workers = getattr(args, "harvest_workers", 0)
+        self.workers = max(0, int(workers))
+
+    # -- phases ---------------------------------------------------------
+
+    def harvest(self, st: FrontierState, records, walker,
+                ev_seen: np.ndarray, pipe=None) -> None:
+        """Full harvest of one pulled segment (engine._harvest semantics)."""
+        eng = self.engine
+        caps = eng.caps
+        reg = _get_metrics()
+        stats = FrontierStatistics()
+
+        t0 = time.perf_counter()
+        ingest_events(st, records, ev_seen)
+        attribute_steps(st, records, walker)
+        t1 = time.perf_counter()
+        reg.observe("frontier.harvest.ingest_s", t1 - t0)
+
+        # feasibility prune + mutation-check prefetch: batched solver work,
+        # unchanged from the serial engine (the pipelined path submits to
+        # the background pool and costs ~nothing here)
+        if not args.sparse_pruning:
+            eng._prune_running(st, records, walker, ev_seen, pipe)
+        eng._prefetch_mutation_checks(st, records, walker)
+        t2 = time.perf_counter()
+        reg.observe("frontier.harvest.solver_s", t2 - t1)
+
+        # decide finishing slots serially, in slot order: a pending-fork
+        # resume must see exactly the frees an in-order sweep would (slots
+        # already free plus earlier finishing slots of THIS sweep), so the
+        # resume/spill decisions are bit-identical to the serial harvest
+        halts = np.asarray(st.halt)
+        free_cnt = sum(1 for s in range(caps.B) if records[s] is None)
+        finishing: List[int] = []
+        for slot in range(caps.B):
+            rec = records[slot]
+            if rec is None:
+                continue
+            halt = int(halts[slot])
+            if halt == O.H_RUNNING:
+                continue
+            if halt == O.H_PENDING_FORK and free_cnt > 0:
+                # slots freed this harvest: just resume next segment
+                st.halt[slot] = O.H_RUNNING
+                if pipe is not None:
+                    pipe.ledger.touch(slot)
+                continue
+            # batch saturated pending-forks spill to the host engine
+            rec.final = snapshot_slot(st, slot)
+            stats.device_paths += 1
+            if halt == O.H_PENDING_FORK:
+                rec.final["halt"] = O.H_PARK
+                stats.record_bulk_park("batch-full")
+            elif halt == O.H_PARK:
+                pc = int(rec.final["pc"])
+                names = walker.tables_for(rec).opcode_names
+                stats.record_park(names[pc] if pc < len(names) else "?")
+                # semantic park: re-injecting at this pc would immediately
+                # re-park — the walker stamps the carrier so _mid_eligible
+                # holds it host-side until the host steps past the pc
+                rec.final["semantic_park"] = True
+                stats.semantic_parks += 1
+            finishing.append(slot)
+            free_cnt += 1
+
+        # replay: shard by owning laser, slot order within each shard
+        t3 = time.perf_counter()
+        pool = _shared_pool(self.workers)
+        if pool is not None and finishing:
+            groups: Dict[int, List[PathRecord]] = {}
+            for slot in finishing:
+                rec = records[slot]
+                groups.setdefault(id(walker.laser_for(rec)), []).append(rec)
+            futs = [
+                pool.submit(_replay_group, walker, recs)
+                for recs in groups.values()
+            ]
+            for f in futs:
+                f.result()
+            reg.counter("frontier.harvest.replay_shards").inc(len(groups))
+            reg.counter("frontier.harvest.sharded_paths").inc(len(finishing))
+        else:
+            for slot in finishing:
+                rec = records[slot]
+                try:
+                    walker.replay(rec)
+                except Exception as e:
+                    rec._replay_err = e
+        t4 = time.perf_counter()
+        reg.observe("frontier.harvest.replay_s", t4 - t3)
+
+        # commit: main thread, slot order — park routing, slot recycling,
+        # ledger touches
+        for slot in finishing:
+            rec = records[slot]
+            if rec._replay_err is not None:
+                log.warning(
+                    "frontier walker failed on a path: %s", rec._replay_err,
+                    exc_info=rec._replay_err,
+                )
+            else:
+                try:
+                    walker.commit(rec)
+                except Exception as e:  # pragma: no cover - diagnostics
+                    log.warning(
+                        "frontier walker failed on a path: %s", e,
+                        exc_info=True,
+                    )
+            records[slot] = None
+            clear_slot(st, slot)
+            ev_seen[slot] = 0
+            if pipe is not None:
+                pipe.ledger.touch(slot)
+        t5 = time.perf_counter()
+        reg.observe("frontier.harvest.commit_s", t5 - t4)

@@ -507,8 +507,15 @@ class PipelinedRunner:
                     "frontier.segment", cat="device", segment=run_segments,
                     warm=self.program_warm, pipelined=True,
                 ), _otrace.device_annotation("frontier.segment"):
+                    # steady state (next dispatch chained): delta pull —
+                    # the [B] scalar plane + dirty rows/events only; a sync
+                    # point follows otherwise and _dispatch_full pushes the
+                    # whole mirror, so pull everything
                     new_st, arena_len_new, n_exec_host, seg_ml_host = (
-                        pull_harvest(out_state, out_len, n_exec, seg_ml)
+                        pull_harvest(
+                            out_state, out_len, n_exec, seg_ml,
+                            prev=prev_st if nxt is not None else None,
+                        )
                     )
                 bubble = time.perf_counter() - t_pull
                 self.max_live = max(self.max_live, seg_ml_host)
@@ -666,6 +673,18 @@ class PipelinedRunner:
                 reg.gauge("pipeline.overlap_ratio").set(
                     round(overlap / total_har, 4)
                 )
+            # the microbench ran at a sync point (full pull), so its
+            # bytes_pulled estimate is the full-state figure; overwrite it
+            # with the measured steady-state delta-pull average
+            mb = stats.microbench
+            pulls = reg.counter("pipeline.delta_pulls").value
+            if mb and pulls:
+                mb = dict(mb)
+                mb["bytes_pulled_meta_per_segment"] = int(
+                    reg.counter("pipeline.delta_pull_bytes").value / pulls
+                )
+                mb["delta_pull_segments"] = int(pulls)
+                stats.microbench = mb
 
         if stop == "slow-bail":
             self.slow_bailed = True

@@ -29,6 +29,7 @@ class PathRecord:
         "_pruned_at",
         "_submitted_at",
         "steps_seen",
+        "_replay_err",
     )
 
     def __init__(self, seed_idx: int, parent: Optional["PathRecord"] = None,
@@ -45,6 +46,7 @@ class PathRecord:
         self._pruned_at = 0  # constraint count last proven satisfiable
         self._submitted_at = 0  # constraint count last sent to the pool
         self.steps_seen = 0  # device step count already attributed
+        self._replay_err = None  # exception captured by a replay worker
 
 
 def snapshot_slot(st, slot: int) -> dict:

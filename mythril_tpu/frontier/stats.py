@@ -92,6 +92,12 @@ class FrontierStatistics(metaclass=Singleton):
         # float-typed wall-time accumulators (report emits 0.0, not 0)
         reg.counter(_PREFIX + "segment_s", initial=0.0)
         reg.counter(_PREFIX + "harvest_s", initial=0.0)
+        # the harvest_wall_s aggregate split per phase (harvest.py), plus
+        # the background floored-bucket compile — force-created so every
+        # snapshot carries the full attribution block
+        for phase in ("ingest", "solver", "replay", "commit"):
+            reg.histogram(_PREFIX + "harvest.%s_s" % phase)
+        reg.histogram(_PREFIX + "bucket_compile_s")
         reg.labeled_counter(_PREFIX + "parks_by_opcode")
         reg.labeled_counter(_PREFIX + "parks_by_reason")
         reg.gauge(_PREFIX + "microbench", default={})

@@ -1238,26 +1238,149 @@ def _pack_events(state: FrontierState, cap: int):
     return state.events[:, :cap, :].reshape(-1)
 
 
-def pull_harvest(state: FrontierState, arena_len, n_exec, max_live):
-    """Device->host harvest transfer: ONE packed pull of every non-event
-    field (+ the arena_len / n_exec / max_live scalars — no separate scalar
-    round trips), then one bucket-capped events pull sized by max(ev_len)."""
+# Delta pulls pad their dynamic-length index vectors to these row counts so
+# the gather programs compile a handful of times, not once per distinct
+# dirty-set size (same motivation as _EVENT_BUCKETS; the full batch width is
+# the last resort).
+_SLOT_BUCKETS = (8, 32, 128)
+
+
+@jax.jit
+def _pack_meta_1d(state: FrontierState, arena_len, n_exec, max_live):
+    """Every per-slot [B] field flattened into one transfer (+ the three
+    trailing scalars, mirroring pack_meta)."""
+    flat = [f for f in state if f.ndim == 1]
+    flat.append(jnp.stack([
+        jnp.asarray(arena_len, jnp.int32),
+        jnp.asarray(n_exec, jnp.int32),
+        jnp.asarray(max_live, jnp.int32),
+    ]))
+    return jnp.concatenate(flat)
+
+
+@jax.jit
+def _gather_rows(state: FrontierState, idx):
+    """Rows ``idx`` of every 2-D field, concatenated flat (field order =
+    FrontierState declaration order; events is 3-D and excluded)."""
+    return jnp.concatenate(
+        [f[idx].reshape(-1) for f in state if f.ndim == 2]
+    )
+
+
+@partial(jax.jit, static_argnums=2)
+def _gather_events_rows(state: FrontierState, idx, cap: int):
+    return state.events[idx, :cap, :].reshape(-1)
+
+
+def _bucketed(n: int, full: int) -> int:
+    return next((b for b in _SLOT_BUCKETS if b >= n and b <= full), full)
+
+
+def pull_harvest(state: FrontierState, arena_len, n_exec, max_live,
+                 prev: FrontierState = None):
+    """Device->host harvest transfer.
+
+    ``prev=None`` (synchronous loop, sync points, mesh): ONE packed pull of
+    every non-event field (+ the arena_len / n_exec / max_live scalars — no
+    separate scalar round trips), then one bucket-capped events pull sized
+    by max(ev_len).
+
+    ``prev`` set (pipelined steady state, the next dispatch already
+    chained): a DELTA pull.  The harvest only ever reads three things from
+    a fresh mirror — per-slot scalars (halt/seed/ev_len/... drive every
+    decision), the 2-D rows of slots it is about to finish or prune, and
+    the new event slices — so the pull ships the [B] scalar plane plus the
+    dirty rows only: slots that halted (snapshot_slot reads their
+    stack/memory), slots whose constraint list grew (prune reads cons;
+    append-only, so an unchanged cons_len means unchanged rows — and a
+    recycled slot's mirror length is 0 after clear_slot, so fork-grant
+    reuse always miscompares and pulls), and ev_len-dirty event slices.
+    Everything else is carried from ``prev`` by copy; those rows are only
+    ever read again by a full push, and every sync point full-pulls first
+    (the pipeline passes ``prev`` only when a dispatch is chained).
+    Against the full pull this drops the per-segment meta transfer from
+    every [B, W] plane to ~16*B scalars + the few finishing rows."""
     assert all(f.dtype == np.int32 for f in state), (
         "packed state transfer assumes uniform int32 fields"
     )
-    shapes = tuple(
-        f.shape for name, f in zip(state._fields, state) if name != "events"
+    if prev is None:
+        shapes = tuple(
+            f.shape for name, f in zip(state._fields, state)
+            if name != "events"
+        )
+        pack_meta, unpack_host, _d, ev_len_of = _state_packer(shapes)
+        buf = np.asarray(pack_meta(state, arena_len, n_exec, max_live))
+        max_ev = int(ev_len_of(buf).max()) if buf.size else 0
+        B, EVT, EVW = state.events.shape
+        cap = next((b for b in _EVENT_BUCKETS if b >= max_ev and b <= EVT),
+                   EVT)
+        events = np.full((B, EVT, EVW), -1, np.int32)
+        if max_ev > 0:
+            pulled = np.asarray(_pack_events(state, cap)).reshape(B, cap, EVW)
+            events[:, :cap, :] = pulled
+        return unpack_host(buf, events)
+
+    from mythril_tpu.observability.metrics import get_registry
+
+    B, EVT, EVW = np.asarray(prev.events).shape
+    names_1d = [n for n, f in zip(prev._fields, prev)
+                if np.asarray(f).ndim == 1]
+    names_2d = [n for n, f in zip(prev._fields, prev)
+                if np.asarray(f).ndim == 2]
+
+    buf = np.asarray(_pack_meta_1d(state, arena_len, n_exec, max_live))
+    fields = {}
+    off = 0
+    for n in names_1d:
+        fields[n] = buf[off: off + B].copy()
+        off += B
+    scalars = (int(buf[off]), int(buf[off + 1]), int(buf[off + 2]))
+    pulled_bytes = buf.nbytes
+
+    halt, seed = fields["halt"], fields["seed"]
+    ev_len = np.minimum(fields["ev_len"], EVT)
+    dirty = (
+        ((seed >= 0) & (halt != O.H_RUNNING))
+        | (ev_len > 0)
+        | (fields["cons_len"] != prev.cons_len)
     )
-    pack_meta, unpack_host, _d, ev_len_of = _state_packer(shapes)
-    buf = np.asarray(pack_meta(state, arena_len, n_exec, max_live))
-    max_ev = int(ev_len_of(buf).max()) if buf.size else 0
-    B, EVT, EVW = state.events.shape
-    cap = next((b for b in _EVENT_BUCKETS if b >= max_ev and b <= EVT), EVT)
+    idx = np.nonzero(dirty)[0].astype(np.int32)
+
+    for n in names_2d:
+        fields[n] = np.asarray(getattr(prev, n)).copy()
+    if idx.size:
+        cap_n = _bucketed(idx.size, B)
+        pad = np.zeros(cap_n, np.int32)
+        pad[: idx.size] = idx
+        rows = np.asarray(_gather_rows(state, jnp.asarray(pad)))
+        pulled_bytes += rows.nbytes
+        off2 = 0
+        for n in names_2d:
+            w = fields[n].shape[1]
+            block = rows[off2: off2 + cap_n * w].reshape(cap_n, w)
+            fields[n][idx] = block[: idx.size]
+            off2 += cap_n * w
+
     events = np.full((B, EVT, EVW), -1, np.int32)
-    if max_ev > 0:
-        pulled = np.asarray(_pack_events(state, cap)).reshape(B, cap, EVW)
-        events[:, :cap, :] = pulled
-    return unpack_host(buf, events)
+    ev_idx = np.nonzero(ev_len > 0)[0].astype(np.int32)
+    if ev_idx.size:
+        max_ev = int(ev_len[ev_idx].max())
+        cap = next((b for b in _EVENT_BUCKETS if b >= max_ev and b <= EVT),
+                   EVT)
+        cap_m = _bucketed(ev_idx.size, B)
+        pad = np.zeros(cap_m, np.int32)
+        pad[: ev_idx.size] = ev_idx
+        pulled = np.asarray(
+            _gather_events_rows(state, jnp.asarray(pad), cap)
+        ).reshape(cap_m, cap, EVW)
+        events[ev_idx, :cap, :] = pulled[: ev_idx.size]
+        pulled_bytes += pulled.nbytes
+    fields["events"] = events
+
+    reg = get_registry()
+    reg.counter("pipeline.delta_pulls").inc()
+    reg.counter("pipeline.delta_pull_bytes").inc(pulled_bytes)
+    return (FrontierState(**fields), *scalars)
 
 
 def push_state(state: FrontierState):

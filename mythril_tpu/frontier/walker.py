@@ -78,9 +78,17 @@ class Walker:
         self.gas_base = [
             (s.mstate.min_gas_used, s.mstate.max_gas_used) for s in seeds
         ]
-        # arena row -> wrapper bound at a hook site (annotation carrier)
-        self.bound: Dict[int, object] = {}
-        self._anno_memo: Dict[int, frozenset] = {}
+        # arena row -> wrapper bound at a hook site (annotation carrier).
+        # Partitioned PER LASER: annotations only ever flow within one
+        # analysis (wrapper objects never cross lasers on the host), and the
+        # partition is what lets the sharded harvest executor replay
+        # different lasers' paths concurrently — a worker's decode closure
+        # is a pure function of its own laser's replay history, with no
+        # cross-thread binds (or memo clears) to race.  Interned arena rows
+        # shared across lasers (e.g. common constants) no longer leak one
+        # analysis' annotations into another — that was a latent bug of the
+        # shared table, not behavior to preserve.
+        self._bind_ctx: Dict[int, tuple] = {}  # id(laser) -> (bound, memo)
         # optional park routing hook (frontier/pipeline.py): called as
         # park_sink(laser, rec, carrier, snap) for parked carriers; a True
         # return means the sink took ownership (e.g. queued the state for
@@ -110,8 +118,19 @@ class Walker:
     # decode with annotation closure
     # ------------------------------------------------------------------
 
-    def _annos(self, row: int) -> frozenset:
-        got = self._anno_memo.get(row)
+    def _binding(self, seed_idx: int) -> tuple:
+        """(bound, anno_memo) dicts for the laser that owns ``seed_idx``.
+        setdefault keeps creation atomic under concurrent replay workers
+        (distinct lasers race only on the outer dict, never on a context).
+        A laser-less walker (decode-only use) shares one sentinel context."""
+        key = (
+            id(self.lasers[seed_idx]) if seed_idx < len(self.lasers) else -1
+        )
+        return self._bind_ctx.setdefault(key, ({}, {}))
+
+    def _annos(self, row: int, seed_idx: int) -> frozenset:
+        bound, anno_memo = self._binding(seed_idx)
+        got = anno_memo.get(row)
         if got is not None:
             return got
         out: Set = set()
@@ -123,7 +142,7 @@ class Walker:
             if r < 0 or r in seen:
                 continue
             seen.add(r)
-            w = self.bound.get(r)
+            w = bound.get(r)
             if w is not None:
                 out.update(getattr(w, "annotations", ()))
             ar = self.arena
@@ -138,29 +157,35 @@ class Walker:
             # hooks' opcodes ship no device events at all (frontier/taint.py)
             out.update(taint.annotations_for_mask(mask))
         result = frozenset(out)
-        self._anno_memo[row] = result
+        anno_memo[row] = result
         return result
 
-    def decode_wrapped(self, row: int):
-        """Arena row -> smt wrapper (BitVec/Bool) with taint closure."""
+    def decode_wrapped(self, row: int, seed_idx: int = 0):
+        """Arena row -> smt wrapper (BitVec/Bool) with taint closure.
+
+        ``seed_idx`` selects the binding context (per laser): replay-time
+        decodes pass the record's seed; the default covers single-laser
+        callers (tests, single-contract engines)."""
         from mythril_tpu.smt import BitVec, Bool
         from mythril_tpu.smt import terms as T
 
         row = int(row)
-        bound = self.bound.get(row)
-        if bound is not None:
-            return bound
+        bound, _memo = self._binding(seed_idx)
+        got = bound.get(row)
+        if got is not None:
+            return got
         term = self.arena.decode(row)
-        annos = self._annos(row)
+        annos = self._annos(row, seed_idx)
         if term.sort is T.BOOL:
             return Bool(term, annotations=annos)
         return BitVec(term, annotations=annos)
 
-    def bind(self, row: int, wrapper) -> None:
+    def bind(self, row: int, wrapper, seed_idx: int = 0) -> None:
         if row is None or row < 0:
             return
-        self.bound[int(row)] = wrapper
-        self._anno_memo.clear()
+        bound, anno_memo = self._binding(seed_idx)
+        bound[int(row)] = wrapper
+        anno_memo.clear()
 
     # ------------------------------------------------------------------
     # carrier management
@@ -216,11 +241,13 @@ class Walker:
     # event processing
     # ------------------------------------------------------------------
 
-    def _set_stack_from_ops(self, carrier, ev) -> None:
+    def _set_stack_from_ops(self, carrier, ev, seed_idx: int) -> None:
         ops = [int(ev[O.EV_OP0 + j]) for j in range(7)]
         ops = [r for r in ops if r >= 0]
         # ops are in pop order: stack top is ops[0]
-        carrier.mstate.stack[:] = [self.decode_wrapped(r) for r in reversed(ops)]
+        carrier.mstate.stack[:] = [
+            self.decode_wrapped(r, seed_idx) for r in reversed(ops)
+        ]
 
     def _set_gas(self, carrier, seed_idx: int, gmin: int, gmax: int) -> None:
         base = self.gas_base[seed_idx]
@@ -242,7 +269,7 @@ class Walker:
             return
         for addr, row in final.get("mem", ()):
             rec.carrier.mstate.memory.write_word_at(
-                int(addr), self.decode_wrapped(int(row))
+                int(addr), self.decode_wrapped(int(row), rec.seed_idx)
             )
 
     def _process_event(self, rec: PathRecord, ev: np.ndarray) -> None:
@@ -259,7 +286,7 @@ class Walker:
             # current — install the device word table first
             self._restore_memory(rec)
         if kind in (O.E_HOOK, O.E_TERMINAL):
-            self._set_stack_from_ops(carrier, ev)
+            self._set_stack_from_ops(carrier, ev, rec.seed_idx)
             new_states, op_code = laser.execute_state(carrier)
             if laser.requires_statespace:
                 laser.manage_cfg(op_code, new_states)
@@ -283,7 +310,7 @@ class Walker:
                 log.warning("unexpected host fork during event replay")
             res = int(ev[O.EV_RES])
             if res >= 0 and rec.carrier.mstate.stack:
-                self.bind(res, rec.carrier.mstate.stack[-1])
+                self.bind(res, rec.carrier.mstate.stack[-1], rec.seed_idx)
             return
 
         if kind == O.E_FORK:
@@ -293,8 +320,8 @@ class Walker:
             word_row = int(ev[O.EV_OP0 + 1])
             if word_row >= 0:
                 carrier.mstate.stack[:] = [
-                    self.decode_wrapped(word_row),
-                    self.decode_wrapped(dest_row),
+                    self.decode_wrapped(word_row, rec.seed_idx),
+                    self.decode_wrapped(dest_row, rec.seed_idx),
                 ]
             else:
                 carrier.mstate.stack[:] = []
@@ -317,7 +344,7 @@ class Walker:
                 cons_row = fork_branch_row(ev, taken=True)
                 condition = None
                 if cons_row >= 0:
-                    condition = self.decode_wrapped(cons_row)
+                    condition = self.decode_wrapped(cons_row, rec.seed_idx)
                     carrier.world_state.constraints.append(condition)
                 carrier.mstate.pc = int(ev[O.EV_RES])  # decided next pc
                 carrier.mstate.depth += 1
@@ -330,13 +357,13 @@ class Walker:
             child = rec.children_by_event.get(rec.carrier_pos - 1)
             if child is not None and not child.dead:
                 child_carrier = _copy.copy(carrier)
-                cond = self.decode_wrapped(cond_row)
+                cond = self.decode_wrapped(cond_row, rec.seed_idx)
                 child_carrier.world_state.constraints.append(cond)
                 child_carrier.mstate.pc = int(ev[O.EV_OP0 + 4])
                 child_carrier.mstate.depth += 1
                 self._branch_node(laser, child_carrier, cond)
                 child.carrier = child_carrier
-            ncond = self.decode_wrapped(ncond_row)
+            ncond = self.decode_wrapped(ncond_row, rec.seed_idx)
             carrier.world_state.constraints.append(ncond)
             carrier.mstate.pc = pc + 1
             carrier.mstate.depth += 1
@@ -363,8 +390,55 @@ class Walker:
     # ------------------------------------------------------------------
 
     def finish(self, rec: PathRecord) -> None:
-        """Path halted on device: drain events, then act on the halt kind."""
+        """Path halted on device: drain events, then act on the halt kind.
+
+        Split into ``replay`` (laser-local: event drain + park-carrier
+        restore, safe to run concurrently for DIFFERENT lasers) and
+        ``commit`` (cross-laser side effects: park routing through the
+        shared ``park_sink``), so the sharded harvest executor can fan
+        replays out per laser and serialize commits in slot order.  Calling
+        ``finish`` is exactly ``replay`` then ``commit`` — the serial path
+        and the oracle for parity tests."""
+        self.replay(rec)
+        self.commit(rec)
+
+    def replay(self, rec: PathRecord) -> None:
+        """Drain the record's events and restore a parked carrier's device
+        state (pc/stack/gas/memory) — everything expensive, everything
+        laser-local.  Terminal paths fully complete here: their E_TERMINAL
+        event runs the terminal instruction through ``laser.execute_state``
+        (transaction end -> open states / inner-call resumes), all appends
+        landing on the owning laser's own lists."""
         self.advance(rec, len(rec.events))
+        if rec.dead or rec.final is None:
+            return
+        halt = rec.final["halt"]
+        if halt in (O.H_PARK, O.H_PENDING_FORK):
+            carrier = rec.carrier
+            if carrier is None:
+                return
+            snap = rec.final
+            self._restore_memory(rec)
+            carrier.mstate.pc = snap["pc"]
+            carrier.mstate.stack[:] = [
+                self.decode_wrapped(int(r), rec.seed_idx)
+                for r in snap["stack"]
+            ]
+            self._set_gas(carrier, rec.seed_idx, snap["gas_min"], snap["gas_max"])
+            carrier.mstate.depth = snap["depth"]
+            carrier.mstate.memory_size = snap["mem_size"]
+            if snap.get("semantic_park"):
+                # the device provably cannot execute THIS instruction:
+                # engine._mid_eligible keeps the state host-side until the
+                # host engine advances it past the parking pc
+                carrier._frontier_park_pc = snap["pc"]
+
+    def commit(self, rec: PathRecord) -> None:
+        """Route a replayed record's outcome: park-sink / work-list hand-off
+        for parked carriers, no-ops for the rest.  The park sink is shared
+        across lasers (pipeline re-injection queue), so the executor calls
+        this on the main thread in slot order — queue order is bit-identical
+        to the serial harvest."""
         if rec.dead or rec.final is None:
             return
         halt = rec.final["halt"]
@@ -380,19 +454,6 @@ class Walker:
             if carrier is None:
                 return
             snap = rec.final
-            self._restore_memory(rec)
-            carrier.mstate.pc = snap["pc"]
-            carrier.mstate.stack[:] = [
-                self.decode_wrapped(int(r)) for r in snap["stack"]
-            ]
-            self._set_gas(carrier, rec.seed_idx, snap["gas_min"], snap["gas_max"])
-            carrier.mstate.depth = snap["depth"]
-            carrier.mstate.memory_size = snap["mem_size"]
-            if snap.get("semantic_park"):
-                # the device provably cannot execute THIS instruction:
-                # engine._mid_eligible keeps the state host-side until the
-                # host engine advances it past the parking pc
-                carrier._frontier_park_pc = snap["pc"]
             sink = self.park_sink
             if sink is not None:
                 try:

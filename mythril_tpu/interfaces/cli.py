@@ -171,6 +171,16 @@ def _add_analysis_options(parser) -> None:
         "latency off the harvest critical path, not parallel solving)",
     )
     group.add_argument(
+        "--harvest-workers",
+        type=int,
+        default=4,
+        metavar="N",
+        help="harvest replay worker threads: terminal path replays shard "
+        "by owning laser (no shared per-laser state across workers) and "
+        "commit in slot order, so the issue set is identical to serial; "
+        "0 runs the serial harvest",
+    )
+    group.add_argument(
         "--compile-cache-dir",
         metavar="DIR",
         help="persist XLA compilations in DIR and reuse them across "
@@ -375,6 +385,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         staticpass=not getattr(parsed, "no_staticpass", False),
         pipeline=getattr(parsed, "pipeline", True),
         solver_workers=getattr(parsed, "solver_workers", 2),
+        harvest_workers=getattr(parsed, "harvest_workers", 4),
         compile_cache_dir=getattr(parsed, "compile_cache_dir", None),
     )
     analyzer = MythrilAnalyzer(

@@ -21,6 +21,7 @@ axioms (reference: mythril/laser/ethereum/function_managers/keccak_function_mana
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Dict, Iterable, Optional, Tuple, Union
 
 # ---------------------------------------------------------------------------
@@ -119,6 +120,12 @@ class Term:
 
 # Interning table.  Keyed by (op, sort, child tids, aux).
 _INTERN: Dict[tuple, Term] = {}
+# Interning must be race-free: equality is term identity (``self is
+# other``), so two threads materializing the same key concurrently would
+# mint two Terms with distinct tids and silently break every identity
+# check and solver memo downstream.  Double-checked: the hit path stays
+# lock-free (dict reads are atomic under the GIL), only a miss locks.
+_INTERN_LOCK = threading.Lock()
 
 
 def _mk(op, sort, args=(), aux=None) -> Term:
@@ -127,8 +134,11 @@ def _mk(op, sort, args=(), aux=None) -> Term:
     key = (op, sort, tuple(a.tid for a in args), aux)
     t = _INTERN.get(key)
     if t is None:
-        t = Term(op, sort, tuple(args), aux, key)
-        _INTERN[key] = t
+        with _INTERN_LOCK:
+            t = _INTERN.get(key)
+            if t is None:
+                t = Term(op, sort, tuple(args), aux, key)
+                _INTERN[key] = t
     return t
 
 

@@ -88,6 +88,11 @@ class Args:
     # feasibility-pool worker threads (solves share one lock — the win is
     # moving solve latency off the harvest critical path, not parallelism)
     solver_workers: int = 2
+    # harvest replay worker threads: terminal replays shard by owning
+    # laser so no per-laser state is ever touched by two workers; results
+    # commit in slot order, so issue sets are identical to the serial
+    # sweep.  0 = serial escape hatch (and the parity baseline)
+    harvest_workers: int = 4
     # persistent XLA compilation cache directory (None = off unless the
     # MYTHRIL_TPU_COMPILATION_CACHE env var opts in)
     compile_cache_dir: Optional[str] = None
