@@ -408,6 +408,155 @@ def pipeline_compare() -> dict:
     return {"metric": "pipeline_compare", "workloads": results}
 
 
+def mesh_compare() -> dict:
+    """Sharded-pipelined vs single-device parity across every mesh ×
+    pipeline combination.
+
+    Runs each workload four times with the device frontier forced on —
+    ``--no-mesh``/``--no-pipeline`` toggled independently — and asserts the
+    correctness contract: all four issue sets are IDENTICAL, the pipelined
+    runs actually chained segments, and (with >1 attached device) the
+    mesh runs really executed path-sharded with per-shard delta-pull bytes
+    attributed to every shard.  This is the pod parity smoke CI runs under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``; returns (and
+    ``main`` prints) one JSON-able dict."""
+    import jax
+
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.frontier import engine as _eng
+    from mythril_tpu.frontier.stats import FrontierStatistics
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.support.support_args import args as global_args
+
+    def issue_set(issues):
+        return sorted((i.swc_id, i.address) for i in issues)
+
+    n_dev = jax.device_count()
+    suicide = bytes.fromhex("60003560e01c6341c0e1b51460145760006000fd5b33ff")
+    workloads = [
+        # (name, contract-or-code, tx_count, modules, recall swc)
+        ("suicide", suicide, 1, ["AccidentallyKillable"], "106"),
+        ("killbilly",
+         EVMContract(code=KILLBILLY, creation_code=KILLBILLY_CREATION,
+                     name="KillBilly"),
+         3, ["AccidentallyKillable"], "106"),
+    ]
+    # (mesh, pipeline): the four escape-hatch combinations of the
+    # acceptance contract, sharded-pipelined first
+    combos = [(True, True), (True, False), (False, True), (False, False)]
+
+    def one_run(target, txs, modules, mesh_on: bool, pipelined: bool):
+        global_args.frontier_mesh = mesh_on
+        global_args.pipeline = pipelined
+        _clear_caches()
+        # per-code slow/narrow verdicts and warm markers are deliberately
+        # process-persistent; they must not leak control flow across modes
+        _eng._SLOW_CODES.clear()
+        _eng._NARROW_CODES.clear()
+        _eng._SLOW_SEGMENTS.clear()
+        reg = get_registry()
+        reg.reset(prefix="pipeline.")
+        fstats = FrontierStatistics()
+        fstats.mesh_devices = 0
+        t0 = time.time()
+        _, issues = _analyze(target, 0x0901D12E, txs, modules=modules,
+                             timeout=300)
+        wall = time.time() - t0
+        snap = {
+            k: v
+            for k, v in reg.snapshot().items()
+            if k.startswith("pipeline.")
+        }
+        ttfe = _ttfe(issues, t0)
+        return {
+            "issues": issue_set(issues),
+            "wall_s": round(wall, 3),
+            "ttfe_s": round(ttfe, 3) if ttfe == ttfe else None,
+            "mesh_devices": int(fstats.mesh_devices),
+            "pipeline": snap,
+        }
+
+    prev = (global_args.pipeline, global_args.frontier_mesh,
+            global_args.frontier, global_args.frontier_force,
+            global_args.frontier_width)
+    results = {}
+    try:
+        global_args.probe_backend = "auto"
+        global_args.frontier = True
+        global_args.frontier_force = True  # tiny contracts: bypass gates
+        global_args.frontier_width = 64
+        # warm every program variant outside the timers (the sharded and
+        # single-device placements lower to different XLA programs)
+        for mesh_on, pipelined in combos:
+            one_run(suicide, 1, ["AccidentallyKillable"], mesh_on, pipelined)
+        for name, target, txs, modules, swc in workloads:
+            runs = {}
+            for mesh_on, pipelined in combos:
+                key = "mesh=%s,pipeline=%s" % (
+                    "on" if mesh_on else "off",
+                    "on" if pipelined else "off",
+                )
+                runs[key] = (
+                    mesh_on, pipelined,
+                    one_run(target, txs, modules, mesh_on, pipelined),
+                )
+            ref = runs["mesh=off,pipeline=on"][2]
+            assert any(s == swc for s, _ in ref["issues"]), (
+                f"{name}: single-device pipelined run lost recall: "
+                f"{ref['issues']}"
+            )
+            for key, (mesh_on, pipelined, r) in runs.items():
+                assert r["issues"] == ref["issues"], (
+                    f"{name} [{key}]: issue set diverged: "
+                    f"{r['issues']} != {ref['issues']}"
+                )
+                seg_p = r["pipeline"].get("pipeline.segments_pipelined", 0)
+                if pipelined:
+                    assert seg_p > 0, (
+                        f"{name} [{key}]: pipelined run chained zero "
+                        f"segments: {r['pipeline']}"
+                    )
+                else:
+                    assert seg_p == 0, (
+                        f"{name} [{key}]: --no-pipeline run still "
+                        f"pipelined: {r['pipeline']}"
+                    )
+                if mesh_on and n_dev > 1:
+                    assert r["mesh_devices"] == n_dev, (
+                        f"{name} [{key}]: mesh run used "
+                        f"{r['mesh_devices']} devices, expected {n_dev}"
+                    )
+                else:
+                    assert r["mesh_devices"] == 0, (
+                        f"{name} [{key}]: --no-mesh run placed on a mesh"
+                    )
+            if n_dev > 1:
+                pod = runs["mesh=on,pipeline=on"][2]["pipeline"]
+                assert pod.get("pipeline.delta_pulls", 0) > 0, (
+                    f"{name}: sharded-pipelined run never delta-pulled: "
+                    f"{pod}"
+                )
+                by_shard = pod.get(
+                    "pipeline.delta_pull_bytes_by_shard", {}
+                )
+                assert len(by_shard) == n_dev and all(
+                    v > 0 for v in by_shard.values()
+                ), (
+                    f"{name}: per-shard delta-pull attribution incomplete "
+                    f"over {n_dev} devices: {by_shard}"
+                )
+            results[name] = {k: r for k, (_, _, r) in runs.items()}
+    finally:
+        (global_args.pipeline, global_args.frontier_mesh,
+         global_args.frontier, global_args.frontier_force,
+         global_args.frontier_width) = prev
+    return {
+        "metric": "mesh_compare",
+        "n_devices": n_dev,
+        "workloads": results,
+    }
+
+
 _HARVEST_PHASES = ("ingest", "solver", "replay", "commit")
 
 
@@ -1036,7 +1185,13 @@ def _warm_frontier() -> None:
     """Compile the segment programs for the production widths OUTSIDE every
     workload timer (the XLA disk cache is invalidated by any program change,
     so a fresh build pays each (caps, bucket) combination once here)."""
+    import mythril_tpu
     from mythril_tpu.support.support_args import args
+
+    # arm (and thereby pre-seed) the persistent compile cache before the
+    # first compile: the warmup's programs land on disk, so later processes
+    # — and every timed workload below — start from compilecache hits
+    mythril_tpu.enable_persistent_compilation_cache(args.compile_cache_dir)
 
     _configure(True)
     args.frontier_force = True
@@ -1061,6 +1216,9 @@ def _new_row_data():
         "harvest_shares": [],
         "harvest_phases": [],  # per-production-rep {phase: seconds} deltas
         "mids": [],  # per-production-rep (mid_reentered, mid_bounced, semantic_parked)
+        # accumulated per-tag [hits, misses] deltas of the persistent XLA
+        # compile cache — did this workload's programs come off disk?
+        "compilecache": {"baseline": [0, 0], "production": [0, 0]},
         "completed_reps": 0,
         "trimmed_reps": [],  # rep numbers the budget clock dropped
     }
@@ -1129,6 +1287,12 @@ def _row_summary(unit: str, d: dict) -> dict:
             else {}
         ),
         "device_residency_pct": dev_pct,
+        # persistent-compile-cache traffic attributed to this workload's
+        # runs (hits = programs loaded from disk instead of recompiled)
+        "compilecache": {
+            tag: {"hits": int(v[0]), "misses": int(v[1])}
+            for tag, v in d.get("compilecache", {}).items()
+        },
         "harvest_share_pct": (
             round(100 * _median(d["harvest_shares"]), 1)
             if d["harvest_shares"]
@@ -1257,6 +1421,24 @@ def main() -> None:
         print(json.dumps(harvest_compare()), flush=True)
         return
 
+    if "--mesh-compare" in sys.argv:
+        # standalone pod parity mode (all four mesh x pipeline combos)
+        print(json.dumps(mesh_compare()), flush=True)
+        return
+
+    # --ttfe-budget SECONDS: turn the production TTFE gap into a loud
+    # regression — after the suite completes, any workload whose median
+    # production time-to-first-exploit exceeds the budget fails the run
+    ttfe_budget = None
+    if "--ttfe-budget" in sys.argv:
+        idx = sys.argv.index("--ttfe-budget")
+        try:
+            ttfe_budget = float(sys.argv[idx + 1])
+        except (IndexError, ValueError):
+            print("[bench] --ttfe-budget requires a SECONDS operand",
+                  file=sys.stderr)
+            sys.exit(2)
+
     # suite-internal budget clock (monotonic); the per-workload t0 stamps
     # stay time.time() because _ttfe/_rebase_stamp compare them against the
     # epoch-anchored report.StartTime discovery stamps
@@ -1319,7 +1501,26 @@ def main() -> None:
                     ).sum
                     for p in _HARVEST_PHASES
                 }
+                cc_before = (
+                    get_registry().counter(
+                        "compilecache.hits", persistent=True
+                    ).value,
+                    get_registry().counter(
+                        "compilecache.misses", persistent=True
+                    ).value,
+                )
                 out = fn(production)
+                cc = d["compilecache"][tag]
+                cc[0] += (
+                    get_registry().counter(
+                        "compilecache.hits", persistent=True
+                    ).value - cc_before[0]
+                )
+                cc[1] += (
+                    get_registry().counter(
+                        "compilecache.misses", persistent=True
+                    ).value - cc_before[1]
+                )
                 work, wall, ttfe = out[:3]
                 d["samples"][tag].append(work / wall if wall > 0 else 0.0)
                 if ttfe == ttfe:  # not NaN
@@ -1396,6 +1597,27 @@ def main() -> None:
         if data[n]["completed_reps"]
     }
     _emit_snapshot(table, budget_meta(), partial=False)
+
+    if ttfe_budget is not None:
+        violations = []
+        for n, row in table.items():
+            t = row.get("ttfe_s", {}).get("production")
+            if t is not None and t > ttfe_budget:
+                violations.append(
+                    f"{n}: production ttfe_s {t:.3f} > budget "
+                    f"{ttfe_budget:.3f}"
+                )
+        if violations:
+            print(
+                "[bench] TTFE budget exceeded:\n  " + "\n  ".join(violations),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        print(
+            f"[bench] TTFE budget ok: every production median within "
+            f"{ttfe_budget:.3f}s",
+            file=sys.stderr,
+        )
 
 
 if __name__ == "__main__":

@@ -4,6 +4,18 @@ _compile_cache_armed = False
 _compile_cache_listener_armed = False
 
 
+def default_compile_cache_dir() -> str:
+    """Per-user default location for the persistent XLA compilation cache
+    (XDG-style: ``$XDG_CACHE_HOME`` or ``~/.cache``, then
+    ``mythril-tpu/xla``)."""
+    import os
+
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "mythril-tpu", "xla")
+
+
 def enable_persistent_compilation_cache(cache_dir=None) -> None:
     """Cache compiled XLA programs on disk across processes.
 
@@ -14,18 +26,19 @@ def enable_persistent_compilation_cache(cache_dir=None) -> None:
     one-time-per-machine cost.  Best-effort: unsupported backends or
     read-only homes silently skip it.
 
-    Default **off**: the no-argument form (called from the device-path
-    modules at import time — they import jax anyway, and host-only
-    workflows must not pay the jax import at startup) only arms the cache
-    when the ``MYTHRIL_TPU_COMPILATION_CACHE`` env var opts in.  Passing
-    ``cache_dir`` (the ``--compile-cache-dir`` flag) arms it explicitly
-    and drops the min-compile-time floor so even small CPU-backend
-    programs (CI parity runs, the opening-dispatch segment) are cached.
+    Default **on** under ``default_compile_cache_dir()`` (the measured
+    production-vs-baseline TTFE gap is dominated by segment recompiles —
+    BENCH_r05).  The ``MYTHRIL_TPU_COMPILATION_CACHE`` env var overrides:
+    ``0``/``off``/``no``/``false`` disables the cache, any other non-empty
+    value relocates it.  Passing ``cache_dir`` (the ``--compile-cache-dir``
+    flag) wins over both.  The min-compile-time floor is dropped to 0 so
+    even small CPU-backend programs (CI parity runs, the opening-dispatch
+    segment) are cached.
 
     Cache hits/misses are mirrored into the ``compilecache.hits`` /
     ``compilecache.misses`` counters via ``jax.monitoring`` so
-    ``--metrics-out`` snapshots show whether warm starts actually skipped
-    the recompile.
+    ``--metrics-out`` snapshots and per-workload bench rows show whether
+    warm starts actually skipped the recompile.
     """
     global _compile_cache_armed
     import os
@@ -33,19 +46,19 @@ def enable_persistent_compilation_cache(cache_dir=None) -> None:
     try:
         explicit = cache_dir is not None
         if not explicit:
-            cache_dir = os.environ.get("MYTHRIL_TPU_COMPILATION_CACHE")
-            if not cache_dir:
-                return  # default off: nobody opted in
+            env = os.environ.get("MYTHRIL_TPU_COMPILATION_CACHE")
+            if env is not None and env.strip().lower() in (
+                "", "0", "off", "no", "false",
+            ):
+                return  # explicit opt-out
+            cache_dir = env or default_compile_cache_dir()
         if _compile_cache_armed and not explicit:
             return
         import jax
 
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs",
-            0.0 if explicit else 2.0,
-        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         _compile_cache_armed = True
         _arm_compile_cache_listener()
     except Exception:

@@ -50,6 +50,7 @@ class AnalyzerArgs:
     query_cache_dir: Optional[str] = None
     staticpass: bool = True
     pipeline: bool = True
+    frontier_mesh: bool = True
     solver_workers: int = 2
     harvest_workers: int = 4
     compile_cache_dir: Optional[str] = None
@@ -108,6 +109,7 @@ class MythrilAnalyzer:
         args.query_cache_dir = getattr(cmd_args, "query_cache_dir", None)
         args.staticpass = getattr(cmd_args, "staticpass", True)
         args.pipeline = getattr(cmd_args, "pipeline", True)
+        args.frontier_mesh = getattr(cmd_args, "frontier_mesh", True)
         args.solver_workers = getattr(cmd_args, "solver_workers", 2)
         args.harvest_workers = getattr(cmd_args, "harvest_workers", 4)
         args.compile_cache_dir = getattr(cmd_args, "compile_cache_dir", None)

@@ -102,6 +102,14 @@ class HostArena:
     def thaw(self) -> None:
         self._frozen = False
 
+    @property
+    def frozen(self) -> bool:
+        """True while a device segment owns the append indices.  The
+        pipelined runner's sync-point machinery (spill re-injection, pod
+        rebalance) asserts on this before encoding: appends outside a sync
+        point would alias in-flight device rows."""
+        return self._frozen
+
     def _append(self, op, a=-1, b=-1, c=-1, width=0, value: Optional[int] = None) -> int:
         if self._frozen:
             raise RuntimeError(

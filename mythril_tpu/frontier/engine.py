@@ -656,6 +656,24 @@ class FrontierEngine:
         caps = self.caps
         t_start = time.perf_counter()
 
+        # mesh precondition lift: pad the slot batch up to a multiple of
+        # the attached device count so the path axis always shards evenly
+        # (the old `caps.B % n_dev == 0` gate silently fell back to a
+        # single device).  The extra slots are ordinary dead slots (seed
+        # -1, never injected into unless paths need them) — they cost only
+        # their share of the packed transfers.
+        if args.frontier_mesh:
+            import dataclasses
+
+            import jax
+
+            n_dev = jax.device_count()
+            if n_dev > 1 and caps.B % n_dev:
+                pad = -caps.B % n_dev
+                caps = dataclasses.replace(caps, B=caps.B + pad)
+                self.caps = caps
+                _get_metrics().counter("frontier.mesh_pad_slots").inc(pad)
+
         seed_lasers = [laser for laser, _ in pairs]
         seeds = [gs for _, gs in pairs]
         lasers: List = []
@@ -808,12 +826,37 @@ class FrontierEngine:
         # jitted program — the fork-grant prefix sum becomes the only
         # cross-shard collective
         mesh = None
+        push_sharded = None
         n_dev = jax.device_count()
-        if args.frontier_mesh and n_dev > 1 and caps.B % n_dev == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
-            from mythril_tpu.parallel.mesh import PATH_AXIS, make_frontier_mesh
+        if args.frontier_mesh and n_dev > 1:
+            from mythril_tpu.parallel.mesh import (
+                make_frontier_mesh,
+                path_sharding,
+            )
 
-            mesh = make_frontier_mesh(path_size=n_dev)
+            if caps.B % n_dev:
+                # caller-pinned caps the padding above could not touch
+                # (checkpoint resume with a fixed width): run single-device,
+                # but LOUDLY — the metric makes the fallback visible
+                _get_metrics().counter("frontier.mesh_fallbacks").inc()
+                log.warning(
+                    "frontier: batch width %d not divisible by %d devices; "
+                    "falling back to single-device execution",
+                    caps.B, n_dev,
+                )
+            else:
+                try:
+                    mesh = make_frontier_mesh(path_size=n_dev)
+                except Exception as e:  # pragma: no cover - defensive
+                    _get_metrics().counter("frontier.mesh_fallbacks").inc()
+                    log.warning(
+                        "frontier: mesh construction failed (%s); "
+                        "falling back to single-device execution", e,
+                    )
+        self._mesh_shards = n_dev if mesh is not None else 1
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
             FrontierStatistics().mesh_devices = n_dev
             repl = NamedSharding(mesh, PartitionSpec())
             # read-mostly inputs placed replicated ONCE; segment outputs keep
@@ -827,9 +870,7 @@ class FrontierEngine:
             )
 
             def _path_sharding(x):
-                return NamedSharding(
-                    mesh, PartitionSpec(PATH_AXIS, *([None] * (x.ndim - 1)))
-                )
+                return path_sharding(mesh, x)
 
             # event buffers start empty every segment: one constant sharded
             # pair reused for the whole run (nothing crosses the link)
@@ -967,7 +1008,11 @@ class FrontierEngine:
                 # below measures dispatch, not compile
                 precompile.join()
 
-        if not skip_loop and args.pipeline and mesh is None:
+        if not skip_loop and args.pipeline:
+            # pipeline and mesh COMPOSE: with a mesh the chained dispatches
+            # run as one SPMD program over the path axis (push_fn places the
+            # corrections with the exact shardings the in-flight outputs
+            # carry, so GSPMD inserts no resharding between segments)
             from mythril_tpu.frontier.pipeline import PipelinedRunner
 
             runner = PipelinedRunner(
@@ -980,6 +1025,7 @@ class FrontierEngine:
                 cfg=cfg, dev_arena=dev_arena, arena_len=arena_len,
                 visited=visited, deadline=deadline,
                 program_key=program_key, program_warm=program_warm,
+                mesh=mesh, push_fn=push_sharded,
             )
             runner.run()
             st = runner.st

@@ -154,6 +154,18 @@ def _replay_group(walker, recs: List[PathRecord]) -> None:
             rec._replay_err = e
 
 
+def _replay_subgroups(walker, subgroups: List[List[PathRecord]]) -> None:
+    """Replay one laser's per-device subgroups sequentially, device order.
+
+    Under a path-sharded mesh the replay shard key is (device, laser); a
+    laser's state is still single-threaded, so all of its device subgroups
+    run on ONE worker, back to back.  Shards are contiguous slot blocks, so
+    device order within a laser is exactly slot order — bit-identical to
+    the unsharded per-laser replay."""
+    for recs in subgroups:
+        _replay_group(walker, recs)
+
+
 # The replay pool is process-wide and persistent (spawning threads per
 # harvest would cost more than short replays take); it is resized lazily
 # when --harvest-workers changes between analyses (bench compare modes)
@@ -261,21 +273,33 @@ class HarvestExecutor:
             finishing.append(slot)
             free_cnt += 1
 
-        # replay: shard by owning laser, slot order within each shard
+        # replay: shard by (device, owning laser) — slot order within each
+        # shard.  The device component is the slot's owning path-shard
+        # (identity when there is no mesh), so per-shard pull attribution
+        # and replay accounting line up; per-laser serialization is kept by
+        # merging a laser's device subgroups onto one worker
         t3 = time.perf_counter()
         pool = _shared_pool(self.workers)
         if pool is not None and finishing:
-            groups: Dict[int, List[PathRecord]] = {}
+            n_sh = max(1, getattr(pipe, "n_shards", 1)) if pipe else 1
+            groups: Dict[tuple, List[PathRecord]] = {}
             for slot in finishing:
                 rec = records[slot]
-                groups.setdefault(id(walker.laser_for(rec)), []).append(rec)
+                key = (slot * n_sh // caps.B, id(walker.laser_for(rec)))
+                groups.setdefault(key, []).append(rec)
+            by_laser: Dict[int, List[List[PathRecord]]] = {}
+            for shard, lid in sorted(groups):
+                by_laser.setdefault(lid, []).append(groups[(shard, lid)])
             futs = [
-                pool.submit(_replay_group, walker, recs)
-                for recs in groups.values()
+                pool.submit(_replay_subgroups, walker, subs)
+                for subs in by_laser.values()
             ]
             for f in futs:
                 f.result()
-            reg.counter("frontier.harvest.replay_shards").inc(len(groups))
+            reg.counter("frontier.harvest.replay_shards").inc(len(by_laser))
+            reg.counter("frontier.harvest.device_laser_shards").inc(
+                len(groups)
+            )
             reg.counter("frontier.harvest.sharded_paths").inc(len(finishing))
         else:
             for slot in finishing:

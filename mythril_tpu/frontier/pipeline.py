@@ -76,6 +76,67 @@ def _pc(name: str):
     return _get_metrics().counter("pipeline." + name)
 
 
+def plan_rebalance(live: np.ndarray, free: np.ndarray, n_shards: int,
+                   max_moves: int = 4) -> List[int]:
+    """Slots to SPILL so live paths spread across path-shards.
+
+    ``live``/``free`` are [B] bool masks (running record present / host-
+    reclaimable).  Returns source slots, hottest shard first — the spill/
+    re-inject machinery parks them and re-injects into the coolest shards'
+    free slots (``choose_free_slot``).  A move is planned only while the
+    hottest shard holds at least 2 more live paths than the coolest AND the
+    coolest shards have free slots to receive them, so a balanced (or
+    fully packed) pod plans nothing.  Pure numpy, unit-testable."""
+    B = live.shape[0]
+    if n_shards <= 1 or B % n_shards:
+        return []
+    sz = B // n_shards
+    live_by = live.reshape(n_shards, sz).sum(axis=1)
+    free_by = free.reshape(n_shards, sz).sum(axis=1).astype(np.int64)
+    moves: List[int] = []
+    spilled = np.zeros(B, bool)
+    while len(moves) < max_moves:
+        hot = int(np.argmax(live_by))
+        order = np.argsort(live_by, kind="stable")
+        cold = next((int(s) for s in order if free_by[s] > 0), None)
+        if cold is None or live_by[hot] - live_by[cold] < 2:
+            break
+        # spill the hot shard's LAST live slot (latest-injected first, so
+        # long-running early paths keep their device residency)
+        block = np.flatnonzero(live[hot * sz:(hot + 1) * sz]
+                               & ~spilled[hot * sz:(hot + 1) * sz])
+        if block.size == 0:
+            break
+        src = hot * sz + int(block[-1])
+        spilled[src] = True
+        moves.append(src)
+        live_by[hot] -= 1
+        live_by[cold] += 1
+        free_by[cold] -= 1
+    return moves
+
+
+def choose_free_slot(free: np.ndarray, live: np.ndarray,
+                     n_shards: int) -> Optional[int]:
+    """First free slot on the least-loaded shard (ties to the lowest shard
+    index; slot order within a shard).  With one shard this is exactly the
+    pre-pod first-free scan, so single-device injection order — and hence
+    the parity baseline — is unchanged."""
+    idx = np.flatnonzero(free)
+    if idx.size == 0:
+        return None
+    B = free.shape[0]
+    if n_shards <= 1 or B % n_shards:
+        return int(idx[0])
+    sz = B // n_shards
+    live_by = live.reshape(n_shards, sz).sum(axis=1)
+    for shard in np.argsort(live_by, kind="stable"):
+        block = np.flatnonzero(free[shard * sz:(shard + 1) * sz])
+        if block.size:
+            return int(shard) * sz + int(block[0])
+    return None
+
+
 class CorrectionLedger:
     """Exactly-once correction bookkeeping for chained dispatches.
 
@@ -221,7 +282,8 @@ class PipelinedRunner:
                  seeds, seed_lasers, lasers, ctxs, seed_code_idx, mid_enc,
                  seed_queue, statics, beam, tables, table_code, table_idx,
                  segment, code_dev, cfg, dev_arena, arena_len, visited,
-                 deadline, program_key, program_warm):
+                 deadline, program_key, program_warm, mesh=None,
+                 push_fn=None):
         self.engine = engine
         self.caps = engine.caps
         self.st = st
@@ -250,6 +312,27 @@ class PipelinedRunner:
         self.deadline = deadline
         self.program_key = program_key
         self.program_warm = program_warm
+
+        # pod composition: with a mesh the slot batch is path-sharded and
+        # every chained dispatch is one SPMD program.  push_fn is the
+        # engine's path-sharded push (push_state otherwise); the mask
+        # sharding places correction masks exactly like the state rows, so
+        # correction merges stay shard-local.
+        self.mesh = mesh
+        self.push_fn = push_fn
+        self.n_shards = 1
+        self.mask_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from mythril_tpu.parallel.mesh import PATH_AXIS
+
+            self.n_shards = int(mesh.shape[PATH_AXIS])
+            self.mask_sharding = NamedSharding(
+                mesh, PartitionSpec(PATH_AXIS)
+            )
+            _get_metrics().gauge("pipeline.mesh_shards").set(self.n_shards)
+        self._rebalance_backoff = 0
 
         self.ledger = CorrectionLedger(self.caps.B)
         self.pool = FeasibilityPool(args.solver_workers)
@@ -323,13 +406,24 @@ class PipelinedRunner:
 
     # -- refill ---------------------------------------------------------
 
+    def _slot_masks(self):
+        """([B] live, [B] free) numpy masks of the host's current view:
+        live = running record present, free = host-reclaimable."""
+        B = self.caps.B
+        rec = np.fromiter(
+            (self.records[s] is not None for s in range(B)), bool, B
+        )
+        seed = np.asarray(self.st.seed)
+        live = rec & (np.asarray(self.st.halt) == O.H_RUNNING) & (seed >= 0)
+        free = ~rec & ~self.ledger.device_owned & (seed < 0)
+        return live, free
+
     def _free_slot(self) -> Optional[int]:
-        for slot in range(self.caps.B):
-            if (self.records[slot] is None
-                    and not self.ledger.device_owned[slot]
-                    and int(self.st.seed[slot]) < 0):
-                return slot
-        return None
+        """Next injection target.  Single-device: first free slot (the
+        pre-pod scan).  Mesh: a free slot on the least-loaded shard, so
+        injections spread over the pod instead of packing shard 0."""
+        live, free = self._slot_masks()
+        return choose_free_slot(free, live, self.n_shards)
 
     def refill(self) -> None:
         """Queued seeds into host-reclaimable free slots.  Unlike the
@@ -338,13 +432,10 @@ class PipelinedRunner:
         from mythril_tpu.frontier.engine import _beam_importance
 
         eng = self.engine
-        for slot in range(self.caps.B):
-            if not self.seed_queue:
+        while self.seed_queue:
+            slot = self._free_slot()
+            if slot is None:
                 break
-            if (self.records[slot] is not None
-                    or self.ledger.device_owned[slot]
-                    or int(self.st.seed[slot]) >= 0):
-                continue
             si = self.seed_queue.pop(0)
             eng._inject(self.st, slot, si, self.ctxs[si],
                         self.seed_code_idx[si],
@@ -416,6 +507,44 @@ class PipelinedRunner:
             return True
         return False
 
+    def _rebalance(self) -> bool:
+        """Sync-point live-slot rebalance across path-shards.
+
+        Spills the hottest shard's youngest live paths through the ordinary
+        batch-full park flow — snapshot, forced ``H_PARK``, walker replay +
+        commit — so they land in ``reinject_q`` via the park sink and are
+        re-injected (same sync point) into the coolest shards' free slots.
+        Every spill and re-injection goes through ``ledger.touch``, so the
+        exactly-once correction protocol is preserved.  Returns True when
+        any slot moved."""
+        from mythril_tpu.frontier.records import snapshot_slot
+
+        live, free = self._slot_masks()
+        moves = plan_rebalance(live, free, self.n_shards)
+        if not moves:
+            return False
+        stats = FrontierStatistics()
+        for src in moves:
+            rec = self.records[src]
+            rec.final = snapshot_slot(self.st, src)
+            rec.final["halt"] = O.H_PARK
+            stats.device_paths += 1
+            stats.record_bulk_park("rebalance")
+            try:
+                self.walker.replay(rec)
+                self.walker.commit(rec)
+            except Exception as e:  # pragma: no cover - diagnostics
+                log.warning(
+                    "frontier rebalance failed on a path: %s", e,
+                    exc_info=True,
+                )
+            self.records[src] = None
+            clear_slot(self.st, src)
+            self.ev_seen[src] = 0
+            self.ledger.touch(src)
+            _pc("rebalanced_slots").inc()
+        return True
+
     def _flush_reinject_queue(self) -> None:
         for laser, carrier in self.reinject_q:
             laser.work_list.append(carrier)
@@ -436,7 +565,7 @@ class PipelinedRunner:
         from mythril_tpu.frontier.step import push_state
 
         cfg = self._ramped_cfg()
-        st_dev = push_state(self.st)
+        st_dev = (self.push_fn or push_state)(self.st)
         self.ledger.consume_all()
         # every free slot is exposed to the device again
         for slot in range(self.caps.B):
@@ -452,7 +581,9 @@ class PipelinedRunner:
         mask = self.ledger.consume(self.st.seed)
         out = chain_dispatch(self.segment, inflight, self.st, mask,
                              self.code_dev, cfg,
-                             arena_override=arena_override)
+                             arena_override=arena_override,
+                             push_fn=self.push_fn,
+                             mask_sharding=self.mask_sharding)
         _pc("segments_pipelined").inc()
         return out
 
@@ -467,7 +598,10 @@ class PipelinedRunner:
         narrow_harvests = 0
         run_segments = 0
         stop: Optional[str] = None
-        micro_pending = bool(args.frontier_microbench and not stats.microbench)
+        # microbench timings are single-device figures; skip it on a mesh
+        # (the synchronous loop applies the same gate)
+        micro_pending = bool(args.frontier_microbench and not stats.microbench
+                             and self.mesh is None)
 
         t0 = time.perf_counter()
         inflight, full_args = self._dispatch_full()
@@ -492,6 +626,18 @@ class PipelinedRunner:
                     micro_pending or self.reinject_q
                     or (self.seed_queue and free_owned)
                 )
+                if (not want_sync and self.n_shards > 1
+                        and stop is None and not deadline_hit):
+                    # pod imbalance: force a sync point so _rebalance can
+                    # spill/re-inject; backoff avoids syncing every segment
+                    # when the imbalance is not fixable (e.g. no free slots)
+                    if self._rebalance_backoff > 0:
+                        self._rebalance_backoff -= 1
+                    else:
+                        live_m, free_m = self._slot_masks()
+                        if plan_rebalance(live_m, free_m, self.n_shards):
+                            want_sync = True
+                            _pc("rebalance_syncs").inc()
                 nxt = None
                 nxt_wall = 0.0
                 if stop is None and not deadline_hit and not want_sync:
@@ -515,6 +661,7 @@ class PipelinedRunner:
                         pull_harvest(
                             out_state, out_len, n_exec, seg_ml,
                             prev=prev_st if nxt is not None else None,
+                            shards=self.n_shards,
                         )
                     )
                 bubble = time.perf_counter() - t_pull
@@ -655,6 +802,9 @@ class PipelinedRunner:
                     break
                 self.ledger.release_owned()
                 self.arena.thaw()
+                if self.n_shards > 1:
+                    moved = self._rebalance()
+                    self._rebalance_backoff = 0 if moved else 2
                 if self.reinject_q:
                     self._reinject()
                 self.refill()

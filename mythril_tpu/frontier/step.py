@@ -1277,7 +1277,7 @@ def _bucketed(n: int, full: int) -> int:
 
 
 def pull_harvest(state: FrontierState, arena_len, n_exec, max_live,
-                 prev: FrontierState = None):
+                 prev: FrontierState = None, shards: int = 1):
     """Device->host harvest transfer.
 
     ``prev=None`` (synchronous loop, sync points, mesh): ONE packed pull of
@@ -1299,7 +1299,14 @@ def pull_harvest(state: FrontierState, arena_len, n_exec, max_live,
     ever read again by a full push, and every sync point full-pulls first
     (the pipeline passes ``prev`` only when a dispatch is chained).
     Against the full pull this drops the per-segment meta transfer from
-    every [B, W] plane to ~16*B scalars + the few finishing rows."""
+    every [B, W] plane to ~16*B scalars + the few finishing rows.
+
+    ``shards > 1`` (pipelined mesh run): the pulled bytes are additionally
+    attributed per path-shard (slot blocks of B/shards) into the
+    ``pipeline.delta_pull_bytes_by_shard`` labeled counter, so a hot shard's
+    outsized pull traffic is visible per device.  Gather-pad rows are
+    excluded from the attribution (they carry no slot), so the per-shard
+    figures sum to slightly less than the raw transfer total."""
     assert all(f.dtype == np.int32 for f in state), (
         "packed state transfer assumes uniform int32 fields"
     )
@@ -1336,6 +1343,10 @@ def pull_harvest(state: FrontierState, arena_len, n_exec, max_live,
         off += B
     scalars = (int(buf[off]), int(buf[off + 1]), int(buf[off + 2]))
     pulled_bytes = buf.nbytes
+    n_sh = max(1, int(shards))
+    # [B] planes split evenly over the contiguous slot blocks; row/event
+    # gathers attribute by the pulled slot's owning shard
+    shard_bytes = np.full(n_sh, buf.nbytes // n_sh, np.int64)
 
     halt, seed = fields["halt"], fields["seed"]
     ev_len = np.minimum(fields["ev_len"], EVT)
@@ -1354,6 +1365,7 @@ def pull_harvest(state: FrontierState, arena_len, n_exec, max_live,
         pad[: idx.size] = idx
         rows = np.asarray(_gather_rows(state, jnp.asarray(pad)))
         pulled_bytes += rows.nbytes
+        np.add.at(shard_bytes, idx * n_sh // B, rows.nbytes // cap_n)
         off2 = 0
         for n in names_2d:
             w = fields[n].shape[1]
@@ -1375,11 +1387,16 @@ def pull_harvest(state: FrontierState, arena_len, n_exec, max_live,
         ).reshape(cap_m, cap, EVW)
         events[ev_idx, :cap, :] = pulled[: ev_idx.size]
         pulled_bytes += pulled.nbytes
+        np.add.at(shard_bytes, ev_idx * n_sh // B, pulled.nbytes // cap_m)
     fields["events"] = events
 
     reg = get_registry()
     reg.counter("pipeline.delta_pulls").inc()
     reg.counter("pipeline.delta_pull_bytes").inc(pulled_bytes)
+    if n_sh > 1:
+        by_shard = reg.labeled_counter("pipeline.delta_pull_bytes_by_shard")
+        for k in range(n_sh):
+            by_shard[f"shard{k}"] += int(shard_bytes[k])
     return (FrontierState(**fields), *scalars)
 
 
@@ -1449,23 +1466,55 @@ def pull_arena_rows(dev_arena: ArenaDev, lo: int, hi: int):
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+# the non-event fields the correction upload actually merges; events/ev_len
+# are rebuilt empty on device, so the correction push's (constant) event
+# buffers never enter the merge — and therefore are never donated
+_MERGE_FIELDS = tuple(
+    n for n in FrontierState._fields if n not in ("events", "ev_len")
+)
+
+
+@lru_cache(maxsize=2)
+def _merge_fn(donate: bool):
+    """The chained-dispatch correction merge, optionally DONATING the
+    correction tuple (argnum 1).  The correction buffers are freshly pushed
+    per chain and never read again, so on backends with real buffer
+    donation (TPU) XLA aliases them straight into the merged outputs — the
+    carried frontier state never double-buffers (SNIPPETS.md [3]).  The
+    segment itself still never donates (see _SEGMENT_DONATE_ARGNUMS); the
+    previous output cannot be donated either, because pull_harvest reads it
+    AFTER the chain is dispatched."""
+
+    @partial(jax.jit, donate_argnums=(1,) if donate else ())
+    def merge(prev: FrontierState, corr_fields, mask) -> FrontierState:
+        def pick(c, p):
+            m = mask.reshape((-1,) + (1,) * (p.ndim - 1))
+            return jnp.where(m, c, p)
+
+        fields = dict(zip(_MERGE_FIELDS, corr_fields))
+        merged = {
+            name: pick(fields[name], p) if name in fields else p
+            for name, p in zip(prev._fields, prev)
+        }
+        merged["events"] = jnp.full_like(prev.events, -1)
+        merged["ev_len"] = jnp.zeros_like(prev.ev_len)
+        return FrontierState(**merged)
+
+    return merge
+
+
 def _merge_corrections(prev: FrontierState, corr: FrontierState,
                        mask) -> FrontierState:
-    def pick(c, p):
-        m = mask.reshape((-1,) + (1,) * (p.ndim - 1))
-        return jnp.where(m, c, p)
-
-    merged = FrontierState(*[pick(c, p) for c, p in zip(corr, prev)])
-    return merged._replace(
-        events=jnp.full_like(prev.events, -1),
-        ev_len=jnp.zeros_like(prev.ev_len),
+    donate = jax.default_backend() != "cpu"  # CPU: donation unimplemented
+    corr_fields = tuple(
+        f for n, f in zip(corr._fields, corr) if n in _MERGE_FIELDS
     )
+    return _merge_fn(donate)(prev, corr_fields, mask)
 
 
 def chain_dispatch(segment, prev_out, host_state: FrontierState,
                    corr_mask: np.ndarray, code_dev, cfg,
-                   arena_override=None):
+                   arena_override=None, push_fn=None, mask_sharding=None):
     """Dispatch the next segment on the previous segment's device outputs.
 
     ``prev_out`` is the 6-tuple a segment call returned (possibly still
@@ -1475,12 +1524,21 @@ def chain_dispatch(segment, prev_out, host_state: FrontierState,
     but the un-flagged slots keep the device's own (possibly further
     advanced) values, so the device never waits for the host.
     ``arena_override`` replaces the chained (dev_arena, arena_len) pair
-    after a sync-point host append (re-injection rows)."""
+    after a sync-point host append (re-injection rows).
+
+    Mesh runs pass ``push_fn`` (the engine's path-sharded push) and
+    ``mask_sharding`` (the [B] path sharding) so the correction upload and
+    its mask land with EXACTLY the shardings the in-flight outputs carry:
+    the merge and the chained segment then run as one SPMD program with
+    matching in/out shardings across every chained dispatch (SNIPPETS.md
+    [1]–[2]) and GSPMD inserts no resharding between them."""
     out_state, dev_arena, out_len, _n_exec, _max_live, visited = prev_out
     if arena_override is not None:
         dev_arena, out_len = arena_override
-    corr = push_state(host_state)
-    merged = _merge_corrections(out_state, corr, jax.device_put(corr_mask))
+    corr = (push_fn or push_state)(host_state)
+    mask = (jax.device_put(corr_mask, mask_sharding)
+            if mask_sharding is not None else jax.device_put(corr_mask))
+    merged = _merge_corrections(out_state, corr, mask)
     return segment(merged, dev_arena, out_len, visited, code_dev, cfg)
 
 

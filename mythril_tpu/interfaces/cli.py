@@ -162,6 +162,15 @@ def _add_analysis_options(parser) -> None:
         "segment/harvest loop; the issue set is identical either way",
     )
     group.add_argument(
+        "--no-mesh",
+        action="store_false",
+        dest="frontier_mesh",
+        default=True,
+        help="disable path-sharded SPMD execution over the attached device "
+        "mesh and run the frontier on a single device; composes with "
+        "--no-pipeline (all four combinations yield the same issue set)",
+    )
+    group.add_argument(
         "--solver-workers",
         type=int,
         default=2,
@@ -184,8 +193,10 @@ def _add_analysis_options(parser) -> None:
         "--compile-cache-dir",
         metavar="DIR",
         help="persist XLA compilations in DIR and reuse them across "
-        "processes (skips segment recompiles on warm starts); default "
-        "off unless the MYTHRIL_TPU_COMPILATION_CACHE env var opts in",
+        "processes (skips segment recompiles on warm starts); default ON "
+        "under ~/.cache/mythril-tpu/xla — set the "
+        "MYTHRIL_TPU_COMPILATION_CACHE env var to 0/off to disable, or "
+        "to a path to relocate",
     )
     group.add_argument(
         "--no-staticpass",
@@ -384,6 +395,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         query_cache_dir=getattr(parsed, "query_cache_dir", None),
         staticpass=not getattr(parsed, "no_staticpass", False),
         pipeline=getattr(parsed, "pipeline", True),
+        frontier_mesh=getattr(parsed, "frontier_mesh", True),
         solver_workers=getattr(parsed, "solver_workers", 2),
         harvest_workers=getattr(parsed, "harvest_workers", 4),
         compile_cache_dir=getattr(parsed, "compile_cache_dir", None),

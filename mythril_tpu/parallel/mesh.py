@@ -52,6 +52,39 @@ def make_frontier_mesh(
     return Mesh(np.asarray(devices).reshape(p, c), (PATH_AXIS, CAND_AXIS))
 
 
+def pad_batch(b: int, n_shards: int) -> int:
+    """Smallest multiple of ``n_shards`` >= ``b``: the slot-batch width that
+    shards evenly over the path axis.  The extra slots are dead (seed -1
+    free slots) and cost only their share of the packed transfers."""
+    if n_shards <= 1:
+        return b
+    return b + (-b) % n_shards
+
+
+def shard_size(b: int, n_shards: int) -> int:
+    """Slots per path-shard; ``b`` must already be a multiple (pad_batch)."""
+    assert n_shards >= 1 and b % n_shards == 0, (b, n_shards)
+    return b // n_shards
+
+
+def slot_shard(slot: int, b: int, n_shards: int) -> int:
+    """Owning path-shard of a slot: the path axis splits [B] into
+    ``n_shards`` contiguous blocks, matching GSPMD's dim-0 partitioning."""
+    return slot // shard_size(b, n_shards)
+
+
+def shard_slots(b: int, n_shards: int) -> np.ndarray:
+    """[B] vector mapping every slot to its owning shard."""
+    return np.arange(b) // shard_size(b, n_shards)
+
+
+def path_sharding(mesh: Mesh, x) -> NamedSharding:
+    """NamedSharding splitting ``x``'s leading (slot-batch) dim over the
+    path axis, trailing dims replicated — the placement every per-slot
+    frontier plane uses (state fields, correction masks, event planes)."""
+    return NamedSharding(mesh, P(PATH_AXIS, *([None] * (x.ndim - 1))))
+
+
 def shard_frontier_inputs(state, arena_dev, visited, code_dev, mesh: Mesh):
     """Shard the batched frontier-interpreter inputs over ``mesh``'s path
     axis: every FrontierState field carries a leading [B] path dimension
@@ -66,8 +99,7 @@ def shard_frontier_inputs(state, arena_dev, visited, code_dev, mesh: Mesh):
     """
 
     def path_shard(x):
-        spec = P(PATH_AXIS, *([None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.device_put(x, path_sharding(mesh, x))
 
     repl = NamedSharding(mesh, P())
     state = jax.tree.map(path_shard, state)

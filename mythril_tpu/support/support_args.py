@@ -58,8 +58,10 @@ class Args:
     # on deliberately tiny contracts
     frontier_force: bool = False
     # SPMD the frontier segment over all visible devices (path axis); the
-    # engine shards automatically when >1 device is attached and the batch
-    # width divides evenly
+    # engine shards automatically when >1 device is attached, padding the
+    # batch width up to a device-count multiple with dead slots.  Composes
+    # with the pipelined runner (chained dispatches run as one SPMD
+    # program); --no-mesh is the single-device escape hatch
     frontier_mesh: bool = True
     # measure pure device-compute time of the first segment (chained
     # re-dispatch subtraction, tunnel-independent) into
@@ -93,8 +95,10 @@ class Args:
     # commit in slot order, so issue sets are identical to the serial
     # sweep.  0 = serial escape hatch (and the parity baseline)
     harvest_workers: int = 4
-    # persistent XLA compilation cache directory (None = off unless the
-    # MYTHRIL_TPU_COMPILATION_CACHE env var opts in)
+    # persistent XLA compilation cache directory (None = the per-user
+    # default under ~/.cache/mythril-tpu/xla; the
+    # MYTHRIL_TPU_COMPILATION_CACHE env var disables with 0/off or
+    # relocates with a path)
     compile_cache_dir: Optional[str] = None
 
 

@@ -267,9 +267,14 @@ def test_break_paths_return_queued_seeds_to_work_list():
     from mythril_tpu.analysis.symbolic import SymExecWrapper
 
     reset_callback_modules()
-    old = (global_args.frontier, global_args.frontier_force)
+    old = (global_args.frontier, global_args.frontier_force,
+           global_args.frontier_mesh)
     global_args.frontier = False
     global_args.frontier_force = True
+    # single-device: mesh padding would widen B=2 up to the device count,
+    # giving every seed a slot — nothing would queue and this fast contract
+    # finishes before the break path this test exists to exercise
+    global_args.frontier_mesh = False
     try:
         sym = SymExecWrapper(
             bytes.fromhex(DISPATCH + "33ff"),
@@ -300,7 +305,8 @@ def test_break_paths_return_queued_seeds_to_work_list():
             f"{n_before - len(laser.work_list)} seeds vanished"
         )
     finally:
-        global_args.frontier, global_args.frontier_force = old
+        (global_args.frontier, global_args.frontier_force,
+         global_args.frontier_mesh) = old
 
 
 def test_host_step_rate_requires_samples():
