@@ -54,6 +54,10 @@ class AnalyzerArgs:
     solver_workers: int = 2
     harvest_workers: int = 4
     compile_cache_dir: Optional[str] = None
+    heartbeat_out: Optional[str] = None
+    heartbeat_interval: float = 0.5
+    flight_recorder: Optional[str] = None
+    watchdog_deadline: Optional[float] = None
 
 
 class MythrilAnalyzer:
@@ -113,6 +117,10 @@ class MythrilAnalyzer:
         args.solver_workers = getattr(cmd_args, "solver_workers", 2)
         args.harvest_workers = getattr(cmd_args, "harvest_workers", 4)
         args.compile_cache_dir = getattr(cmd_args, "compile_cache_dir", None)
+        args.heartbeat_out = getattr(cmd_args, "heartbeat_out", None)
+        args.heartbeat_interval = getattr(cmd_args, "heartbeat_interval", 0.5)
+        args.flight_recorder = getattr(cmd_args, "flight_recorder", None)
+        args.watchdog_deadline = getattr(cmd_args, "watchdog_deadline", None)
         from mythril_tpu.querycache import configure as _configure_query_cache
 
         _configure_query_cache(

@@ -62,6 +62,7 @@ from mythril_tpu.frontier.harvest import HarvestExecutor
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
 from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability import flightrecorder as _frec
 from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.observability.metrics import get_registry as _get_metrics
 from mythril_tpu.frontier.step import (
@@ -959,10 +960,15 @@ class FrontierEngine:
             )
             st_nat = st._replace(loops=st.loops[:, :nat_lc])
             t_seg = time.perf_counter()
+            _fid0 = (_otrace.get_tracer().new_flow_id()
+                     if _otrace.get_tracer().enabled else None)
             with _otrace.span(
                 "frontier.segment", cat="device", segment=-1,
                 warm=(caps, natural_bucket) in _WARM_PROGRAMS, opening=True,
             ), _otrace.device_annotation("frontier.segment"):
+                if _fid0 is not None:
+                    _otrace.get_tracer().flow("s", _fid0, "flow.segment",
+                                              cat="device")
                 out_state, dev_arena, out_len, n_exec, seg_ml, nat_visited = (
                     nat_segment(push_state(st_nat), dev_arena, arena_len,
                                 nat_visited, nat_code_dev, cfg0)
@@ -970,6 +976,7 @@ class FrontierEngine:
                 st_p, arena_len, n_exec_host, seg_ml_host = pull_harvest(
                     out_state, out_len, n_exec, seg_ml
                 )
+            _frec.beat()
             max_live = max(max_live, seg_ml_host)
             arena.pull_from_device(dev_arena, arena_len)
             executed += n_exec_host
@@ -986,6 +993,9 @@ class FrontierEngine:
             t_har = time.perf_counter()
             with _otrace.span("frontier.harvest", cat="frontier",
                               segment=-1):
+                if _fid0 is not None:
+                    _otrace.get_tracer().flow("f", _fid0, "flow.segment",
+                                              cat="device")
                 self._harvest(st, records, walker, ev_seen)
             ev_seen.fill(0)
             har_only = time.perf_counter() - t_har
@@ -1036,6 +1046,9 @@ class FrontierEngine:
             slow_bailed = runner.slow_bailed
             width_verdict_valid = runner.width_verdict_valid
             skip_loop = True
+        watch = _frec.activity() if not skip_loop else None
+        if watch is not None:
+            watch.__enter__()
         while not skip_loop:
             if time.perf_counter() > deadline or time_handler.time_remaining() <= 0:
                 log.info("frontier: execution timeout; parking live paths")
@@ -1065,10 +1078,15 @@ class FrontierEngine:
                 micro_args = (
                     st_dev, dev_arena, arena_len, visited, code_dev, cfg
                 )
+            _fid = (_otrace.get_tracer().new_flow_id()
+                    if _otrace.get_tracer().enabled else None)
             with _otrace.span(
                 "frontier.segment", cat="device",
                 segment=run_segments, warm=program_warm,
             ), _otrace.device_annotation("frontier.segment"):
+                if _fid is not None:
+                    _otrace.get_tracer().flow("s", _fid, "flow.segment",
+                                              cat="device")
                 out_state, dev_arena, out_len, n_exec, seg_max_live, visited = (
                     segment(st_dev, dev_arena, arena_len, visited, code_dev, cfg)
                 )
@@ -1078,6 +1096,7 @@ class FrontierEngine:
                 st, arena_len_new, n_exec_host, seg_ml_host = pull_harvest(
                     out_state, out_len, n_exec, seg_max_live
                 )
+            _frec.beat()
             max_live = max(max_live, seg_ml_host)
             arena.pull_from_device(dev_arena, arena_len_new)
             arena_len = arena_len_new
@@ -1100,6 +1119,9 @@ class FrontierEngine:
             t_har = time.perf_counter()
             with _otrace.span("frontier.harvest", cat="frontier",
                               segment=run_segments):
+                if _fid is not None:
+                    _otrace.get_tracer().flow("f", _fid, "flow.segment",
+                                              cat="device")
                 self._harvest(st, records, walker, ev_seen)
             # events were fully drained into the path records, and the next
             # segment starts with EMPTY device buffers (push_state rebuilds
@@ -1211,6 +1233,8 @@ class FrontierEngine:
                     break
             else:
                 narrow_harvests = 0
+        if watch is not None:
+            watch.__exit__(None, None, None)
 
         if slow_bailed:
             # slow: proven slower than host stepping on this link (absolute
@@ -1462,6 +1486,7 @@ class FrontierEngine:
                 pipe.pool.submit(
                     slot, rec, n_cons, raws,
                     frozenset(t.tid for t in raws),
+                    sid=getattr(pipe, "current_sid", -1),
                 )
             return
         # harvest feasibility is one of the query cache's three entry points

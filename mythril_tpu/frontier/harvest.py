@@ -54,6 +54,7 @@ from mythril_tpu.frontier import ops as O
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import FrontierState, clear_slot
 from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.observability.metrics import get_registry as _get_metrics
 from mythril_tpu.support.support_args import args
 
@@ -154,16 +155,25 @@ def _replay_group(walker, recs: List[PathRecord]) -> None:
             rec._replay_err = e
 
 
-def _replay_subgroups(walker, subgroups: List[List[PathRecord]]) -> None:
+def _replay_subgroups(walker, subgroups: List[List[PathRecord]],
+                      sid: int = -1, fid: Optional[int] = None) -> None:
     """Replay one laser's per-device subgroups sequentially, device order.
 
     Under a path-sharded mesh the replay shard key is (device, laser); a
     laser's state is still single-threaded, so all of its device subgroups
     run on ONE worker, back to back.  Shards are contiguous slot blocks, so
     device order within a laser is exactly slot order — bit-identical to
-    the unsharded per-laser replay."""
-    for recs in subgroups:
-        _replay_group(walker, recs)
+    the unsharded per-laser replay.
+
+    ``sid``/``fid`` are flight-deck correlation handles: the worker span
+    carries the segment id and finishes the flow arrow the harvest thread
+    started when it submitted this laser's work."""
+    with _otrace.span("frontier.replay", cat="frontier", segment=sid,
+                      paths=sum(len(r) for r in subgroups)):
+        if fid is not None:
+            _otrace.get_tracer().flow("f", fid, "flow.replay", cat="frontier")
+        for recs in subgroups:
+            _replay_group(walker, recs)
 
 
 # The replay pool is process-wide and persistent (spawning threads per
@@ -219,19 +229,24 @@ class HarvestExecutor:
         caps = eng.caps
         reg = _get_metrics()
         stats = FrontierStatistics()
+        sid = getattr(pipe, "current_sid", -1) if pipe is not None else -1
 
         t0 = time.perf_counter()
-        ingest_events(st, records, ev_seen)
-        attribute_steps(st, records, walker)
+        with _otrace.span("frontier.harvest.ingest", cat="frontier",
+                          segment=sid):
+            ingest_events(st, records, ev_seen)
+            attribute_steps(st, records, walker)
         t1 = time.perf_counter()
         reg.observe("frontier.harvest.ingest_s", t1 - t0)
 
         # feasibility prune + mutation-check prefetch: batched solver work,
         # unchanged from the serial engine (the pipelined path submits to
         # the background pool and costs ~nothing here)
-        if not args.sparse_pruning:
-            eng._prune_running(st, records, walker, ev_seen, pipe)
-        eng._prefetch_mutation_checks(st, records, walker)
+        with _otrace.span("frontier.harvest.solver", cat="solver",
+                          segment=sid):
+            if not args.sparse_pruning:
+                eng._prune_running(st, records, walker, ev_seen, pipe)
+            eng._prefetch_mutation_checks(st, records, walker)
         t2 = time.perf_counter()
         reg.observe("frontier.harvest.solver_s", t2 - t1)
 
@@ -290,10 +305,18 @@ class HarvestExecutor:
             by_laser: Dict[int, List[List[PathRecord]]] = {}
             for shard, lid in sorted(groups):
                 by_laser.setdefault(lid, []).append(groups[(shard, lid)])
-            futs = [
-                pool.submit(_replay_subgroups, walker, subs)
-                for subs in by_laser.values()
-            ]
+            tracer = _otrace.get_tracer()
+            futs = []
+            for subs in by_laser.values():
+                fid = None
+                if tracer.enabled:
+                    # flow arrow: this harvest slice -> the worker's replay
+                    # span (emitted before submit so "s" precedes "f")
+                    fid = tracer.new_flow_id()
+                    tracer.flow("s", fid, "flow.replay", cat="frontier")
+                futs.append(
+                    pool.submit(_replay_subgroups, walker, subs, sid, fid)
+                )
             for f in futs:
                 f.result()
             reg.counter("frontier.harvest.replay_shards").inc(len(by_laser))
@@ -302,36 +325,40 @@ class HarvestExecutor:
             )
             reg.counter("frontier.harvest.sharded_paths").inc(len(finishing))
         else:
-            for slot in finishing:
-                rec = records[slot]
-                try:
-                    walker.replay(rec)
-                except Exception as e:
-                    rec._replay_err = e
+            with _otrace.span("frontier.replay", cat="frontier", segment=sid,
+                              paths=len(finishing)):
+                for slot in finishing:
+                    rec = records[slot]
+                    try:
+                        walker.replay(rec)
+                    except Exception as e:
+                        rec._replay_err = e
         t4 = time.perf_counter()
         reg.observe("frontier.harvest.replay_s", t4 - t3)
 
         # commit: main thread, slot order — park routing, slot recycling,
         # ledger touches
-        for slot in finishing:
-            rec = records[slot]
-            if rec._replay_err is not None:
-                log.warning(
-                    "frontier walker failed on a path: %s", rec._replay_err,
-                    exc_info=rec._replay_err,
-                )
-            else:
-                try:
-                    walker.commit(rec)
-                except Exception as e:  # pragma: no cover - diagnostics
+        with _otrace.span("frontier.harvest.commit", cat="frontier",
+                          segment=sid, paths=len(finishing)):
+            for slot in finishing:
+                rec = records[slot]
+                if rec._replay_err is not None:
                     log.warning(
-                        "frontier walker failed on a path: %s", e,
-                        exc_info=True,
+                        "frontier walker failed on a path: %s",
+                        rec._replay_err, exc_info=rec._replay_err,
                     )
-            records[slot] = None
-            clear_slot(st, slot)
-            ev_seen[slot] = 0
-            if pipe is not None:
-                pipe.ledger.touch(slot)
+                else:
+                    try:
+                        walker.commit(rec)
+                    except Exception as e:  # pragma: no cover - diagnostics
+                        log.warning(
+                            "frontier walker failed on a path: %s", e,
+                            exc_info=True,
+                        )
+                records[slot] = None
+                clear_slot(st, slot)
+                ev_seen[slot] = 0
+                if pipe is not None:
+                    pipe.ledger.touch(slot)
         t5 = time.perf_counter()
         reg.observe("frontier.harvest.commit_s", t5 - t4)

@@ -64,7 +64,9 @@ from mythril_tpu.frontier import ops as O
 from mythril_tpu.frontier.records import PathRecord
 from mythril_tpu.frontier.state import FrontierState, clear_slot
 from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability import flightrecorder as _frec
 from mythril_tpu.observability import tracer as _otrace
+from mythril_tpu.observability.heartbeat import get_heartbeat
 from mythril_tpu.observability.metrics import get_registry as _get_metrics
 from mythril_tpu.support.support_args import args
 from mythril_tpu.support.time_handler import time_handler
@@ -222,8 +224,8 @@ class FeasibilityPool:
         self._inflight: Dict[frozenset, list] = {}
         self._done: list = []
 
-    def submit(self, slot: int, rec, n_cons: int, raws, key: frozenset
-               ) -> None:
+    def submit(self, slot: int, rec, n_cons: int, raws, key: frozenset,
+               sid: int = -1) -> None:
         with self._lock:
             waiters = self._inflight.get(key)
             if waiters is not None:
@@ -231,21 +233,32 @@ class FeasibilityPool:
                 _pc("pool_inflight_dedup").inc()
                 return
             self._inflight[key] = [(slot, rec, n_cons)]
-            depth = len(self._inflight)
         _pc("pool_submitted").inc()
-        g = _get_metrics().gauge("pipeline.pool_queue_depth")
-        g.set(max(int(g.value or 0), depth))
-        self._executor.submit(self._work, key, raws)
+        # queue depth is a heartbeat-sampled gauge (pending()); publishing
+        # it here on every mutation left whatever the last submit saw,
+        # which read stale between sync points
+        tracer = _otrace.get_tracer()
+        fid = None
+        if tracer.enabled:
+            # flow arrow: harvest slice (caller's thread) -> worker span
+            fid = tracer.new_flow_id()
+            tracer.flow("s", fid, "flow.feasibility", cat="solver")
+        self._executor.submit(self._work, key, raws, sid, fid)
 
-    def _work(self, key: frozenset, raws) -> None:
+    def _work(self, key: frozenset, raws, sid: int = -1,
+              fid: Optional[int] = None) -> None:
         from mythril_tpu.smt.solver import check_satisfiable_batch
 
-        try:
-            with self._solver_lock:
-                ok = bool(check_satisfiable_batch([raws])[0])
-        except Exception as e:  # pragma: no cover - defensive
-            log.debug("background feasibility check failed: %s", e)
-            ok = True  # sound: the path just keeps running
+        with _otrace.span("pipeline.feasibility", cat="solver", segment=sid):
+            if fid is not None:
+                _otrace.get_tracer().flow("f", fid, "flow.feasibility",
+                                          cat="solver")
+            try:
+                with self._solver_lock:
+                    ok = bool(check_satisfiable_batch([raws])[0])
+            except Exception as e:  # pragma: no cover - defensive
+                log.debug("background feasibility check failed: %s", e)
+                ok = True  # sound: the path just keeps running
         with self._lock:
             self._done.append((key, ok))
 
@@ -342,6 +355,56 @@ class PipelinedRunner:
         self.max_live = 0
         self.slow_bailed = False
         self.width_verdict_valid = True
+
+        # flight-deck correlation: every dispatch (full or chained) gets a
+        # monotonic segment id that its pull, harvest, replay and
+        # feasibility spans all carry, plus a flow id linking the dispatch
+        # slice to the host work it produced (s at dispatch, t at pull,
+        # f at harvest)
+        self.seg_uid = -1
+        self.current_sid = -1  # sid of the segment being harvested
+        self._seg_flow: Dict[int, int] = {}
+        self._last_dispatch_sid = -1
+
+    def _begin_dispatch(self) -> int:
+        self.seg_uid += 1
+        sid = self.seg_uid
+        self._last_dispatch_sid = sid
+        tracer = _otrace.get_tracer()
+        if tracer.enabled:
+            self._seg_flow[sid] = tracer.new_flow_id()
+        return sid
+
+    # -- heartbeat source ----------------------------------------------
+
+    def _heartbeat_sample(self) -> dict:
+        """Queue depths for the heartbeat sampler.  Runs on the sampler
+        thread against concurrently-mutated state: values are snapshots,
+        and the sampler tolerates a transient race throwing."""
+        B = self.caps.B
+        live, free = self._slot_masks()
+        sample = {
+            "pipeline.pool_queue_depth": self.pool.pending(),
+            "pipeline.ledger_pending_corrections": int(
+                self.ledger.corr_mask.sum()
+            ),
+            "pipeline.reinject_queue_depth": len(self.reinject_q),
+            "pipeline.seed_queue_depth": len(self.seed_queue),
+            "frontier.arena_occupancy": int(self.arena.length),
+            "frontier.live_paths": int(live.sum()),
+        }
+        n_sh = self.n_shards
+        if n_sh >= 1 and B % max(n_sh, 1) == 0:
+            sz = B // n_sh
+            sample["pipeline.free_slots_by_shard"] = {
+                f"shard{i}": int(free[i * sz:(i + 1) * sz].sum())
+                for i in range(n_sh)
+            }
+            sample["pipeline.live_slots_by_shard"] = {
+                f"shard{i}": int(live[i * sz:(i + 1) * sz].sum())
+                for i in range(n_sh)
+            }
+        return sample
 
     # -- walker park sink: catch re-runnable spills ---------------------
 
@@ -564,28 +627,43 @@ class PipelinedRunner:
         """Full push of the host mirror (dispatch 0 and sync points)."""
         from mythril_tpu.frontier.step import push_state
 
+        sid = self._begin_dispatch()
         cfg = self._ramped_cfg()
-        st_dev = (self.push_fn or push_state)(self.st)
-        self.ledger.consume_all()
-        # every free slot is exposed to the device again
-        for slot in range(self.caps.B):
-            self.ledger.device_owned[slot] = self.records[slot] is None
-        full_args = (st_dev, self.dev_arena, self.arena_len, self.visited,
-                     self.code_dev, cfg)
-        return self.segment(*full_args), full_args
+        with _otrace.span("frontier.dispatch", cat="device", segment=sid,
+                          full=True, shards=self.n_shards):
+            self._emit_dispatch_flow(sid)
+            st_dev = (self.push_fn or push_state)(self.st)
+            self.ledger.consume_all()
+            # every free slot is exposed to the device again
+            for slot in range(self.caps.B):
+                self.ledger.device_owned[slot] = self.records[slot] is None
+            full_args = (st_dev, self.dev_arena, self.arena_len,
+                         self.visited, self.code_dev, cfg)
+            out = self.segment(*full_args)
+        return out, full_args
 
     def _chain(self, inflight, arena_override=None):
         from mythril_tpu.frontier.step import chain_dispatch
 
+        sid = self._begin_dispatch()
         cfg = self._ramped_cfg()
-        mask = self.ledger.consume(self.st.seed)
-        out = chain_dispatch(self.segment, inflight, self.st, mask,
-                             self.code_dev, cfg,
-                             arena_override=arena_override,
-                             push_fn=self.push_fn,
-                             mask_sharding=self.mask_sharding)
+        with _otrace.span("frontier.dispatch", cat="device", segment=sid,
+                          chained=True, shards=self.n_shards):
+            self._emit_dispatch_flow(sid)
+            mask = self.ledger.consume(self.st.seed)
+            out = chain_dispatch(self.segment, inflight, self.st, mask,
+                                 self.code_dev, cfg,
+                                 arena_override=arena_override,
+                                 push_fn=self.push_fn,
+                                 mask_sharding=self.mask_sharding,
+                                 segment_id=sid)
         _pc("segments_pipelined").inc()
         return out
+
+    def _emit_dispatch_flow(self, sid: int) -> None:
+        fid = self._seg_flow.get(sid)
+        if fid is not None:
+            _otrace.get_tracer().flow("s", fid, "flow.segment", cat="device")
 
     def run(self) -> None:
         from mythril_tpu.frontier import engine as _eng
@@ -603,13 +681,27 @@ class PipelinedRunner:
         micro_pending = bool(args.frontier_microbench and not stats.microbench
                              and self.mesh is None)
 
+        hb = get_heartbeat()
+        hb.register("pipeline", self._heartbeat_sample)
+        hb_started = False
+        if not hb.running:
+            # CLI runs with --heartbeat-out arm the sampler up front; any
+            # other pipelined run (facade embedding, bench) starts it here
+            # so pool/ledger depth gauges are sampled, not set-on-mutation
+            hb.start(period_s=getattr(args, "heartbeat_interval", 0.5),
+                     out_path=getattr(args, "heartbeat_out", None))
+            hb_started = True
+
         t0 = time.perf_counter()
         inflight, full_args = self._dispatch_full()
+        inflight_sid = self._last_dispatch_sid
         dispatch_wall = time.perf_counter() - t0
         prev_st = self.st
         # while any dispatch is in flight the device owns the arena append
         # indices; host encode paths must not race them (arena.freeze)
         self.arena.freeze()
+        watch = _frec.activity()
+        watch.__enter__()
         try:
             while True:
                 deadline_hit = (time.perf_counter() > self.deadline
@@ -639,10 +731,12 @@ class PipelinedRunner:
                             want_sync = True
                             _pc("rebalance_syncs").inc()
                 nxt = None
+                nxt_sid = -1
                 nxt_wall = 0.0
                 if stop is None and not deadline_hit and not want_sync:
                     t_d = time.perf_counter()
                     nxt = self._chain(inflight)
+                    nxt_sid = self._last_dispatch_sid
                     nxt_wall = time.perf_counter() - t_d
 
                 # ---- pull: the pipeline's only blocking point
@@ -650,9 +744,14 @@ class PipelinedRunner:
                  out_visited) = inflight
                 t_pull = time.perf_counter()
                 with _otrace.span(
-                    "frontier.segment", cat="device", segment=run_segments,
+                    "frontier.segment", cat="device", segment=inflight_sid,
                     warm=self.program_warm, pipelined=True,
                 ), _otrace.device_annotation("frontier.segment"):
+                    _fid = self._seg_flow.get(inflight_sid)
+                    if _fid is not None:
+                        _otrace.get_tracer().flow(
+                            "t", _fid, "flow.segment", cat="device"
+                        )
                     # steady state (next dispatch chained): delta pull —
                     # the [B] scalar plane + dirty rows/events only; a sync
                     # point follows otherwise and _dispatch_full pushes the
@@ -700,10 +799,17 @@ class PipelinedRunner:
                 prev_st = new_st
                 if nxt is None:
                     self.ledger.release_owned()
+                _frec.beat()  # a segment retired: push the watchdog out
                 t_har = time.perf_counter()
                 self.apply_verdicts()
+                self.current_sid = inflight_sid
                 with _otrace.span("frontier.harvest", cat="frontier",
-                                  segment=run_segments):
+                                  segment=inflight_sid):
+                    _fid = self._seg_flow.pop(inflight_sid, None)
+                    if _fid is not None:
+                        _otrace.get_tracer().flow(
+                            "f", _fid, "flow.segment", cat="device"
+                        )
                     eng._harvest(self.st, self.records, self.walker,
                                  self.ev_seen, pipe=self)
                 self.clear_orphans()
@@ -795,6 +901,7 @@ class PipelinedRunner:
 
                 if nxt is not None:
                     inflight = nxt
+                    inflight_sid = nxt_sid
                     dispatch_wall = nxt_wall
                     continue
                 # sync point: no dispatch in flight anywhere
@@ -810,13 +917,28 @@ class PipelinedRunner:
                 self.refill()
                 t0 = time.perf_counter()
                 inflight, full_args = self._dispatch_full()
+                inflight_sid = self._last_dispatch_sid
                 dispatch_wall = time.perf_counter() - t0
                 self.arena.freeze()
         finally:
+            watch.__exit__(None, None, None)
             self.arena.thaw()
             self.walker.park_sink = None
             self._flush_reinject_queue()
             self.pool.shutdown()
+            # an abandoned dispatch (exception before its pull) would leave
+            # a started flow with no finish; close it so every "s" in the
+            # export has its "f"
+            if self._seg_flow:
+                tracer = _otrace.get_tracer()
+                for sid, fid in self._seg_flow.items():
+                    with tracer.span("frontier.dispatch.abandoned",
+                                     cat="device", segment=sid):
+                        tracer.flow("f", fid, "flow.segment", cat="device")
+                self._seg_flow.clear()
+            hb.unregister("pipeline")
+            if hb_started:
+                hb.stop()
             overlap = reg.counter("pipeline.overlap_s").value
             total_har = overlap + reg.counter("pipeline.bubble_s").value
             if total_har > 0:

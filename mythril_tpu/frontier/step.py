@@ -37,6 +37,7 @@ from mythril_tpu.frontier.code import (
     CodeTables,
 )
 from mythril_tpu.frontier.state import Caps, FrontierState
+from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.ops import bitvec as bv
 
 I32 = jnp.int32
@@ -1514,7 +1515,8 @@ def _merge_corrections(prev: FrontierState, corr: FrontierState,
 
 def chain_dispatch(segment, prev_out, host_state: FrontierState,
                    corr_mask: np.ndarray, code_dev, cfg,
-                   arena_override=None, push_fn=None, mask_sharding=None):
+                   arena_override=None, push_fn=None, mask_sharding=None,
+                   segment_id: int = -1):
     """Dispatch the next segment on the previous segment's device outputs.
 
     ``prev_out`` is the 6-tuple a segment call returned (possibly still
@@ -1531,15 +1533,22 @@ def chain_dispatch(segment, prev_out, host_state: FrontierState,
     its mask land with EXACTLY the shardings the in-flight outputs carry:
     the merge and the chained segment then run as one SPMD program with
     matching in/out shardings across every chained dispatch (SNIPPETS.md
-    [1]–[2]) and GSPMD inserts no resharding between them."""
+    [1]–[2]) and GSPMD inserts no resharding between them.
+
+    ``segment_id`` is the flight deck's monotonic dispatch id — the key
+    that correlates this dispatch with the pull/harvest/replay/solver
+    spans it later produces; it only annotates telemetry, never the
+    computation."""
     out_state, dev_arena, out_len, _n_exec, _max_live, visited = prev_out
     if arena_override is not None:
         dev_arena, out_len = arena_override
-    corr = (push_fn or push_state)(host_state)
-    mask = (jax.device_put(corr_mask, mask_sharding)
-            if mask_sharding is not None else jax.device_put(corr_mask))
-    merged = _merge_corrections(out_state, corr, mask)
-    return segment(merged, dev_arena, out_len, visited, code_dev, cfg)
+    with _otrace.span("frontier.chain_merge", cat="device",
+                      segment=segment_id):
+        corr = (push_fn or push_state)(host_state)
+        mask = (jax.device_put(corr_mask, mask_sharding)
+                if mask_sharding is not None else jax.device_put(corr_mask))
+        merged = _merge_corrections(out_state, corr, mask)
+        return segment(merged, dev_arena, out_len, visited, code_dev, cfg)
 
 
 # Host arena rows appended at a pipeline sync point (re-injected spills) are

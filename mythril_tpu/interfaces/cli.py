@@ -226,6 +226,37 @@ def _add_analysis_options(parser) -> None:
         help="write the full metrics-registry snapshot (frontier/solver/"
         "profiler counters and per-stage histograms) to FILE as JSON",
     )
+    group.add_argument(
+        "--heartbeat-out",
+        metavar="FILE",
+        help="sample pipeline queue depths (feasibility in-flight, ledger "
+        "pending corrections, free slots per shard, arena occupancy) at a "
+        "fixed period into FILE as JSON lines — live progress for "
+        "multi-minute runs (tail -f)",
+    )
+    group.add_argument(
+        "--heartbeat-interval",
+        type=float,
+        default=0.5,
+        metavar="SECONDS",
+        help="heartbeat sampling period (default 0.5s)",
+    )
+    group.add_argument(
+        "--flight-recorder",
+        metavar="DIR",
+        help="arm the flight recorder: on an unhandled exception, SIGUSR1, "
+        "or a watchdog timeout, dump a bundle (recent spans, metrics "
+        "snapshot, heartbeat tail, all-thread stacks) into DIR; implies "
+        "span tracing",
+    )
+    group.add_argument(
+        "--watchdog-deadline",
+        type=float,
+        metavar="SECONDS",
+        help="with --flight-recorder: dump a hang bundle when no frontier "
+        "segment completes within SECONDS while a run is active "
+        "(default: watchdog off)",
+    )
 
 
 def _add_output_options(parser) -> None:
@@ -399,6 +430,10 @@ def _build_analyzer(parsed, query_signature: bool = False):
         solver_workers=getattr(parsed, "solver_workers", 2),
         harvest_workers=getattr(parsed, "harvest_workers", 4),
         compile_cache_dir=getattr(parsed, "compile_cache_dir", None),
+        heartbeat_out=getattr(parsed, "heartbeat_out", None),
+        heartbeat_interval=getattr(parsed, "heartbeat_interval", 0.5),
+        flight_recorder=getattr(parsed, "flight_recorder", None),
+        watchdog_deadline=getattr(parsed, "watchdog_deadline", None),
     )
     analyzer = MythrilAnalyzer(
         disassembler, cmd_args, strategy=parsed.strategy, address=address
@@ -407,17 +442,42 @@ def _build_analyzer(parsed, query_signature: bool = False):
 
 
 def _arm_observability(parsed) -> None:
-    """Enable span tracing before the analyzer is built when requested."""
-    if getattr(parsed, "trace_out", None):
+    """Arm the flight deck before the analyzer is built when requested."""
+    if (getattr(parsed, "trace_out", None)
+            or getattr(parsed, "flight_recorder", None)):
         from mythril_tpu.observability import get_tracer
 
         get_tracer().enabled = True
+    if getattr(parsed, "heartbeat_out", None):
+        from mythril_tpu.observability import get_heartbeat
+
+        get_heartbeat().start(
+            period_s=getattr(parsed, "heartbeat_interval", 0.5),
+            out_path=parsed.heartbeat_out,
+        )
+    flight_dir = getattr(parsed, "flight_recorder", None)
+    if flight_dir:
+        from mythril_tpu.observability import arm_flight_recorder
+
+        arm_flight_recorder(
+            flight_dir,
+            watchdog_deadline_s=getattr(parsed, "watchdog_deadline", None),
+        )
 
 
 def _export_observability(parsed) -> None:
     """Write --trace-out / --metrics-out artifacts after an analysis."""
     trace_out = getattr(parsed, "trace_out", None)
     metrics_out = getattr(parsed, "metrics_out", None)
+    if getattr(parsed, "heartbeat_out", None):
+        from mythril_tpu.observability import get_heartbeat
+
+        hb = get_heartbeat()
+        hb.sample_now()  # final depths before export
+        hb.stop()
+        log.info(
+            "wrote %d heartbeat samples to %s", hb.ticks, parsed.heartbeat_out
+        )
     if trace_out:
         from mythril_tpu.observability import get_tracer
 

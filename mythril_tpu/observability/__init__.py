@@ -22,6 +22,16 @@ The convenience re-exports below are the recommended import surface::
         ...
 """
 
+from mythril_tpu.observability.flightrecorder import (  # noqa: F401
+    FlightRecorder,
+    arm_flight_recorder,
+    disarm_flight_recorder,
+    get_flight_recorder,
+)
+from mythril_tpu.observability.heartbeat import (  # noqa: F401
+    HeartbeatSampler,
+    get_heartbeat,
+)
 from mythril_tpu.observability.metrics import (  # noqa: F401
     Counter,
     Gauge,

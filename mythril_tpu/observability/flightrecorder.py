@@ -1,0 +1,281 @@
+"""Flight recorder: turn "the pod run hung" into an artifact.
+
+Keeps no state of its own beyond a beat timestamp — the bounded span
+ring already lives in the tracer and the queue-depth tail in the
+heartbeat sampler.  What this module adds is the *dump triggers*:
+
+* **unhandled exception** — chains ``sys.excepthook`` so the bundle is
+  written before the traceback prints;
+* **SIGUSR1** — operator-triggered snapshot of a live run
+  (``kill -USR1 <pid>``), installed only when running on the main
+  thread of a platform that has the signal;
+* **watchdog** — a daemon thread that fires when no segment completes
+  within a configurable deadline while the engine is inside an active
+  window (``activity()`` context), catching silent stalls in chained
+  dispatch or a wedged solver pool.
+
+A bundle is one JSON file: the trigger reason, the tail of recent spans,
+the full metrics snapshot, recent heartbeat samples, and a stack dump of
+every live thread (``sys._current_frames``) — enough to attribute a hang
+to the device fence, the feasibility pool, or a harvest worker without
+reproducing it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "arm_flight_recorder",
+    "disarm_flight_recorder",
+    "get_flight_recorder",
+    "beat",
+    "activity",
+]
+
+SPAN_TAIL = 2000  # most recent spans included in a bundle
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        out_dir: str,
+        watchdog_deadline_s: Optional[float] = None,
+    ):
+        self.out_dir = out_dir
+        self.watchdog_deadline_s = watchdog_deadline_s
+        self._lock = threading.Lock()
+        self._armed = False
+        self._prev_excepthook = None
+        self._hook = None
+        self._prev_sigusr1 = None
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_beat = time.perf_counter()
+        self._active = 0
+        self._watchdog_fired = False
+        self._bundle_seq = 0
+        self.bundles: list = []  # paths written, for tests/CLI summary
+
+    # -- triggers ------------------------------------------------------
+
+    def arm(self) -> None:
+        if self._armed:
+            return
+        self._armed = True
+        os.makedirs(self.out_dir, exist_ok=True)
+        self._prev_excepthook = sys.excepthook
+        # keep ONE bound-method object: attribute access mints a fresh one
+        # each time, so disarm()'s identity check needs this exact reference
+        self._hook = self._on_exception
+        sys.excepthook = self._hook
+        self._install_sigusr1()
+        if self.watchdog_deadline_s:
+            self._stop.clear()
+            self._watchdog = threading.Thread(
+                target=self._watch, name="mythril-watchdog", daemon=True
+            )
+            self._watchdog.start()
+
+    def disarm(self) -> None:
+        if not self._armed:
+            return
+        self._armed = False
+        self._stop.set()
+        if sys.excepthook is self._hook:
+            sys.excepthook = self._prev_excepthook
+        if self._prev_sigusr1 is not None:
+            try:
+                import signal
+
+                signal.signal(signal.SIGUSR1, self._prev_sigusr1)
+            except Exception:
+                pass
+            self._prev_sigusr1 = None
+        w = self._watchdog
+        if w is not None and w.is_alive():
+            w.join(timeout=2.0)
+        self._watchdog = None
+
+    def _install_sigusr1(self) -> None:
+        # signal handlers can only be installed from the main thread;
+        # service-mode embeddings arm from workers and just skip this.
+        try:
+            import signal
+
+            if not hasattr(signal, "SIGUSR1"):
+                return
+            if threading.current_thread() is not threading.main_thread():
+                return
+            self._prev_sigusr1 = signal.signal(
+                signal.SIGUSR1, lambda _sig, _frm: self.dump("sigusr1")
+            )
+        except Exception:
+            self._prev_sigusr1 = None
+
+    def _on_exception(self, exc_type, exc, tb) -> None:
+        try:
+            self.dump(
+                "exception",
+                extra={
+                    "exception": "".join(
+                        traceback.format_exception(exc_type, exc, tb)
+                    )[-8000:],
+                },
+            )
+        finally:
+            prev = self._prev_excepthook or sys.__excepthook__
+            prev(exc_type, exc, tb)
+
+    # -- watchdog ------------------------------------------------------
+
+    def beat(self) -> None:
+        """A segment completed — push the watchdog deadline out."""
+        self._last_beat = time.perf_counter()
+        self._watchdog_fired = False
+
+    def activity(self) -> "_Activity":
+        """Scope the watchdog: it only fires inside an activity window."""
+        return _Activity(self)
+
+    def _watch(self) -> None:
+        deadline = self.watchdog_deadline_s
+        tick = min(max(deadline / 4.0, 0.05), 1.0)
+        while not self._stop.wait(tick):
+            if self._active <= 0 or self._watchdog_fired:
+                continue
+            idle = time.perf_counter() - self._last_beat
+            if idle > deadline:
+                self._watchdog_fired = True  # once per stall, reset by beat()
+                self.dump("watchdog", extra={"idle_s": round(idle, 3)})
+
+    # -- bundle --------------------------------------------------------
+
+    def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None) -> str:
+        """Write a bundle now; returns the path."""
+        from mythril_tpu.observability import observability_meta
+        from mythril_tpu.observability.heartbeat import get_heartbeat
+        from mythril_tpu.observability.tracer import get_tracer
+
+        with self._lock:
+            self._bundle_seq += 1
+            seq = self._bundle_seq
+        bundle: Dict[str, Any] = {
+            "reason": reason,
+            "time": time.time(),
+            "pid": os.getpid(),
+            "seq": seq,
+        }
+        if extra:
+            bundle.update(extra)
+        try:
+            bundle["observability"] = observability_meta()
+        except Exception as e:  # never let the dump path throw
+            bundle["observability_error"] = repr(e)
+        try:
+            tracer = get_tracer()
+            spans = tracer.spans()
+            bundle["spans_tail"] = spans[-SPAN_TAIL:]
+            bundle["spans_dropped"] = tracer.dropped
+        except Exception as e:
+            bundle["spans_error"] = repr(e)
+        try:
+            bundle["heartbeat_tail"] = get_heartbeat().recent_samples()
+        except Exception as e:
+            bundle["heartbeat_error"] = repr(e)
+        bundle["threads"] = self._thread_stacks()
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(
+            self.out_dir, f"flight-{reason}-{os.getpid()}-{seq}.json"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, indent=1, default=repr)
+        os.replace(tmp, path)
+        self.bundles.append(path)
+        sys.stderr.write(f"[flight-recorder] {reason}: wrote {path}\n")
+        return path
+
+    @staticmethod
+    def _thread_stacks() -> Dict[str, Any]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in sys._current_frames().items():
+            out[f"{names.get(tid, 'thread')}-{tid}"] = traceback.format_stack(
+                frame
+            )[-12:]
+        return out
+
+
+class _Activity:
+    __slots__ = ("_rec",)
+
+    def __init__(self, rec: FlightRecorder):
+        self._rec = rec
+
+    def __enter__(self):
+        self._rec._last_beat = time.perf_counter()
+        self._rec._active += 1
+        return self
+
+    def __exit__(self, *_exc):
+        self._rec._active -= 1
+        return False
+
+
+class _NullActivity:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        return False
+
+
+_NULL_ACTIVITY = _NullActivity()
+
+_recorder: Optional[FlightRecorder] = None
+
+
+def arm_flight_recorder(
+    out_dir: str, watchdog_deadline_s: Optional[float] = None
+) -> FlightRecorder:
+    """Install (or re-point) the process flight recorder."""
+    global _recorder
+    if _recorder is not None:
+        _recorder.disarm()
+    _recorder = FlightRecorder(out_dir, watchdog_deadline_s)
+    _recorder.arm()
+    return _recorder
+
+
+def disarm_flight_recorder() -> None:
+    global _recorder
+    if _recorder is not None:
+        _recorder.disarm()
+        _recorder = None
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def beat() -> None:
+    """Segment-completion heartbeat; free when no recorder is armed."""
+    r = _recorder
+    if r is not None:
+        r.beat()
+
+
+def activity():
+    """Watchdog window context; no-op when no recorder is armed."""
+    r = _recorder
+    return r.activity() if r is not None else _NULL_ACTIVITY

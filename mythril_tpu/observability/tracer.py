@@ -13,8 +13,11 @@ Design constraints, in order:
    NTP steps cannot corrupt durations.
 
 3. **Standard export.**  ``export_chrome_trace()`` writes the Chrome
-   ``trace_event`` JSON object format ("X" complete events) that
-   chrome://tracing and https://ui.perfetto.dev load directly;
+   ``trace_event`` JSON object format that chrome://tracing and
+   https://ui.perfetto.dev load directly: "X" complete events for spans,
+   "M" metadata events naming every thread/track that recorded anything,
+   "C" counter events for heartbeat samples, and "s"/"f" flow events
+   correlating device dispatches with the host work they produced;
    ``export_jsonl()`` writes one flat JSON object per line for ad-hoc
    grep/jq pipelines.
 
@@ -26,13 +29,29 @@ expect — they render relative time, not epoch time.
 from __future__ import annotations
 
 import functools
+import itertools
 import json
 import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-__all__ = ["Tracer", "get_tracer", "span", "traced", "device_annotation"]
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "span",
+    "traced",
+    "device_annotation",
+]
+
+# Event-phase constants for the ring tuples.  "X" complete events are by
+# far the most common; flows and counters ride in the same ring so the
+# export stays a single time-ordered pass.
+_PH_SPAN = "X"
+_PH_INSTANT = "i"
+_PH_FLOW_START = "s"
+_PH_FLOW_END = "f"
+_PH_COUNTER = "C"
 
 
 class _NullContext:
@@ -100,6 +119,12 @@ class Tracer:
         self._buf: deque = deque(maxlen=capacity)
         self.dropped = 0
         self._origin = time.perf_counter()
+        # tid -> human name, captured lazily on first record per thread
+        # (worker pools name their threads mythril-feas-N etc.), plus
+        # synthetic ids for non-thread tracks registered explicitly.
+        self._thread_names: Dict[int, str] = {}
+        self._track_ids = itertools.count(1)
+        self._flow_ids = itertools.count(1)
 
     # -- recording -----------------------------------------------------
 
@@ -120,13 +145,57 @@ class Tracer:
         if not self.enabled:
             return
         t = time.perf_counter()
-        self._record(name, cat, t, 0.0, threading.get_ident(), args or None)
+        self._record(name, cat, t, 0.0, threading.get_ident(), args or None,
+                     ph=_PH_INSTANT)
 
-    def _record(self, name, cat, t0, dur, tid, args) -> None:
+    def new_flow_id(self) -> int:
+        """A process-unique id binding one ``s`` event to one ``f`` event."""
+        return next(self._flow_ids)
+
+    def flow(self, phase: str, fid: int, name: str, cat: str = "host") -> None:
+        """Record one endpoint of a flow arrow (``phase`` is "s" or "f").
+
+        Chrome-trace flow events bind to the enclosing slice on their
+        track at their timestamp, so call this *inside* the span the
+        arrow should attach to.  Each ``fid`` must see its "s" before
+        its "f" in wall-clock order (guaranteed here because the start
+        side is always emitted before the work is handed off).
+        """
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        self._record(name, cat, t, 0.0, threading.get_ident(), None,
+                     ph=phase, fid=fid)
+
+    def counter(self, name: str, values: Dict[str, float], tid: Optional[int] = None) -> None:
+        """Record a counter sample ("C" event -> Perfetto counter track)."""
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        self._record(name, "counter", t, 0.0,
+                     tid if tid is not None else threading.get_ident(),
+                     dict(values), ph=_PH_COUNTER)
+
+    def register_track(self, name: str) -> int:
+        """Reserve a synthetic tid rendered as a named track in exports.
+
+        Used for logical tracks that are not OS threads (per-shard
+        counter tracks, the heartbeat sampler's queue-depth lanes).
+        """
+        with self._lock:
+            tid = 1_000_000_000 + next(self._track_ids)
+            self._thread_names[tid] = name
+        return tid
+
+    def _record(self, name, cat, t0, dur, tid, args, ph=_PH_SPAN, fid=None) -> None:
         with self._lock:
             if len(self._buf) == self.capacity:
                 self.dropped += 1
-            self._buf.append((name, cat, t0 - self._origin, dur, tid, args))
+            if tid not in self._thread_names:
+                cur = threading.current_thread()
+                if cur.ident == tid:
+                    self._thread_names[tid] = cur.name
+            self._buf.append((name, cat, t0 - self._origin, dur, tid, args, ph, fid))
 
     # -- inspection ----------------------------------------------------
 
@@ -138,8 +207,9 @@ class Tracer:
         """Snapshot of recorded spans as dicts (seconds, origin-relative)."""
         with self._lock:
             raw = list(self._buf)
-        return [
-            {
+        out = []
+        for name, cat, ts, dur, tid, args, ph, fid in raw:
+            rec = {
                 "name": name,
                 "cat": cat,
                 "ts": ts,
@@ -147,8 +217,12 @@ class Tracer:
                 "tid": tid,
                 **({"args": args} if args else {}),
             }
-            for name, cat, ts, dur, tid, args in raw
-        ]
+            if ph != _PH_SPAN:
+                rec["ph"] = ph
+            if fid is not None:
+                rec["flow_id"] = fid
+            out.append(rec)
+        return out
 
     def summary(self) -> Dict[str, Any]:
         with self._lock:
@@ -160,11 +234,17 @@ class Tracer:
             "capacity": self.capacity,
         }
 
+    def thread_names(self) -> Dict[int, str]:
+        """Snapshot of tid -> track name seen so far."""
+        with self._lock:
+            return dict(self._thread_names)
+
     def reset(self) -> None:
         with self._lock:
             self._buf.clear()
             self.dropped = 0
             self._origin = time.perf_counter()
+            self._thread_names.clear()
 
     # -- export --------------------------------------------------------
 
@@ -175,26 +255,66 @@ class Tracer:
         pid = os.getpid()
         with self._lock:
             raw = list(self._buf)
-        events = []
-        for name, cat, ts, dur, tid, args in raw:
+            names = dict(self._thread_names)
+            dropped = self.dropped
+        events: List[Dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": "mythril-tpu"},
+            }
+        ]
+        seen_tids = {tid for (_n, _c, _ts, _d, tid, _a, _ph, _f) in raw}
+        for tid in sorted(seen_tids | set(names)):
+            events.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": names.get(tid, f"thread-{tid}")},
+            })
+        for name, cat, ts, dur, tid, args, ph, fid in raw:
             ev = {
                 "name": name,
                 "cat": cat,
-                "ph": "X",
+                "ph": ph,
                 "ts": round(ts * 1e6, 3),
-                "dur": round(dur * 1e6, 3),
                 "pid": pid,
                 "tid": tid,
             }
+            if ph == _PH_SPAN:
+                ev["dur"] = round(dur * 1e6, 3)
+            elif ph == _PH_INSTANT:
+                ev["s"] = "t"  # thread-scoped instant
+            elif ph == _PH_FLOW_END:
+                ev["bp"] = "e"  # bind to enclosing slice, not the next one
+            if fid is not None:
+                ev["id"] = fid
             if args:
                 ev["args"] = args
             events.append(ev)
+        if dropped:
+            # Visible marker so a truncated timeline cannot be mistaken
+            # for a complete one (otherData is easy to miss in viewers).
+            last_ts = max((e["ts"] for e in events if "ts" in e), default=0.0)
+            events.append({
+                "name": f"tracer.dropped={dropped}",
+                "cat": "tracer",
+                "ph": "i",
+                "s": "g",  # global-scoped: full-height line in the viewer
+                "ts": last_ts,
+                "pid": pid,
+                "tid": 0,
+                "args": {"dropped_spans": dropped, "capacity": self.capacity},
+            })
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {
                 "producer": "mythril_tpu.observability",
-                "dropped_spans": self.dropped,
+                "dropped_spans": dropped,
             },
         }
 

@@ -100,6 +100,14 @@ class Args:
     # MYTHRIL_TPU_COMPILATION_CACHE env var disables with 0/off or
     # relocates with a path)
     compile_cache_dir: Optional[str] = None
+    # flight deck (mythril_tpu/observability): heartbeat JSONL of sampled
+    # queue depths, sampler period, flight-recorder bundle directory, and
+    # the watchdog deadline (seconds without a completed segment before a
+    # hang bundle is dumped; None disables the watchdog)
+    heartbeat_out: Optional[str] = None
+    heartbeat_interval: float = 0.5
+    flight_recorder: Optional[str] = None
+    watchdog_deadline: Optional[float] = None
 
 
 args = Args()

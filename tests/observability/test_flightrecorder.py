@@ -1,0 +1,124 @@
+"""Flight recorder: dump bundles, excepthook chaining, watchdog scoping."""
+
+import json
+import sys
+import time
+
+import pytest
+
+from mythril_tpu.observability import flightrecorder as frec
+from mythril_tpu.observability.flightrecorder import FlightRecorder
+from mythril_tpu.observability.tracer import get_tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_module_state():
+    yield
+    frec.disarm_flight_recorder()
+
+
+def test_dump_writes_bundle_with_spans_and_stacks(tmp_path):
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.enabled = True
+    try:
+        with tracer.span("pre-crash", cat="test"):
+            pass
+        rec = FlightRecorder(str(tmp_path))
+        path = rec.dump("manual", extra={"note": "hello"})
+        bundle = json.loads(open(path).read())
+        assert bundle["reason"] == "manual"
+        assert bundle["note"] == "hello"
+        assert bundle["seq"] == 1
+        assert any(s["name"] == "pre-crash" for s in bundle["spans_tail"])
+        # every live thread has a stack tail; this one is among them
+        assert any("MainThread" in k for k in bundle["threads"])
+        assert rec.bundles == [path]
+        # no stray .tmp left behind (atomic replace)
+        assert not list(tmp_path.glob("*.tmp"))
+    finally:
+        tracer.enabled = False
+        tracer.reset()
+
+
+def test_dump_includes_heartbeat_tail(tmp_path):
+    from mythril_tpu.observability.heartbeat import get_heartbeat
+
+    hb = get_heartbeat()
+    hb.reset()
+    hb.register("t", lambda: {"test.fr.depth": 4})
+    hb.sample_now()
+    try:
+        rec = FlightRecorder(str(tmp_path))
+        bundle = json.loads(open(rec.dump("manual")).read())
+        assert bundle["heartbeat_tail"][-1]["test.fr.depth"] == 4
+    finally:
+        hb.reset()
+        from mythril_tpu.observability.metrics import get_registry
+
+        get_registry().reset(prefix="test.fr.")
+
+
+def test_excepthook_chains_and_dumps(tmp_path):
+    seen = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: seen.append(a)
+    try:
+        rec = frec.arm_flight_recorder(str(tmp_path))
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+        assert len(rec.bundles) == 1
+        bundle = json.loads(open(rec.bundles[0]).read())
+        assert bundle["reason"] == "exception"
+        assert "ValueError: boom" in bundle["exception"]
+        # the pre-existing hook still ran after the dump
+        assert len(seen) == 1
+        frec.disarm_flight_recorder()
+        # disarm restores the chained hook
+        assert sys.excepthook not in (rec._on_exception,)
+    finally:
+        sys.excepthook = prev
+
+
+def test_watchdog_fires_once_inside_activity_window(tmp_path):
+    rec = frec.arm_flight_recorder(str(tmp_path), watchdog_deadline_s=0.1)
+    deadline = time.time() + 5.0
+    with frec.activity():
+        while not rec.bundles and time.time() < deadline:
+            time.sleep(0.02)
+        # one stall -> exactly one bundle, even if we keep stalling
+        time.sleep(0.3)
+    assert len(rec.bundles) == 1
+    bundle = json.loads(open(rec.bundles[0]).read())
+    assert bundle["reason"] == "watchdog"
+    assert bundle["idle_s"] >= 0.1  # fires when idle >= deadline
+
+
+def test_watchdog_silent_outside_activity_and_with_beats(tmp_path):
+    rec = frec.arm_flight_recorder(str(tmp_path), watchdog_deadline_s=0.1)
+    # idle (no activity window): never fires
+    time.sleep(0.3)
+    assert rec.bundles == []
+    # active but beating: never fires
+    with frec.activity():
+        for _ in range(6):
+            time.sleep(0.05)
+            frec.beat()
+    assert rec.bundles == []
+
+
+def test_module_helpers_are_noops_when_disarmed():
+    frec.disarm_flight_recorder()
+    assert frec.get_flight_recorder() is None
+    frec.beat()  # must not raise
+    with frec.activity():
+        pass
+
+
+def test_rearm_replaces_recorder(tmp_path):
+    a = frec.arm_flight_recorder(str(tmp_path / "a"))
+    b = frec.arm_flight_recorder(str(tmp_path / "b"))
+    assert frec.get_flight_recorder() is b
+    assert not a._armed

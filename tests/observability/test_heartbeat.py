@@ -1,0 +1,129 @@
+"""Heartbeat sampler: sources, gauges, counter tracks, JSONL, error budget."""
+
+import json
+import time
+
+import pytest
+
+from mythril_tpu.observability.heartbeat import HeartbeatSampler, get_heartbeat
+from mythril_tpu.observability.metrics import get_registry
+from mythril_tpu.observability.tracer import get_tracer
+
+
+@pytest.fixture
+def hb():
+    s = HeartbeatSampler(period_s=0.01)
+    yield s
+    s.reset()
+
+
+def test_sample_now_sets_gauges_and_tail(hb):
+    reg = get_registry()
+    hb.register("pipe", lambda: {
+        "test.hb.depth": 7,
+        "test.hb.free_slots_by_shard": {"shard0": 3, "shard1": 5},
+    })
+    sample = hb.sample_now()
+    assert sample["test.hb.depth"] == 7
+    # scalar and per-shard dict values both land as gauges
+    assert reg.gauge("test.hb.depth").value == 7
+    assert reg.gauge("test.hb.free_slots_by_shard").value == {
+        "shard0": 3, "shard1": 5,
+    }
+    (tail,) = hb.recent_samples()
+    assert tail["tick"] == 1 and tail["test.hb.depth"] == 7
+    reg.reset(prefix="test.hb.")
+
+
+def test_counter_events_on_heartbeat_track(hb):
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.enabled = True
+    try:
+        hb.register("pipe", lambda: {
+            "test.hb.depth": 2,
+            "test.hb.by_shard": {"shard0": 1},
+        })
+        hb.sample_now()
+        counters = [s for s in tracer.spans() if s.get("ph") == "C"]
+        assert {c["name"] for c in counters} == {
+            "test.hb.depth", "test.hb.by_shard",
+        }
+        # all counter samples ride one named synthetic track
+        (tid,) = {c["tid"] for c in counters}
+        assert tracer.thread_names()[tid] == "heartbeat"
+    finally:
+        tracer.enabled = False
+        tracer.reset()
+        get_registry().reset(prefix="test.hb.")
+
+
+def test_daemon_thread_ticks_and_writes_jsonl(hb, tmp_path):
+    out = tmp_path / "heartbeat.jsonl"
+    hb.register("pipe", lambda: {"test.hb.live": 1})
+    hb.start(period_s=0.01, out_path=str(out))
+    assert hb.running
+    deadline = time.time() + 5.0
+    while hb.ticks < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    hb.stop()
+    assert not hb.running
+    assert hb.ticks >= 3
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) >= 3
+    assert all(l["test.hb.live"] == 1 for l in lines)
+    # ticks are monotonically numbered and stamped
+    assert [l["tick"] for l in lines] == sorted(l["tick"] for l in lines)
+    assert all("t" in l for l in lines)
+    get_registry().reset(prefix="test.hb.")
+
+
+def test_source_error_budget_tolerates_transient_races(hb):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:  # two transient failures, then healthy
+            raise RuntimeError("racing the pipeline")
+        return {"test.hb.flaky": calls["n"]}
+
+    hb.register("flaky", flaky)
+    assert hb.sample_now() == {}
+    assert hb.sample_now() == {}
+    # under the MAX_SOURCE_ERRORS budget: the source is retried and recovers
+    assert hb.sample_now()["test.hb.flaky"] == 3
+    get_registry().reset(prefix="test.hb.")
+
+
+def test_source_dropped_after_consecutive_error_budget(hb):
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise RuntimeError("permanently broken")
+
+    hb.register("broken", broken)
+    for _ in range(HeartbeatSampler.MAX_SOURCE_ERRORS + 3):
+        assert hb.sample_now() == {}
+    # dropped after the budget: no further calls
+    assert calls["n"] == HeartbeatSampler.MAX_SOURCE_ERRORS
+    # re-registering resets the budget
+    hb.register("broken", lambda: {"test.hb.fixed": 1})
+    assert hb.sample_now()["test.hb.fixed"] == 1
+    get_registry().reset(prefix="test.hb.")
+
+
+def test_unregister_and_reset(hb):
+    hb.register("a", lambda: {"test.hb.a": 1})
+    hb.unregister("a")
+    assert hb.sample_now() == {}
+    hb.register("b", lambda: {"test.hb.b": 1})
+    hb.sample_now()
+    hb.reset()
+    assert hb.recent_samples() == [] and hb.ticks == 0
+    assert hb.sample_now() == {}  # sources forgotten too
+    get_registry().reset(prefix="test.hb.")
+
+
+def test_singleton_accessor():
+    assert get_heartbeat() is get_heartbeat()

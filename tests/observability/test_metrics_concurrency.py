@@ -74,3 +74,51 @@ def test_querycache_counters_are_atomic():
     base = c.value
     _hammer(lambda: c.inc())
     assert c.value - base == N_THREADS * N_ITER
+
+
+def test_snapshot_under_writer_storm():
+    """snapshot() raced against 8 writers stays JSON-serializable and
+    never observes torn metric state (the heartbeat sampler and the
+    --metrics-out exporter both read while the pipeline writes)."""
+    import json
+
+    reg = get_registry()
+    c = reg.counter("test.storm.counter")
+    c.reset()
+    h = reg.histogram("test.storm.hist")
+    h.reset()
+    lc = reg.labeled_counter("test.storm.labeled")
+    lc.reset()
+    g = reg.gauge("test.storm.gauge")
+
+    stop = threading.Event()
+    reader_errors = []
+
+    def read_loop():
+        try:
+            while not stop.is_set():
+                snap = reg.snapshot()
+                json.dumps(snap)  # must serialize mid-storm
+                v = snap.get("test.storm.counter", 0)
+                assert 0 <= v <= N_THREADS * N_ITER
+        except Exception as exc:  # pragma: no cover - failure path
+            reader_errors.append(exc)
+
+    reader = threading.Thread(target=read_loop)
+    reader.start()
+
+    def write():
+        c.inc()
+        h.observe(0.001)
+        lc.inc("shard0")
+        g.set({"shard0": 1, "shard1": 2})
+
+    try:
+        _hammer(write)
+    finally:
+        stop.set()
+        reader.join()
+    assert not reader_errors
+    assert c.value == N_THREADS * N_ITER
+    assert h.count == N_THREADS * N_ITER
+    assert lc["shard0"] == N_THREADS * N_ITER

@@ -59,9 +59,17 @@ def test_chrome_trace_schema(tracer, tmp_path):
     doc = json.loads(path.read_text())
     # the trace_event JSON *object* format Perfetto/chrome://tracing load
     assert isinstance(doc["traceEvents"], list)
-    assert len(doc["traceEvents"]) == 2
-    for ev in doc["traceEvents"]:
-        assert ev["ph"] == "X"  # complete events
+    meta = [ev for ev in doc["traceEvents"] if ev["ph"] == "M"]
+    slices = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    assert len(slices) == 2
+    # metadata names the process and every track that recorded anything
+    assert any(
+        ev["name"] == "process_name" and ev["args"]["name"] == "mythril-tpu"
+        for ev in meta
+    )
+    named_tids = {ev["tid"] for ev in meta if ev["name"] == "thread_name"}
+    for ev in slices:
+        assert ev["tid"] in named_tids
         assert isinstance(ev["name"], str)
         assert isinstance(ev["cat"], str)
         # timestamps/durations in microseconds, non-negative
@@ -141,3 +149,193 @@ def test_reset_clears_and_rebases_origin(tracer):
     (span,) = tracer.spans()
     # origin was rebased: the new span starts near zero
     assert span["ts"] < 60.0
+
+
+# -- flight-deck additions: flows, counters, named tracks, drop marker ------
+
+
+def test_flow_events_link_dispatch_to_harvest(tracer):
+    fid = tracer.new_flow_id()
+    with tracer.span("dispatch", cat="device"):
+        tracer.flow("s", fid, "flow.segment", cat="device")
+    with tracer.span("pull", cat="device"):
+        tracer.flow("t", fid, "flow.segment", cat="device")
+    with tracer.span("harvest", cat="frontier"):
+        tracer.flow("f", fid, "flow.segment", cat="device")
+
+    doc = tracer.chrome_trace()
+    flows = [ev for ev in doc["traceEvents"] if ev["ph"] in ("s", "t", "f")]
+    assert [ev["ph"] for ev in flows] == ["s", "t", "f"]
+    # all three endpoints share the id and arrive in wall-clock order
+    assert {ev["id"] for ev in flows} == {fid}
+    assert flows[0]["ts"] <= flows[1]["ts"] <= flows[2]["ts"]
+    # the terminator binds to its ENCLOSING slice, not the next one
+    assert flows[2]["bp"] == "e"
+
+
+def test_new_flow_ids_are_unique(tracer):
+    ids = [tracer.new_flow_id() for _ in range(100)]
+    assert len(set(ids)) == 100
+
+
+def test_counter_events_on_registered_track(tracer):
+    tid = tracer.register_track("heartbeat")
+    assert tid >= 1_000_000_000  # never collides with an OS thread ident
+    tracer.counter(
+        "pipeline.pool_queue_depth", {"value": 3}, tid=tid
+    )
+    doc = tracer.chrome_trace()
+    (c,) = [ev for ev in doc["traceEvents"] if ev["ph"] == "C"]
+    assert c["tid"] == tid
+    assert c["args"] == {"value": 3}
+    # the synthetic track is named via thread_name metadata
+    assert any(
+        ev["ph"] == "M"
+        and ev["name"] == "thread_name"
+        and ev["tid"] == tid
+        and ev["args"]["name"] == "heartbeat"
+        for ev in doc["traceEvents"]
+    )
+
+
+def test_thread_name_captured_lazily():
+    t = Tracer(capacity=100)
+    t.enabled = True
+
+    def work():
+        with t.span("named", cat="test"):
+            pass
+
+    th = threading.Thread(target=work, name="mythril-feas-0")
+    th.start()
+    th.join()
+    assert "mythril-feas-0" in t.thread_names().values()
+    doc = t.chrome_trace()
+    assert any(
+        ev["ph"] == "M" and ev["args"]["name"] == "mythril-feas-0"
+        for ev in doc["traceEvents"]
+    )
+
+
+def test_dropped_marker_instant_visible_only_when_truncated():
+    t = Tracer(capacity=5)
+    t.enabled = True
+    for i in range(3):
+        with t.span(f"s{i}", cat="test"):
+            pass
+    doc = t.chrome_trace()
+    assert not [e for e in doc["traceEvents"] if e["name"].startswith("tracer.dropped")]
+
+    for i in range(10):
+        with t.span(f"t{i}", cat="test"):
+            pass
+    doc = t.chrome_trace()
+    (marker,) = [
+        e for e in doc["traceEvents"] if e["name"].startswith("tracer.dropped")
+    ]
+    assert marker["ph"] == "i" and marker["s"] == "g"  # full-height line
+    assert marker["args"]["dropped_spans"] == t.dropped > 0
+    # the marker sits at the end of the visible timeline
+    assert marker["ts"] == max(
+        e["ts"] for e in doc["traceEvents"] if "ts" in e
+    )
+
+
+# -- writer storms: _record and the readers must survive 8-way hammering ----
+
+N_STORM_THREADS = 8
+N_STORM_ITER = 500
+
+
+def _storm(worker, n_threads=N_STORM_THREADS):
+    barrier = threading.Barrier(n_threads)
+
+    def run(k):
+        barrier.wait()  # maximize interleaving
+        worker(k)
+
+    threads = [
+        threading.Thread(target=run, args=(k,)) for k in range(n_threads)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+
+def test_writer_storm_exact_counts_no_drops():
+    t = Tracer(capacity=N_STORM_THREADS * N_STORM_ITER * 3)
+    t.enabled = True
+
+    def worker(k):
+        for i in range(N_STORM_ITER):
+            with t.span(f"w{k}", cat="test", i=i):
+                pass
+            fid = t.new_flow_id()
+            t.flow("s", fid, "flow.storm", cat="test")
+            t.flow("f", fid, "flow.storm", cat="test")
+
+    _storm(worker)
+    assert len(t) == N_STORM_THREADS * N_STORM_ITER * 3
+    assert t.dropped == 0
+    # every flow id saw exactly one s and one f
+    flows = [s for s in t.spans() if s.get("ph") in ("s", "f")]
+    by_id = {}
+    for s in flows:
+        by_id.setdefault(s["flow_id"], []).append(s["ph"])
+    assert all(sorted(phs) == ["f", "s"] for phs in by_id.values())
+
+
+def test_writer_storm_eviction_accounting_is_exact():
+    cap = 64
+    t = Tracer(capacity=cap)
+    t.enabled = True
+
+    def worker(k):
+        for i in range(N_STORM_ITER):
+            with t.span(f"w{k}", cat="test"):
+                pass
+
+    _storm(worker)
+    total = N_STORM_THREADS * N_STORM_ITER
+    assert len(t) == cap
+    assert t.dropped == total - cap
+    assert t.chrome_trace()["otherData"]["dropped_spans"] == total - cap
+
+
+def test_writer_storm_with_concurrent_readers():
+    """summary()/spans()/chrome_trace() race 8 writers without corruption."""
+    t = Tracer(capacity=4096)
+    t.enabled = True
+    stop = threading.Event()
+    reader_errors = []
+
+    def read_loop():
+        try:
+            while not stop.is_set():
+                s = t.summary()
+                assert 0 <= s["spans"] <= t.capacity
+                for rec in t.spans():
+                    assert isinstance(rec["name"], str)
+                json.dumps(t.chrome_trace())  # full export must serialize
+        except Exception as exc:  # pragma: no cover - failure path
+            reader_errors.append(exc)
+
+    readers = [threading.Thread(target=read_loop) for _ in range(2)]
+    for r in readers:
+        r.start()
+
+    def worker(k):
+        for i in range(N_STORM_ITER):
+            with t.span(f"w{k}", cat="test"):
+                pass
+            t.counter(f"c{k}", {"value": i})
+
+    try:
+        _storm(worker)
+    finally:
+        stop.set()
+        for r in readers:
+            r.join()
+    assert not reader_errors
+    assert t.summary()["spans"] + t.dropped == N_STORM_THREADS * N_STORM_ITER * 2
