@@ -24,13 +24,21 @@ This driver instead runs the per-contract transaction loops in LOCKSTEP:
 Semantics per contract are unchanged — the frontier parks anything it
 cannot run and each laser's host engine finishes it — only the scheduling
 across contracts differs.
+
+``run_cooperative_batch`` is the long-lived-service entry point layered on
+the same lockstep core: per-job fault isolation (one tenant's exception or
+solver blow-up fails only that job's result, the rest of the batch
+completes), per-request frontier segment tagging for trace correlation, and
+per-job issue attribution that hands each job its error alongside its
+issues.  ``analyze_cooperative`` keeps the original batch-tool contract
+(exceptions propagate, two-tuple return).
 """
 
 from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from mythril_tpu.support.support_args import args
 from mythril_tpu.support.time_handler import time_handler
@@ -57,31 +65,83 @@ def analyze_cooperative(
     sequential per-contract analysis (differentially tested in
     tests/analysis/test_cooperative.py).
     """
+    issues_by_name, errors_by_name, total_states = run_cooperative_batch(
+        jobs,
+        transaction_count=transaction_count,
+        modules=modules,
+        strategy=strategy,
+        execution_timeout=execution_timeout,
+        base_address=base_address,
+        caps=caps,
+        isolate_errors=False,
+    )
+    assert not errors_by_name  # isolate_errors=False re-raises instead
+    return issues_by_name, total_states
+
+
+def run_cooperative_batch(
+    jobs: Sequence[Tuple[str, bytes]],
+    transaction_count: int = 2,
+    modules: Optional[List[str]] = None,
+    strategy: str = "bfs",
+    execution_timeout: int = 60,
+    base_address: int = BASE_ADDRESS,
+    caps=None,
+    isolate_errors: bool = True,
+    request_tags: Optional[Sequence[str]] = None,
+) -> Tuple[Dict[str, List], Dict[str, str], int]:
+    """Lockstep-analyze ``jobs`` with per-job fault isolation.
+
+    Returns ``(issues_by_name, errors_by_name, total_states)``.  A job whose
+    construction, seeding, host continuation or finalization raises lands in
+    ``errors_by_name`` (name -> one-line description) and drops out of later
+    rounds; every other job runs to completion untouched — the multi-tenant
+    isolation contract of the analysis service.  With
+    ``isolate_errors=False`` the first failure propagates (the original
+    ``analyze_cooperative`` behavior).
+
+    ``request_tags`` (parallel to ``jobs``) label this batch's frontier
+    segments so a shared wide device segment is attributable to the requests
+    riding it (``frontier.segment`` spans carry ``requests=...``).
+    """
     from mythril_tpu.analysis.security import retrieve_callback_issues
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.core.transaction import symbolic as sym_tx
     from mythril_tpu.frontier.engine import drain_lasers
     from mythril_tpu.smt.solver import check_satisfiable_batch
 
+    errors_by_name: Dict[str, str] = {}
+
+    def _fail(name: str, stage: str, exc: BaseException) -> None:
+        if not isolate_errors:
+            raise exc
+        log.warning("job %r failed during %s: %s", name, stage, exc,
+                    exc_info=True)
+        errors_by_name.setdefault(name, f"{stage}: {exc!r}")
+
     addresses = [base_address + 0x10000 * i for i in range(len(jobs))]
-    wrappers = [
-        SymExecWrapper(
-            code,
-            address=addr,
-            strategy=strategy,
-            transaction_count=transaction_count,
-            execution_timeout=execution_timeout,
-            modules=modules,
-            defer_exec=True,
-        )
-        for (name, code), addr in zip(jobs, addresses)
-    ]
+    wrappers: List[Tuple[str, int, object]] = []  # (name, addr, wrapper)
+    for (name, code), addr in zip(jobs, addresses):
+        try:
+            w = SymExecWrapper(
+                code,
+                address=addr,
+                strategy=strategy,
+                transaction_count=transaction_count,
+                execution_timeout=execution_timeout,
+                modules=modules,
+                defer_exec=True,
+            )
+        except Exception as e:
+            _fail(name, "construction", e)
+            continue
+        wrappers.append((name, addr, w))
 
     # the global wall-clock budget covers the whole batch: the lockstep
     # rounds interleave contracts, so per-contract budgets do not partition
     time_handler.start_execution(execution_timeout * max(1, len(jobs)))
     t0 = time.time()
-    for w, addr in zip(wrappers, addresses):
+    for _name, _addr, w in wrappers:
         w.laser._fire("start_sym_exec")
         w.laser.time = t0
         w.laser.open_states = [w.deferred_world_state]
@@ -92,66 +152,89 @@ def analyze_cooperative(
     # fewer live codes, and a shrunken bucket would trigger a fresh XLA
     # compile mid-run (measured at ~17s on the tunneled chip)
     bucket_floor = None
-    if use_frontier:
+    if use_frontier and wrappers:
         from mythril_tpu.frontier.code import bucket_hint
 
         bucket_floor = bucket_hint([
             w.deferred_world_state[addr].code.instruction_list
-            for w, addr in zip(wrappers, addresses)
+            for _name, addr, w in wrappers
         ])
+    failed: set = set()
     for round_idx in range(transaction_count):
         live = []
-        for w, addr in zip(wrappers, addresses):
+        for name, addr, w in wrappers:
+            if name in failed:
+                continue
             laser = w.laser
             if not laser.open_states:
                 continue
-            # batched open-state prune (core/svm.py:186-197)
-            if not args.sparse_pruning:
-                flags = check_satisfiable_batch(
-                    [s.constraints.get_all_raw() for s in laser.open_states]
-                )
-                laser.open_states = [
-                    s for s, ok in zip(laser.open_states, flags) if ok
-                ]
-            if not laser.open_states:
+            try:
+                # batched open-state prune (core/svm.py:186-197)
+                if not args.sparse_pruning:
+                    flags = check_satisfiable_batch(
+                        [s.constraints.get_all_raw() for s in laser.open_states]
+                    )
+                    laser.open_states = [
+                        s for s, ok in zip(laser.open_states, flags) if ok
+                    ]
+                if not laser.open_states:
+                    continue
+                laser._fire("start_sym_trans")
+                sym_tx.seed_message_call(laser, addr)
+            except Exception as e:
+                _fail(name, f"seeding round {round_idx}", e)
+                failed.add(name)
+                laser.open_states = []
                 continue
-            laser._fire("start_sym_trans")
-            sym_tx.seed_message_call(laser, addr)
-            live.append(w)
+            live.append((name, w))
         if not live:
             break
         log.info(
             "cooperative round %d: %d live contracts, %d seeds",
             round_idx,
             len(live),
-            sum(len(w.laser.work_list) for w in live),
+            sum(len(w.laser.work_list) for _n, w in live),
         )
         if use_frontier:
             # the whole corpus round as one wide multi-code segment batch
             try:
                 drain_lasers(
-                    [w.laser for w in live], caps=caps,
+                    [w.laser for _n, w in live], caps=caps,
                     bucket_floor=bucket_floor,
+                    tags=request_tags,
                 )
             except Exception as e:  # graceful degradation, never lose a run
                 log.warning(
                     "cooperative frontier failed; host engines continue: %s",
                     e, exc_info=True,
                 )
-        for w in live:
-            # host continuation: parked paths + frontier-ineligible states
-            w.laser.exec()
-            w.laser._fire("stop_sym_trans")
+        for name, w in live:
+            # host continuation: parked paths + frontier-ineligible states.
+            # A tenant whose host engine blows up (solver exception, plugin
+            # bug) fails ALONE: its work list is abandoned, everyone else's
+            # round closes normally.
+            try:
+                w.laser.exec()
+                w.laser._fire("stop_sym_trans")
+            except Exception as e:
+                _fail(name, f"host continuation round {round_idx}", e)
+                failed.add(name)
+                w.laser.open_states = []
+                w.laser.work_list.clear()
 
     benchmark_base = args.benchmark_path
     try:
-        for n, w in enumerate(wrappers):
-            w.laser._fire("stop_sym_exec")
-            if benchmark_base and len(wrappers) > 1:
-                # one series file per contract (same convention as
-                # facade/mythril_analyzer.py) instead of silent overwrites
-                args.benchmark_path = f"{benchmark_base}.{n}"
-            w.finalize()
+        for n, (name, _addr, w) in enumerate(wrappers):
+            try:
+                w.laser._fire("stop_sym_exec")
+                if benchmark_base and len(wrappers) > 1:
+                    # one series file per contract (same convention as
+                    # facade/mythril_analyzer.py) instead of silent overwrites
+                    args.benchmark_path = f"{benchmark_base}.{n}"
+                w.finalize()
+            except Exception as e:
+                _fail(name, "finalization", e)
+                failed.add(name)
     finally:
         args.benchmark_path = benchmark_base
 
@@ -168,6 +251,7 @@ def analyze_cooperative(
     issues_by_name = {
         name: by_hash.get(get_code_hash(code), [])
         for (name, code) in jobs
+        if name not in errors_by_name
     }
-    total_states = sum(w.laser.total_states for w in wrappers)
-    return issues_by_name, total_states
+    total_states = sum(w.laser.total_states for _n, _a, w in wrappers)
+    return issues_by_name, errors_by_name, total_states

@@ -19,6 +19,24 @@ from mythril_tpu.observability import tracer as _otrace
 
 log = logging.getLogger(__name__)
 
+# Optional process-wide issue sink: called with each freshly confirmed issue
+# list the moment a module's execute() accepts it, BEFORE end-of-run
+# collection.  The service daemon installs one to stream issues per request
+# as they confirm; one-shot runs leave it None (a single global load + None
+# check on the hot path).  Installed/removed only between runs from the
+# thread that owns the analysis, so no lock is needed.
+_ISSUE_SINK = None
+
+
+def set_issue_sink(sink):
+    """Install ``sink(issues: List[Issue]) -> None`` as the confirmation
+    tap; returns the previous sink so callers can restore it.  Sink errors
+    are swallowed (streaming must never fail an analysis)."""
+    global _ISSUE_SINK
+    prev = _ISSUE_SINK
+    _ISSUE_SINK = sink
+    return prev
+
 
 class EntryPoint(Enum):
     POST = 1
@@ -115,6 +133,11 @@ class DetectionModule:
         if result:
             self.issues.extend(result)
             self.update_cache(result)
+            if _ISSUE_SINK is not None:
+                try:
+                    _ISSUE_SINK(result)
+                except Exception:
+                    log.exception("issue sink failed; analysis continues")
         return result
 
     def _execute(self, target) -> Optional[List[Issue]]:

@@ -54,6 +54,9 @@ class AnalyzerArgs:
     solver_workers: int = 2
     harvest_workers: int = 4
     compile_cache_dir: Optional[str] = None
+    # one directory pinning BOTH persistent caches (querycache/ + xla/);
+    # explicit query_cache_dir / compile_cache_dir win over the derivation
+    cache_root: Optional[str] = None
     heartbeat_out: Optional[str] = None
     heartbeat_interval: float = 0.5
     flight_recorder: Optional[str] = None
@@ -80,56 +83,12 @@ class MythrilAnalyzer:
 
         StartTime()
 
-        # propagate flags to the global args object (reference :63-70)
-        args.solver_timeout = cmd_args.solver_timeout
-        args.execution_timeout = cmd_args.execution_timeout
-        args.create_timeout = cmd_args.create_timeout
-        args.max_depth = cmd_args.max_depth
-        args.call_depth_limit = cmd_args.call_depth_limit
-        args.loop_bound = cmd_args.loop_bound
-        args.transaction_count = cmd_args.transaction_count
-        args.unconstrained_storage = cmd_args.unconstrained_storage
-        args.sparse_pruning = cmd_args.sparse_pruning
-        args.parallel_solving = cmd_args.parallel_solving
-        args.solver_log = cmd_args.solver_log
-        args.enable_iprof = cmd_args.enable_iprof
-        args.benchmark_path = getattr(cmd_args, "benchmark_path", None)
-        args.checkpoint_path = getattr(cmd_args, "checkpoint_file", None)
-        args.resume_from = getattr(cmd_args, "resume_from", None)
-        args.probe_backend = getattr(cmd_args, "probe_backend", "auto")
-        if args.probe_backend == "cdcl":
-            # forced-exact mode without the native solver would answer every
-            # query UNKNOWN and silently prune the whole state space
-            from mythril_tpu.native import bitblast
+        # propagate flags to the global args object (reference :63-70);
+        # shared with the long-lived service daemon (facade/warm.py) so
+        # one-shot and warm-process runs configure the engine identically
+        from mythril_tpu.facade.warm import apply_analyzer_args
 
-            if not bitblast.available():
-                raise RuntimeError(
-                    "--probe-backend cdcl requires the native CDCL solver "
-                    "(mythril_tpu/native); it is not available in this build"
-                )
-        args.frontier = getattr(cmd_args, "frontier", False)
-        args.frontier_width = getattr(cmd_args, "frontier_width", 64)
-        args.query_cache = getattr(cmd_args, "query_cache", True)
-        args.query_cache_dir = getattr(cmd_args, "query_cache_dir", None)
-        args.staticpass = getattr(cmd_args, "staticpass", True)
-        args.pipeline = getattr(cmd_args, "pipeline", True)
-        args.frontier_mesh = getattr(cmd_args, "frontier_mesh", True)
-        args.solver_workers = getattr(cmd_args, "solver_workers", 2)
-        args.harvest_workers = getattr(cmd_args, "harvest_workers", 4)
-        args.compile_cache_dir = getattr(cmd_args, "compile_cache_dir", None)
-        args.heartbeat_out = getattr(cmd_args, "heartbeat_out", None)
-        args.heartbeat_interval = getattr(cmd_args, "heartbeat_interval", 0.5)
-        args.flight_recorder = getattr(cmd_args, "flight_recorder", None)
-        args.watchdog_deadline = getattr(cmd_args, "watchdog_deadline", None)
-        from mythril_tpu.querycache import configure as _configure_query_cache
-
-        _configure_query_cache(
-            enabled=args.query_cache, cache_dir=args.query_cache_dir
-        )
-        if args.compile_cache_dir:
-            from mythril_tpu import enable_persistent_compilation_cache
-
-            enable_persistent_compilation_cache(args.compile_cache_dir)
+        apply_analyzer_args(cmd_args)
 
     def _sym_exec(self, contract, run_analysis_modules: bool = True) -> SymExecWrapper:
         from mythril_tpu.support.loader import DynLoader

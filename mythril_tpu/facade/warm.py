@@ -1,0 +1,137 @@
+"""Warm-process facade: reusable flag propagation + per-request scoping.
+
+``MythrilAnalyzer`` was written for one-shot processes: its constructor
+copies CLI args into the global flag object, arms the caches, and the
+process exits after one report.  The analysis service needs exactly that
+propagation WITHOUT constructing an analyzer per request — the process
+stays warm and only the per-request telemetry/detector scope resets
+between batches.  This module is the shared half:
+
+* ``apply_analyzer_args`` — the flag-propagation block, factored out of
+  ``MythrilAnalyzer.__init__`` so the daemon and the one-shot facade run
+  the identical configuration path (including ``--cache-root``
+  derivation and cache arming).
+* ``resolve_cache_root`` — one directory pins both persistent caches.
+* ``reset_analysis_scope`` — the scope sweep that makes each service
+  batch behave like a fresh process: non-persistent metrics, detector
+  issue lists, and the process-wide (address, bytecode_hash) detection
+  caches are cleared; the SMT query cache, interned terms, and compiled
+  XLA programs deliberately stay warm (their reuse is sound by
+  construction — validated hits only).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from mythril_tpu.support.support_args import args
+
+__all__ = [
+    "apply_analyzer_args",
+    "reset_analysis_scope",
+    "resolve_cache_root",
+]
+
+
+def resolve_cache_root(cache_root: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """Map ``--cache-root DIR`` to ``(query_cache_dir, compile_cache_dir)``.
+
+    One directory configures all service persistence: the SMT query
+    cache lands under ``DIR/querycache`` and the XLA compilation cache
+    under ``DIR/xla``.  Explicit ``--query-cache-dir`` /
+    ``--compile-cache-dir`` flags win over the derived paths.
+    """
+    if not cache_root:
+        return None, None
+    root = os.path.abspath(os.path.expanduser(cache_root))
+    return os.path.join(root, "querycache"), os.path.join(root, "xla")
+
+
+def apply_analyzer_args(cmd_args) -> None:
+    """Propagate facade args onto the global flag object and arm caches.
+
+    Mirrors the reference's copy-into-singleton pattern
+    (mythril/mythril/mythril_analyzer.py:63-70); shared by the one-shot
+    ``MythrilAnalyzer`` and the long-lived ``service.AnalysisService``
+    so both configure the engine identically.
+    """
+    args.solver_timeout = cmd_args.solver_timeout
+    args.execution_timeout = cmd_args.execution_timeout
+    args.create_timeout = cmd_args.create_timeout
+    args.max_depth = cmd_args.max_depth
+    args.call_depth_limit = cmd_args.call_depth_limit
+    args.loop_bound = cmd_args.loop_bound
+    args.transaction_count = cmd_args.transaction_count
+    args.unconstrained_storage = cmd_args.unconstrained_storage
+    args.sparse_pruning = cmd_args.sparse_pruning
+    args.parallel_solving = cmd_args.parallel_solving
+    args.solver_log = cmd_args.solver_log
+    args.enable_iprof = cmd_args.enable_iprof
+    args.benchmark_path = getattr(cmd_args, "benchmark_path", None)
+    args.checkpoint_path = getattr(cmd_args, "checkpoint_file", None)
+    args.resume_from = getattr(cmd_args, "resume_from", None)
+    args.probe_backend = getattr(cmd_args, "probe_backend", "auto")
+    if args.probe_backend == "cdcl":
+        # forced-exact mode without the native solver would answer every
+        # query UNKNOWN and silently prune the whole state space
+        from mythril_tpu.native import bitblast
+
+        if not bitblast.available():
+            raise RuntimeError(
+                "--probe-backend cdcl requires the native CDCL solver "
+                "(mythril_tpu/native); it is not available in this build"
+            )
+    args.frontier = getattr(cmd_args, "frontier", False)
+    args.frontier_width = getattr(cmd_args, "frontier_width", 64)
+    args.query_cache = getattr(cmd_args, "query_cache", True)
+    args.staticpass = getattr(cmd_args, "staticpass", True)
+    args.pipeline = getattr(cmd_args, "pipeline", True)
+    args.frontier_mesh = getattr(cmd_args, "frontier_mesh", True)
+    args.solver_workers = getattr(cmd_args, "solver_workers", 2)
+    args.harvest_workers = getattr(cmd_args, "harvest_workers", 4)
+    args.heartbeat_out = getattr(cmd_args, "heartbeat_out", None)
+    args.heartbeat_interval = getattr(cmd_args, "heartbeat_interval", 0.5)
+    args.flight_recorder = getattr(cmd_args, "flight_recorder", None)
+    args.watchdog_deadline = getattr(cmd_args, "watchdog_deadline", None)
+    # --cache-root pins both persistent caches under one directory;
+    # explicit per-cache flags win over the derived paths
+    args.cache_root = getattr(cmd_args, "cache_root", None)
+    derived_qc, derived_xla = resolve_cache_root(args.cache_root)
+    args.query_cache_dir = (
+        getattr(cmd_args, "query_cache_dir", None) or derived_qc
+    )
+    args.compile_cache_dir = (
+        getattr(cmd_args, "compile_cache_dir", None) or derived_xla
+    )
+    from mythril_tpu.querycache import configure as _configure_query_cache
+
+    _configure_query_cache(
+        enabled=args.query_cache, cache_dir=args.query_cache_dir
+    )
+    if args.compile_cache_dir:
+        from mythril_tpu import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache(args.compile_cache_dir)
+
+
+def reset_analysis_scope() -> None:
+    """Make the next analysis in this process behave like a fresh one.
+
+    Clears per-analysis telemetry (non-persistent metrics, which resets
+    the FrontierStatistics/SolverStatistics facades), detector issue
+    lists, and the process-wide (address, bytecode_hash) detection
+    caches — without the caches sweep a daemon batch would silently
+    suppress re-detection of anything a previous batch already flagged.
+    Deliberately does NOT drop the SMT query cache, the interned-term
+    tables, or compiled XLA programs: keeping those warm across requests
+    is the service's entire point, and their reuse is validated-sound.
+    """
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.analysis.security import reset_callback_modules
+    from mythril_tpu.observability import reset_analysis_metrics
+
+    reset_analysis_metrics()
+    reset_callback_modules()
+    for module in ModuleLoader().get_detection_modules():
+        module.cache.clear()

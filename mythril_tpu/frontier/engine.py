@@ -30,7 +30,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -294,6 +294,7 @@ def drain_lasers(
     lasers: List,
     caps: Optional[Caps] = None,
     bucket_floor: Optional[tuple] = None,
+    tags: Optional[Sequence[str]] = None,
 ) -> int:
     """Run eligible seeds from EVERY laser's work list as one multi-code
     frontier batch (the cooperative corpus entry point).  Parked paths land
@@ -304,7 +305,9 @@ def drain_lasers(
     minimum (code_cap, instr_cap, addr_cap, loops_cap) so every round of a
     cooperative run reuses ONE compiled segment program even as the live
     code set shrinks (a smaller round must not trigger a fresh XLA compile
-    mid-sweep)."""
+    mid-sweep).  ``tags`` (service request ids riding this batch) annotate
+    every ``frontier.segment`` span so a shared wide device segment is
+    attributable to the requests it serves."""
     groups: Dict[tuple, List[Tuple]] = {}
     for laser in lasers:
         if _is_concolic(laser):
@@ -323,6 +326,8 @@ def drain_lasers(
     executed = 0
     for pairs in groups.values():
         engine = FrontierEngine(pairs[0][0], caps)
+        if tags:
+            engine.request_tags = tuple(tags)
         executed += engine._drain_pairs(pairs, bucket_floor=bucket_floor)
     return executed
 
@@ -331,6 +336,9 @@ class FrontierEngine:
     def __init__(self, laser, caps: Optional[Caps] = None):
         self.laser = laser
         self.caps = caps or Caps(B=args.frontier_width)
+        # service request ids riding this engine's segments (set by
+        # drain_lasers(tags=...)); stamped onto frontier.segment spans
+        self.request_tags: Optional[tuple] = None
 
     # ------------------------------------------------------------------
 
@@ -965,6 +973,10 @@ class FrontierEngine:
             with _otrace.span(
                 "frontier.segment", cat="device", segment=-1,
                 warm=(caps, natural_bucket) in _WARM_PROGRAMS, opening=True,
+                **(
+                    {"requests": ",".join(self.request_tags)}
+                    if self.request_tags else {}
+                ),
             ), _otrace.device_annotation("frontier.segment"):
                 if _fid0 is not None:
                     _otrace.get_tracer().flow("s", _fid0, "flow.segment",
@@ -1083,6 +1095,10 @@ class FrontierEngine:
             with _otrace.span(
                 "frontier.segment", cat="device",
                 segment=run_segments, warm=program_warm,
+                **(
+                    {"requests": ",".join(self.request_tags)}
+                    if self.request_tags else {}
+                ),
             ), _otrace.device_annotation("frontier.segment"):
                 if _fid is not None:
                     _otrace.get_tracer().flow("s", _fid, "flow.segment",

@@ -199,6 +199,14 @@ def _add_analysis_options(parser) -> None:
         "to a path to relocate",
     )
     group.add_argument(
+        "--cache-root",
+        metavar="DIR",
+        help="pin BOTH persistent caches under one directory: SMT query "
+        "cache in DIR/querycache, XLA compilation cache in DIR/xla (one "
+        "flag for service deployments); explicit --query-cache-dir / "
+        "--compile-cache-dir win over the derived paths",
+    )
+    group.add_argument(
         "--no-staticpass",
         action="store_true",
         help="disable the static bytecode pre-analysis pass (CFG + abstract-"
@@ -331,6 +339,106 @@ def create_parser() -> argparse.ArgumentParser:
     h2a = subparsers.add_parser("hash-to-address", help="look up signatures for a selector")
     h2a.add_argument("hash", help="e.g. 0xa9059cbb")
 
+    serve = subparsers.add_parser(
+        "serve",
+        help="run the persistent analysis service (multi-tenant daemon: "
+        "shared-batch admission, codehash dedup, streamed results)",
+    )
+    serve.add_argument("--host", default="127.0.0.1", help="bind address")
+    serve.add_argument("--port", type=int, default=7344, help="TCP port")
+    serve.add_argument(
+        "--batch-width", type=int, default=8, metavar="N",
+        help="max compatible requests admitted into one shared device batch",
+    )
+    serve.add_argument(
+        "--batch-window", type=float, default=0.05, metavar="SECONDS",
+        help="admission window held open for more arrivals (interactive "
+        "requests cut it short)",
+    )
+    serve.add_argument(
+        "--no-probe", action="store_false", dest="probe", default=True,
+        help="disable the host-first hybrid probe for interactive-tier "
+        "requests (default on: first evidence never waits on a cold "
+        "XLA bucket)",
+    )
+    serve.add_argument(
+        "--no-frontier", action="store_false", dest="frontier", default=True,
+        help="run service batches on host engines only (no device frontier)",
+    )
+    serve.add_argument(
+        "--no-warmup", action="store_false", dest="warmup", default=True,
+        help="skip the startup warmup analysis",
+    )
+    serve.add_argument(
+        "--cache-root", metavar="DIR",
+        help="pin the SMT query cache (DIR/querycache) and XLA compile "
+        "cache (DIR/xla) under one directory",
+    )
+    serve.add_argument(
+        "-t", "--transaction-count", type=int, default=2,
+        help="default transaction count for submissions",
+    )
+    serve.add_argument(
+        "-m", "--modules", metavar="MODULES",
+        help="comma-separated default detection modules",
+    )
+    serve.add_argument(
+        "--strategy", default="bfs",
+        choices=["dfs", "bfs", "naive-random", "weighted-random",
+                 "beam-search"],
+        help="default search strategy",
+    )
+    serve.add_argument(
+        "--execution-timeout", type=int, default=60,
+        help="default per-request execution timeout (seconds)",
+    )
+    serve.add_argument(
+        "--heartbeat-out", metavar="FILE",
+        help="sample service queue depths into FILE as JSON lines",
+    )
+    serve.add_argument(
+        "--heartbeat-interval", type=float, default=0.5, metavar="SECONDS",
+        help="heartbeat sampling period (default 0.5s)",
+    )
+    _add_verbosity(serve)
+
+    submit = subparsers.add_parser(
+        "submit", help="submit a contract to a running analysis service"
+    )
+    submit.add_argument("--host", default="127.0.0.1", help="service host")
+    submit.add_argument("--port", type=int, default=7344, help="service port")
+    submit.add_argument(
+        "-c", "--code", metavar="BYTECODE",
+        help="hex-encoded runtime bytecode",
+    )
+    submit.add_argument(
+        "-f", "--codefile", metavar="BYTECODEFILE",
+        help="file containing hex-encoded runtime bytecode",
+    )
+    submit.add_argument("--name", help="request label")
+    submit.add_argument(
+        "--tier", choices=["batch", "interactive"], default="batch",
+        help="interactive jumps the admission queue and gets the "
+        "host-first probe (TTFE budget)",
+    )
+    submit.add_argument(
+        "-t", "--transaction-count", type=int, default=None,
+        help="override the service's default transaction count",
+    )
+    submit.add_argument(
+        "-m", "--modules", metavar="MODULES",
+        help="comma-separated detection modules",
+    )
+    submit.add_argument(
+        "--execution-timeout", type=int, default=None,
+        help="override the service's default execution timeout (seconds)",
+    )
+    submit.add_argument(
+        "-o", "--outform", choices=["text", "json"], default="text",
+        help="output format",
+    )
+    _add_verbosity(submit)
+
     subparsers.add_parser("version", help="print version")
     subparsers.add_parser("help", help="print help")
     return parser
@@ -430,6 +538,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         solver_workers=getattr(parsed, "solver_workers", 2),
         harvest_workers=getattr(parsed, "harvest_workers", 4),
         compile_cache_dir=getattr(parsed, "compile_cache_dir", None),
+        cache_root=getattr(parsed, "cache_root", None),
         heartbeat_out=getattr(parsed, "heartbeat_out", None),
         heartbeat_interval=getattr(parsed, "heartbeat_interval", 0.5),
         flight_recorder=getattr(parsed, "flight_recorder", None),
@@ -617,6 +726,92 @@ def execute_command(parsed) -> None:
               "with unconstrained storage; probe-based, not a completeness proof):")
         for fn in safe:
             print(f"  - {fn}")
+        return
+
+    if command == "serve":
+        from mythril_tpu.service.daemon import ServiceConfig
+        from mythril_tpu.service.request import AnalysisOptions
+        from mythril_tpu.service.server import run_server
+
+        modules = (
+            tuple(parsed.modules.split(","))
+            if getattr(parsed, "modules", None) else None
+        )
+        config = ServiceConfig(
+            default_options=AnalysisOptions(
+                transaction_count=parsed.transaction_count,
+                modules=modules,
+                strategy=parsed.strategy,
+                execution_timeout=parsed.execution_timeout,
+            ),
+            max_batch_width=parsed.batch_width,
+            batch_window_s=parsed.batch_window,
+            frontier=parsed.frontier,
+            probe=parsed.probe,
+            cache_root=getattr(parsed, "cache_root", None),
+            warmup=parsed.warmup,
+            heartbeat=True,
+            heartbeat_interval_s=parsed.heartbeat_interval,
+        )
+        if getattr(parsed, "heartbeat_out", None):
+            from mythril_tpu.observability import get_heartbeat
+
+            get_heartbeat().start(
+                period_s=parsed.heartbeat_interval,
+                out_path=parsed.heartbeat_out,
+            )
+        sys.exit(run_server(config, host=parsed.host, port=parsed.port))
+
+    if command == "submit":
+        from mythril_tpu.service.client import ServiceClient
+
+        if parsed.code:
+            code = parsed.code
+        elif parsed.codefile:
+            with open(parsed.codefile) as f:
+                code = f.read().strip()
+        else:
+            raise CriticalError("submit needs -c/--code or -f/--codefile")
+        client = ServiceClient(parsed.host, parsed.port)
+        modules = (
+            parsed.modules.split(",") if getattr(parsed, "modules", None)
+            else None
+        )
+        as_json = parsed.outform == "json"
+        try:
+            for event in client.submit_stream(
+                code,
+                name=parsed.name,
+                tier=parsed.tier,
+                transaction_count=parsed.transaction_count,
+                modules=modules,
+                execution_timeout=parsed.execution_timeout,
+            ):
+                if as_json:
+                    print(json.dumps(event), flush=True)
+                    continue
+                kind = event.get("event")
+                if kind == "accepted":
+                    dd = " (deduplicated)" if event.get("deduped") else ""
+                    print(f"accepted {event['request_id']}{dd}", flush=True)
+                elif kind == "issue":
+                    prov = " [provisional]" if event.get("provisional") else ""
+                    print(
+                        f"issue SWC-{event.get('swc_id')} "
+                        f"{event.get('title')} @ {event.get('function')}"
+                        f"{prov}",
+                        flush=True,
+                    )
+                elif kind == "error":
+                    raise CriticalError(f"analysis failed: {event.get('error')}")
+                else:
+                    print(
+                        f"done: {len(event.get('issues', []))} issues in "
+                        f"{event.get('elapsed_s')}s",
+                        flush=True,
+                    )
+        except (ConnectionError, OSError) as e:
+            raise CriticalError(f"cannot reach analysis service: {e}") from e
         return
 
     if command == "analyze":

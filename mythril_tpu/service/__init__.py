@@ -1,0 +1,41 @@
+"""Analysis-as-a-service: a long-lived multi-tenant daemon.
+
+Every pre-service entry point is a cold one-shot process: each request
+re-pays XLA compilation, SMT query-cache warmup, and runs its contract
+alone on the device even when the slot batch is mostly empty.  This
+package converts the batch tool into a server:
+
+* ``daemon.AnalysisService`` — the warm process.  One worker thread owns
+  the (non-reentrant) analysis singletons and runs admitted requests as
+  shared wide device batches via the cooperative corpus sweep
+  (``analysis/cooperative.run_cooperative_batch``), streaming issues back
+  per request as they confirm.
+* ``admission.AdmissionController`` — queue + dedup.  Submissions are
+  keyed by canonical codehash + options; duplicate submitters subscribe
+  to the in-flight result (replay-then-live ordering) or get a cached
+  replay of a completed one.
+* ``server.run_server`` / ``client.ServiceClient`` — a thin JSON-lines
+  TCP layer (``myth serve`` / ``myth submit``) over the in-process API.
+
+Determinism contract: each request's issue set (by
+``codehash.issue_digest``) is bit-identical to a solo run of the same
+contract — shared batching changes scheduling, never findings.  See
+docs/source/service.rst.
+"""
+
+from mythril_tpu.service.codehash import (  # noqa: F401
+    canonical_codehash,
+    issue_digest,
+    normalize_code,
+    options_key,
+)
+from mythril_tpu.service.request import (  # noqa: F401
+    AnalysisOptions,
+    AnalysisRequest,
+    ResultStream,
+)
+from mythril_tpu.service.admission import AdmissionController  # noqa: F401
+from mythril_tpu.service.daemon import (  # noqa: F401
+    AnalysisService,
+    ServiceConfig,
+)

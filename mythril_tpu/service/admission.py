@@ -1,0 +1,222 @@
+"""Admission control: dedup by canonical identity, batch by options.
+
+The controller owns three maps, all keyed by ``(codehash,
+options_key)``:
+
+* ``pending`` — flights waiting for a batch slot (FIFO by first
+  submission time; interactive flights jump the line),
+* ``running`` — flights the worker has admitted into the current batch,
+* ``results`` — a bounded log of completed flights for instant replay.
+
+A duplicate submission never re-analyzes: it subscribes to the pending
+or running flight (replay-then-live ordering under the flight lock) or
+replays a completed result.  ``next_batch`` hands the worker the oldest
+compatible group — all admitted flights share one options key, because
+the cooperative sweep runs one configuration per batch.
+
+Every mutation is guarded by one controller lock; flight event fan-out
+is guarded by the per-flight lock so replay and live emission cannot
+interleave.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from mythril_tpu.observability.metrics import get_registry
+from mythril_tpu.service.request import AnalysisRequest, ResultStream
+
+log = logging.getLogger(__name__)
+
+__all__ = ["AdmissionController", "Flight"]
+
+Key = Tuple[str, Tuple]
+
+
+class Flight:
+    """One in-progress analysis and its subscribers.
+
+    ``emit`` appends to the event log and fans out to every subscriber;
+    ``subscribe`` replays the log into the new stream first — both under
+    ``self.lock``, so a late subscriber sees exactly the events an early
+    one did, in order, with no loss or duplication at the seam.
+    """
+
+    def __init__(self, key: Key, request: AnalysisRequest):
+        self.key = key
+        self.codehash = request.codehash
+        self.options = request.options
+        self.tier = request.tier
+        self.created_at = request.submitted_at
+        self.requests: List[AnalysisRequest] = [request]
+        self.lock = threading.Lock()
+        self.events: List[Tuple[str, Any]] = []
+        self.streams: List[ResultStream] = []
+        self.finished = False
+        # first-evidence attribution for the probe-vs-device counters
+        self.first_issue_source: Optional[str] = None
+
+    def subscribe(self, request: AnalysisRequest) -> ResultStream:
+        stream = ResultStream(request.request_id)
+        with self.lock:
+            if request not in self.requests:
+                self.requests.append(request)
+                if request.interactive:
+                    self.tier = request.tier  # a dup upgrade counts
+            for kind, payload in self.events:
+                stream.push(kind, payload)
+            if not self.finished:
+                self.streams.append(stream)
+        return stream
+
+    def emit(self, kind: str, payload: Any, source: str = "device") -> None:
+        with self.lock:
+            if self.finished:
+                return
+            if kind == "issue" and self.first_issue_source is None:
+                self.first_issue_source = source
+            self.events.append((kind, payload))
+            if kind in ResultStream._DONE_KINDS:
+                self.finished = True
+            for stream in self.streams:
+                stream.push(kind, payload)
+            if self.finished:
+                self.streams.clear()
+
+    @property
+    def interactive(self) -> bool:
+        return self.tier == "interactive"
+
+
+class AdmissionController:
+    def __init__(self, result_cache_size: int = 256):
+        self._lock = threading.Lock()
+        self._pending: "OrderedDict[Key, Flight]" = OrderedDict()
+        self._running: Dict[Key, Flight] = {}
+        self._results: "OrderedDict[Key, List[Tuple[str, Any]]]" = OrderedDict()
+        self._result_cache_size = result_cache_size
+        self._arrival = threading.Condition(self._lock)
+        reg = get_registry()
+        # persistent=True: the worker sweeps analysis-scoped metrics
+        # before every batch; service counters must survive that
+        self._c_requests = reg.counter("service.requests", persistent=True)
+        self._c_dedup = reg.counter("service.dedup_hits", persistent=True)
+        self._c_replay = reg.counter("service.replay_hits", persistent=True)
+        self._c_admitted = reg.counter("service.admitted", persistent=True)
+
+    # -- submission side ----------------------------------------------
+
+    def submit(self, request: AnalysisRequest) -> Tuple[ResultStream, bool]:
+        """Queue ``request``; returns ``(stream, deduped)``.
+
+        ``deduped`` is True when no new analysis was scheduled — the
+        request subscribed to an in-flight twin or replayed a completed
+        result.
+        """
+        key: Key = (request.codehash, request.options.key())
+        self._c_requests.inc()
+        with self._lock:
+            flight = self._pending.get(key) or self._running.get(key)
+            if flight is not None:
+                self._c_dedup.inc()
+                stream = flight.subscribe(request)
+                return stream, True
+            cached = self._results.get(key)
+            if cached is not None:
+                self._results.move_to_end(key)
+                self._c_dedup.inc()
+                self._c_replay.inc()
+                stream = ResultStream(request.request_id)
+                for kind, payload in cached:
+                    stream.push(kind, payload)
+                return stream, True
+            flight = Flight(key, request)
+            self._pending[key] = flight
+            stream = flight.subscribe(request)
+            self._arrival.notify_all()
+            return stream, False
+
+    # -- worker side ---------------------------------------------------
+
+    def wait_for_pending(self, timeout: Optional[float] = None) -> bool:
+        """Block until at least one flight is pending (or timeout)."""
+        with self._lock:
+            if self._pending:
+                return True
+            self._arrival.wait(timeout=timeout)
+            return bool(self._pending)
+
+    def has_interactive_pending(self) -> bool:
+        with self._lock:
+            return any(f.interactive for f in self._pending.values())
+
+    def next_batch(self, max_width: int) -> List[Flight]:
+        """Admit up to ``max_width`` compatible flights and mark them
+        running.
+
+        The anchor is the oldest pending interactive flight if one
+        exists (interactive jumps the line), else the oldest pending
+        flight; every other admitted flight shares the anchor's options
+        key.  Remaining flights stay pending for the next batch.
+        """
+        with self._lock:
+            if not self._pending:
+                return []
+            anchor = next(
+                (f for f in self._pending.values() if f.interactive),
+                next(iter(self._pending.values())),
+            )
+            opts_key = anchor.key[1]
+            batch: List[Flight] = [anchor]
+            for key, flight in self._pending.items():
+                if flight is anchor or len(batch) >= max_width:
+                    continue
+                if key[1] == opts_key:
+                    batch.append(flight)
+            for flight in batch:
+                del self._pending[flight.key]
+                self._running[flight.key] = flight
+            self._c_admitted.inc(len(batch))
+            return batch
+
+    def finish(self, flight: Flight, events: Optional[List[Tuple[str, Any]]] = None) -> None:
+        """Retire a running flight; cache its event log for replay.
+
+        Error'd flights are NOT cached — a tenant-scoped failure
+        (solver timeout, plugin exception) must not poison later
+        submissions of the same contract.
+        """
+        with self._lock:
+            self._running.pop(flight.key, None)
+            log_ = events if events is not None else flight.events
+            if log_ and log_[-1][0] == "done":
+                self._results[flight.key] = list(log_)
+                self._results.move_to_end(flight.key)
+                while len(self._results) > self._result_cache_size:
+                    self._results.popitem(last=False)
+
+    # -- introspection -------------------------------------------------
+
+    def depths(self) -> Dict[str, int]:
+        """Heartbeat source payload (sampled, never set on mutation)."""
+        with self._lock:
+            return {
+                "service.queue_depth": len(self._pending),
+                "service.inflight": len(self._running),
+                "service.result_cache": len(self._results),
+            }
+
+    def drain_wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until no pending and no running flights remain."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            with self._lock:
+                if not self._pending and not self._running:
+                    return True
+            if deadline is not None and time.time() > deadline:
+                return False
+            time.sleep(0.02)

@@ -1,0 +1,107 @@
+"""Thin client for the analysis service (``myth submit``).
+
+One TCP connection per submission: write the request line, then iterate
+the event lines the daemon streams back.  ``submit_stream`` yields each
+event dict as it arrives (issues the moment they confirm); ``submit``
+collects and returns the terminal summary.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+__all__ = ["ServiceClient"]
+
+
+class ServiceClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 7344,
+                 timeout: Optional[float] = 300.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    # -- plumbing ------------------------------------------------------
+
+    def _roundtrip(self, msg: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+        with socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        ) as sock:
+            sock.sendall((json.dumps(msg) + "\n").encode())
+            with sock.makefile("r", encoding="utf-8") as rf:
+                for line in rf:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+
+    # -- API -----------------------------------------------------------
+
+    def ping(self) -> bool:
+        for event in self._roundtrip({"op": "ping"}):
+            return event.get("event") == "pong"
+        return False
+
+    def stats(self) -> Dict[str, Any]:
+        for event in self._roundtrip({"op": "stats"}):
+            return event
+        return {}
+
+    def submit_stream(
+        self,
+        code: str,
+        name: Optional[str] = None,
+        tier: str = "batch",
+        transaction_count: Optional[int] = None,
+        modules: Optional[Sequence[str]] = None,
+        strategy: Optional[str] = None,
+        execution_timeout: Optional[int] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Yield event dicts: ``accepted``, ``issue``*, ``done``/``error``."""
+        msg: Dict[str, Any] = {"op": "submit", "code": code, "tier": tier}
+        if name:
+            msg["name"] = name
+        if transaction_count is not None:
+            msg["transaction_count"] = transaction_count
+        if modules:
+            msg["modules"] = list(modules)
+        if strategy:
+            msg["strategy"] = strategy
+        if execution_timeout is not None:
+            msg["execution_timeout"] = execution_timeout
+        terminal = False
+        for event in self._roundtrip(msg):
+            yield event
+            if event.get("event") in ("done", "error"):
+                terminal = True
+                break
+        if not terminal:
+            raise ConnectionError(
+                "server closed the stream before a terminal event"
+            )
+
+    def submit(self, code: str, **kwargs) -> Dict[str, Any]:
+        """Blocking submit; returns the ``done`` summary.
+
+        The summary's ``issues`` list is authoritative; ``streamed``
+        carries the incrementally received issue events (a superset
+        check for the determinism tests).  Raises ``RuntimeError`` on a
+        per-request analysis failure.
+        """
+        streamed: List[Dict[str, Any]] = []
+        accepted: Dict[str, Any] = {}
+        for event in self.submit_stream(code, **kwargs):
+            kind = event.get("event")
+            if kind == "accepted":
+                accepted = event
+            elif kind == "issue":
+                streamed.append(event)
+            elif kind == "error":
+                raise RuntimeError(f"analysis failed: {event.get('error')}")
+            elif kind == "done":
+                out = dict(event)
+                out["streamed"] = streamed
+                out["request_id"] = accepted.get("request_id")
+                out["deduped"] = accepted.get("deduped", False)
+                return out
+        raise ConnectionError("stream ended without terminal event")

@@ -1,0 +1,447 @@
+"""The warm analysis process: admission worker, streaming, isolation.
+
+One daemon thread (``service-worker``) owns every non-reentrant analysis
+singleton — the global flag object, the time handler, the detection
+module loader — and runs admitted flights as shared wide device batches
+through ``analysis.cooperative.run_cooperative_batch``.  Submissions and
+stream consumption happen on arbitrary threads; only the worker touches
+the engine.
+
+Per-batch scope reset (``facade.warm.reset_analysis_scope``) makes every
+batch behave like a fresh process for *detection* while the SMT query
+cache, interned terms, and compiled XLA programs stay warm — that split
+is the determinism story: issue sets are bit-identical to solo runs
+(differentially tested in tests/service/), throughput is not.
+
+Streaming: a process-wide issue sink (``module.base.set_issue_sink``)
+taps every confirmation the moment a module accepts it; the sink
+attributes issues to flights by ``Issue.bytecode_hash`` and emits each
+digest once per flight.  The terminal ``done`` event carries the
+authoritative end-of-batch issue list, so a client that ignores the
+stream loses latency, never findings.
+
+Interactive tier: flights submitted with ``tier="interactive"`` jump the
+admission queue, cut the batch window, and (by default) get a bounded
+host-first 1-tx probe *before* the authoritative batch — a cold XLA
+bucket then costs the probe nothing, so the TTFE budget holds even on
+first contact.  Probe findings stream marked ``provisional``; the
+``service.probe_wins`` / ``service.device_wins`` counters record which
+side delivered a request's first evidence.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from mythril_tpu.observability import tracer as _otrace
+from mythril_tpu.observability.heartbeat import get_heartbeat
+from mythril_tpu.observability.metrics import get_registry
+from mythril_tpu.service.admission import AdmissionController, Flight
+from mythril_tpu.service.codehash import canonical_codehash, issue_digest, normalize_code
+from mythril_tpu.service.request import (
+    AnalysisOptions,
+    AnalysisRequest,
+    ResultStream,
+    TIER_BATCH,
+    TIER_INTERACTIVE,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["AnalysisService", "ServiceConfig"]
+
+#: minimal STOP contract used to pull heavy imports during warmup
+_WARMUP_CODE = bytes.fromhex("00")
+
+
+@dataclass
+class ServiceConfig:
+    default_options: AnalysisOptions = field(default_factory=AnalysisOptions)
+    max_batch_width: int = 8
+    #: how long the worker holds an admission window open for more
+    #: arrivals once work is pending (interactive arrivals cut it short)
+    batch_window_s: float = 0.05
+    #: run batches on the device frontier (the service's raison d'être);
+    #: tests flip this off for pure-host speed
+    frontier: bool = True
+    #: host-first hybrid probe for interactive-tier requests (default ON:
+    #: a cold bucket must still meet the TTFE budget)
+    probe: bool = True
+    probe_timeout_s: int = 10
+    #: one directory pinning query cache + XLA compile cache
+    cache_root: Optional[str] = None
+    #: run a tiny analysis at start() so imports/solver are hot before
+    #: the first real request lands
+    warmup: bool = True
+    #: start the heartbeat sampler and register the service depth source
+    heartbeat: bool = False
+    heartbeat_interval_s: float = 0.5
+    result_cache_size: int = 256
+
+
+class AnalysisService:
+    def __init__(self, config: Optional[ServiceConfig] = None):
+        self.config = config or ServiceConfig()
+        self.admission = AdmissionController(
+            result_cache_size=self.config.result_cache_size
+        )
+        self._ids = itertools.count(1)
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._warm_ready = threading.Event()
+        self._draining = False
+        self._started = False
+        reg = get_registry()
+        self._c_batches = reg.counter("service.batches", persistent=True)
+        self._h_width = reg.histogram(
+            "service.batch_width", buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+            persistent=True,
+        )
+        self._c_streamed = reg.counter("service.streamed_issues", persistent=True)
+        self._c_errors = reg.counter("service.request_errors", persistent=True)
+        self._c_probe_wins = reg.counter("service.probe_wins", persistent=True)
+        self._c_device_wins = reg.counter("service.device_wins", persistent=True)
+        self._c_probe_runs = reg.counter("service.probe_runs", persistent=True)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "AnalysisService":
+        if self._started:
+            return self
+        self._configure_process()
+        hb = get_heartbeat()
+        hb.register("service", self.admission.depths)
+        if self.config.heartbeat and not hb.running:
+            hb.start(period_s=self.config.heartbeat_interval_s)
+        self._stop.clear()
+        self._warm_ready.clear()
+        self._draining = False
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="service-worker", daemon=True
+        )
+        self._started = True
+        self._worker.start()
+        return self
+
+    def wait_warm(self, timeout: Optional[float] = None) -> bool:
+        """Block until startup warmup has finished (immediately true when
+        ``warmup=False``).  Load generators use this so measured windows
+        start from a warm process, matching the service's steady state."""
+        return self._warm_ready.wait(timeout)
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
+        """Stop the worker; with ``drain`` (the SIGTERM path) finish every
+        pending and running flight first.  Returns True on clean drain."""
+        if not self._started:
+            return True
+        self._draining = True  # reject new submissions immediately
+        drained = True
+        if drain:
+            drained = self.admission.drain_wait(timeout=timeout)
+        self._stop.set()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout=30.0)
+        self._worker = None
+        self._started = False
+        get_heartbeat().unregister("service")
+        return drained
+
+    def _configure_process(self) -> None:
+        """Arm the warm-process configuration once, at startup."""
+        from mythril_tpu.facade.mythril_analyzer import AnalyzerArgs
+        from mythril_tpu.facade.warm import apply_analyzer_args
+
+        opts = self.config.default_options
+        apply_analyzer_args(AnalyzerArgs(
+            strategy=opts.strategy,
+            transaction_count=opts.transaction_count,
+            execution_timeout=opts.execution_timeout,
+            modules=list(opts.modules) if opts.modules else None,
+            frontier=self.config.frontier,
+            cache_root=self.config.cache_root,
+        ))
+
+    def _warmup(self) -> None:
+        """Pull heavy imports + solver setup with a minimal contract so
+        the first real request pays dispatch, not process warmup."""
+        from mythril_tpu.analysis.cooperative import run_cooperative_batch
+
+        t0 = time.perf_counter()
+        try:
+            with _otrace.span("service.warmup", cat="service"):
+                run_cooperative_batch(
+                    [("warmup", _WARMUP_CODE)],
+                    transaction_count=1,
+                    execution_timeout=5,
+                    isolate_errors=True,
+                )
+        except Exception:
+            log.exception("service warmup failed; continuing cold")
+        self._scope_reset()
+        log.info("service warmup done in %.2fs", time.perf_counter() - t0)
+
+    # -- submission API (any thread) -----------------------------------
+
+    def submit(
+        self,
+        code,
+        name: Optional[str] = None,
+        tier: str = TIER_BATCH,
+        options: Optional[AnalysisOptions] = None,
+    ) -> Tuple[AnalysisRequest, ResultStream, bool]:
+        """Queue one contract; returns ``(request, stream, deduped)``."""
+        if self._draining or not self._started:
+            raise RuntimeError("service is not accepting submissions")
+        if tier not in (TIER_BATCH, TIER_INTERACTIVE):
+            raise ValueError(f"unknown tier {tier!r}")
+        raw = normalize_code(code)
+        codehash = canonical_codehash(raw)
+        request = AnalysisRequest(
+            request_id=f"r{next(self._ids):06d}",
+            name=name or codehash[:10],
+            code=raw,
+            codehash=codehash,
+            options=options or self.config.default_options,
+            tier=tier,
+        )
+        stream, deduped = self.admission.submit(request)
+        return request, stream, deduped
+
+    def stats(self) -> Dict[str, Any]:
+        reg = get_registry()
+        out = dict(self.admission.depths())
+        for name in (
+            "service.requests", "service.dedup_hits", "service.replay_hits",
+            "service.admitted", "service.batches", "service.streamed_issues",
+            "service.request_errors", "service.probe_wins",
+            "service.device_wins", "service.probe_runs",
+        ):
+            out[name] = reg.counter(name, persistent=True).snapshot()
+        return out
+
+    # -- worker (single thread owns the engine) ------------------------
+
+    def _worker_loop(self) -> None:
+        if self.config.warmup:
+            self._warmup()
+        self._warm_ready.set()
+        cfg = self.config
+        while True:
+            if not self.admission.wait_for_pending(timeout=0.1):
+                if self._stop.is_set():
+                    return
+                continue
+            # admission window: give compatible arrivals a moment to pile
+            # into the same wide segment batch — unless an interactive
+            # request is waiting (TTFE beats width) or we are draining
+            deadline = time.perf_counter() + cfg.batch_window_s
+            while (
+                time.perf_counter() < deadline
+                and not self._draining
+                and not self._stop.is_set()
+                and not self.admission.has_interactive_pending()
+            ):
+                time.sleep(min(0.005, cfg.batch_window_s))
+            batch = self.admission.next_batch(cfg.max_batch_width)
+            if not batch:
+                continue
+            try:
+                self._run_batch(batch)
+            except Exception as exc:  # never kill the worker
+                log.exception("service batch failed")
+                for flight in batch:
+                    if not flight.finished:
+                        flight.emit("error", f"batch failure: {exc!r}")
+                        self._c_errors.inc()
+                    self.admission.finish(flight)
+
+    def _scope_reset(self) -> None:
+        from mythril_tpu.facade.warm import reset_analysis_scope
+
+        reset_analysis_scope()
+
+    def _make_sink(
+        self,
+        by_hash: Dict[str, Flight],
+        streamed: Dict[Tuple, Set[Tuple]],
+        source: str,
+        lock: threading.Lock,
+    ):
+        """Issue-sink closure attributing confirmations to flights.
+
+        Runs on whatever thread confirms the issue (worker, harvest
+        replay workers), hence the explicit lock around the check-then-
+        add on the per-flight streamed-digest sets.
+        """
+        provisional = source == "probe"
+
+        def _sink(issues) -> None:
+            for issue in issues:
+                flight = by_hash.get(issue.bytecode_hash)
+                if flight is None:
+                    continue
+                digest = issue_digest(issue)
+                with lock:
+                    if digest in streamed[flight.key]:
+                        continue
+                    streamed[flight.key].add(digest)
+                wire = _issue_to_wire(issue)
+                if provisional:
+                    wire["provisional"] = True
+                flight.emit("issue", wire, source=source)
+                self._c_streamed.inc()
+
+        return _sink
+
+    def _run_batch(self, batch: List[Flight]) -> None:
+        from mythril_tpu.analysis.cooperative import run_cooperative_batch
+        from mythril_tpu.analysis.module.base import set_issue_sink
+
+        t0 = time.perf_counter()
+        self._c_batches.inc()
+        self._h_width.observe(float(len(batch)))
+        by_hash = {f.codehash: f for f in batch}
+        streamed: Dict[Tuple, Set[Tuple]] = {f.key: set() for f in batch}
+        sink_lock = threading.Lock()
+        request_ids = [f.requests[0].request_id for f in batch]
+        opts: AnalysisOptions = batch[0].options
+
+        with _otrace.span(
+            "service.batch", cat="service", width=len(batch),
+            requests=",".join(request_ids),
+        ):
+            self._scope_reset()
+            if self.config.probe:
+                for flight in batch:
+                    if flight.interactive and not flight.finished:
+                        self._probe(flight, by_hash, streamed, sink_lock)
+                # the probe ran detectors: sweep their issue lists and
+                # (address, codehash) caches so the authoritative batch
+                # re-detects everything it would have found solo
+                self._scope_reset()
+
+            prev_sink = set_issue_sink(
+                self._make_sink(by_hash, streamed, "device", sink_lock)
+            )
+            try:
+                issues_by_name, errors_by_name, _states = run_cooperative_batch(
+                    [(f.codehash, f.requests[0].code) for f in batch],
+                    transaction_count=opts.transaction_count,
+                    modules=list(opts.modules) if opts.modules else None,
+                    strategy=opts.strategy,
+                    execution_timeout=opts.execution_timeout,
+                    isolate_errors=True,
+                    request_tags=request_ids,
+                )
+            finally:
+                set_issue_sink(prev_sink)
+
+        elapsed = time.perf_counter() - t0
+        for flight in batch:
+            if flight.codehash in errors_by_name:
+                flight.emit("error", errors_by_name[flight.codehash])
+                self._c_errors.inc()
+                self.admission.finish(flight)
+                continue
+            wires = [
+                _issue_to_wire(i)
+                for i in issues_by_name.get(flight.codehash, [])
+            ]
+            # stream anything end-of-batch collection found that the sink
+            # did not see mid-run (POST modules, late confirmations)
+            for wire in wires:
+                digest = issue_digest(wire)
+                with sink_lock:
+                    fresh = digest not in streamed[flight.key]
+                    if fresh:
+                        streamed[flight.key].add(digest)
+                if fresh:
+                    flight.emit("issue", wire, source="device")
+                    self._c_streamed.inc()
+            if flight.interactive and flight.first_issue_source is not None:
+                (self._c_probe_wins if flight.first_issue_source == "probe"
+                 else self._c_device_wins).inc()
+            flight.emit("done", {
+                "codehash": flight.codehash,
+                "issues": wires,
+                "elapsed_s": round(elapsed, 3),
+                "batch_width": len(batch),
+            })
+            self.admission.finish(flight)
+        log.info(
+            "service batch of %d done in %.2fs (%d errored)",
+            len(batch), elapsed, len(errors_by_name),
+        )
+
+    def _probe(
+        self,
+        flight: Flight,
+        by_hash: Dict[str, Flight],
+        streamed: Dict[Tuple, Set[Tuple]],
+        sink_lock: threading.Lock,
+    ) -> None:
+        """Bounded host-first 1-tx pre-analysis for an interactive flight.
+
+        Runs with the frontier off and the host probe backend, so first
+        evidence never waits on a cold XLA bucket compile.  Findings are
+        provisional (1-tx is a subset of the authoritative run); the
+        per-flight streamed-digest set spans probe AND batch, so a
+        confirmed probe finding is not re-streamed by the device pass.
+        """
+        from mythril_tpu.analysis.cooperative import run_cooperative_batch
+        from mythril_tpu.analysis.module.base import set_issue_sink
+        from mythril_tpu.support.support_args import args
+
+        self._c_probe_runs.inc()
+        opts = flight.options
+        saved = (args.frontier, args.probe_backend)
+        prev_sink = set_issue_sink(
+            self._make_sink(by_hash, streamed, "probe", sink_lock)
+        )
+        args.frontier = False
+        args.probe_backend = "host"
+        t0 = time.perf_counter()
+        try:
+            with _otrace.span(
+                "service.probe", cat="service",
+                request=flight.requests[0].request_id,
+            ):
+                run_cooperative_batch(
+                    [(flight.codehash, flight.requests[0].code)],
+                    transaction_count=1,
+                    modules=list(opts.modules) if opts.modules else None,
+                    strategy=opts.strategy,
+                    execution_timeout=min(
+                        self.config.probe_timeout_s, opts.execution_timeout
+                    ),
+                    isolate_errors=True,
+                )
+        except Exception:
+            log.exception("interactive probe failed; batch continues")
+        finally:
+            args.frontier, args.probe_backend = saved
+            set_issue_sink(prev_sink)
+        get_registry().histogram("service.probe_s", persistent=True).observe(
+            time.perf_counter() - t0
+        )
+
+
+def _issue_to_wire(issue) -> Dict[str, Any]:
+    """JSON-safe wire form of one finding (digest-complete + context)."""
+    return {
+        "contract": issue.contract,
+        "function": issue.function,
+        "address": issue.address,
+        "swc_id": issue.swc_id,
+        "title": issue.title,
+        "severity": issue.severity,
+        "description_head": issue.description_head,
+        "bytecode_hash": issue.bytecode_hash,
+        "discovery_time": round(issue.discovery_time, 3),
+    }

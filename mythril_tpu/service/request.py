@@ -1,0 +1,136 @@
+"""Request and result-stream primitives for the analysis service.
+
+A submission produces a ``ResultStream``: a per-subscriber queue of
+events the owner (the daemon worker, via the flight it rides) pushes as
+the analysis progresses.  Duplicate submitters each get their OWN
+stream; the flight replays already-emitted events into a late
+subscriber's queue before attaching it live, so every subscriber
+observes the same sequence — replay first, then live, issues strictly
+before the terminal event.
+
+Events are ``(kind, payload)`` with kind one of ``"issue"`` (one wire
+dict, streamed the moment the finding confirms), ``"done"`` (payload:
+summary dict with the authoritative ``issues`` list) or ``"error"``
+(payload: one-line reason).  ``done``/``error`` are terminal and emitted
+exactly once per stream.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from mythril_tpu.observability.metrics import get_registry
+
+__all__ = ["AnalysisOptions", "AnalysisRequest", "ResultStream"]
+
+TIER_BATCH = "batch"
+TIER_INTERACTIVE = "interactive"
+
+
+@dataclass(frozen=True)
+class AnalysisOptions:
+    """The per-request options that can change the issue set."""
+
+    transaction_count: int = 2
+    modules: Optional[Tuple[str, ...]] = None
+    strategy: str = "bfs"
+    execution_timeout: int = 60
+
+    def key(self) -> Tuple:
+        from mythril_tpu.service.codehash import options_key
+
+        return options_key(
+            self.transaction_count,
+            self.modules,
+            self.strategy,
+            self.execution_timeout,
+        )
+
+
+@dataclass
+class AnalysisRequest:
+    request_id: str
+    name: str
+    code: bytes
+    codehash: str
+    options: AnalysisOptions
+    tier: str = TIER_BATCH
+    submitted_at: float = field(default_factory=time.time)
+
+    @property
+    def interactive(self) -> bool:
+        return self.tier == TIER_INTERACTIVE
+
+
+class ResultStream:
+    """One subscriber's ordered view of a flight's events.
+
+    Producer side (flight, under its lock): ``push``.  Consumer side
+    (client handler thread): ``events()`` / ``issues()`` — both block
+    until the terminal event.  The stream also owns the service-level
+    TTFE sample: the clock starts at subscription, so a dedup subscriber
+    replayed a finished flight legitimately records a near-zero TTFE —
+    that IS the time-to-first-evidence the service delivered.
+    """
+
+    _DONE_KINDS = ("done", "error")
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self.created_at = time.time()
+        self.first_issue_at: Optional[float] = None
+        self._q: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
+        self._closed = False  # producer-side; guarded by the flight lock
+
+    # -- producer ------------------------------------------------------
+
+    def push(self, kind: str, payload: Any) -> None:
+        if self._closed:
+            return
+        if kind == "issue" and self.first_issue_at is None:
+            self.first_issue_at = time.time()
+            get_registry().histogram("service.ttfe_s", persistent=True).observe(
+                self.first_issue_at - self.created_at
+            )
+        if kind in self._DONE_KINDS:
+            self._closed = True
+        self._q.put((kind, payload))
+
+    # -- consumer ------------------------------------------------------
+
+    def events(self, timeout: Optional[float] = None) -> Iterator[Tuple[str, Any]]:
+        """Yield events until (and including) the terminal one.
+
+        ``timeout`` bounds the wait for EACH event; expiry raises
+        ``queue.Empty`` (a stuck daemon must not hang clients forever).
+        """
+        while True:
+            kind, payload = self._q.get(timeout=timeout)
+            yield kind, payload
+            if kind in self._DONE_KINDS:
+                return
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Drain the stream; return the ``done`` summary.
+
+        Raises ``RuntimeError`` on an ``error`` event (per-tenant
+        isolation surfaces here: only this request's submitter sees it).
+        """
+        streamed: List[Dict[str, Any]] = []
+        for kind, payload in self.events(timeout=timeout):
+            if kind == "issue":
+                streamed.append(payload)
+            elif kind == "error":
+                raise RuntimeError(f"analysis failed: {payload}")
+            else:
+                summary = dict(payload)
+                summary["streamed"] = streamed
+                return summary
+        raise RuntimeError("stream ended without terminal event")
+
+    def issues(self, timeout: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Authoritative issue dicts from the ``done`` summary."""
+        return self.result(timeout=timeout)["issues"]

@@ -1,0 +1,205 @@
+"""JSON-lines TCP front end for the analysis service (``myth serve``).
+
+Deliberately thin: one request line in, a stream of event lines out —
+the protocol mirrors the in-process ``ResultStream`` one-to-one so the
+daemon, not the transport, owns ordering and isolation.
+
+Protocol (UTF-8, one JSON object per line):
+
+    -> {"op": "submit", "code": "<hex>", "name": "...", "tier": "batch"}
+    <- {"event": "accepted", "request_id": "...", "deduped": false}
+    <- {"event": "issue", "swc_id": "106", ...}          (0..n, as they confirm)
+    <- {"event": "done", "issues": [...], "elapsed_s": 1.2}
+  or <- {"event": "error", "error": "..."}
+
+    -> {"op": "ping"}    <- {"event": "pong"}
+    -> {"op": "stats"}   <- {"event": "stats", ...counters...}
+
+``run_server`` installs SIGTERM/SIGINT handlers that stop accepting,
+drain every in-flight request (subscribers still receive their streamed
+issues and terminal events), then exit — the graceful-shutdown contract
+a deployment's rolling restart relies on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import socket
+import socketserver
+import threading
+from typing import Optional, Tuple
+
+from mythril_tpu.service.daemon import AnalysisService, ServiceConfig
+from mythril_tpu.service.request import AnalysisOptions
+
+log = logging.getLogger(__name__)
+
+__all__ = ["AnalysisServer", "run_server"]
+
+#: bound on one request line (code is hex: 2 chars/byte; EVM contracts
+#: cap at 24KiB runtime, so 1MiB is generous headroom for options)
+MAX_LINE = 1 << 20
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        service: AnalysisService = self.server.service  # type: ignore[attr-defined]
+        try:
+            line = self.rfile.readline(MAX_LINE)
+            if not line:
+                return
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                self._send({"event": "error", "error": "malformed JSON"})
+                return
+            op = msg.get("op")
+            if op == "ping":
+                self._send({"event": "pong"})
+            elif op == "stats":
+                self._send({"event": "stats", **service.stats()})
+            elif op == "submit":
+                self._submit(service, msg)
+            else:
+                self._send({"event": "error", "error": f"unknown op {op!r}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; the flight finishes for other subscribers
+
+    def _submit(self, service: AnalysisService, msg: dict) -> None:
+        try:
+            options = None
+            if any(k in msg for k in (
+                "transaction_count", "modules", "strategy",
+                "execution_timeout",
+            )):
+                base = service.config.default_options
+                options = AnalysisOptions(
+                    transaction_count=int(
+                        msg.get("transaction_count", base.transaction_count)
+                    ),
+                    modules=tuple(msg["modules"]) if msg.get("modules")
+                    else base.modules,
+                    strategy=msg.get("strategy", base.strategy),
+                    execution_timeout=int(
+                        msg.get("execution_timeout", base.execution_timeout)
+                    ),
+                )
+            request, stream, deduped = service.submit(
+                msg.get("code", ""),
+                name=msg.get("name"),
+                tier=msg.get("tier", "batch"),
+                options=options,
+            )
+        except (ValueError, RuntimeError) as exc:
+            self._send({"event": "error", "error": str(exc)})
+            return
+        self._send({
+            "event": "accepted",
+            "request_id": request.request_id,
+            "codehash": request.codehash,
+            "deduped": deduped,
+        })
+        for kind, payload in stream.events():
+            if kind == "issue":
+                self._send({"event": "issue", **payload})
+            elif kind == "error":
+                self._send({"event": "error", "error": payload})
+            else:
+                self._send({"event": "done", **payload})
+
+    def _send(self, obj: dict) -> None:
+        self.wfile.write((json.dumps(obj) + "\n").encode())
+        self.wfile.flush()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True  # handler threads must not block process exit
+
+
+class AnalysisServer:
+    """Socket server + service lifecycle, embeddable in tests."""
+
+    def __init__(
+        self,
+        config: Optional[ServiceConfig] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.service = AnalysisService(config)
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.service = self.service  # type: ignore[attr-defined]
+        self._serve_thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._tcp.server_address[:2]
+
+    def start(self) -> "AnalysisServer":
+        self.service.start()
+        self._serve_thread = threading.Thread(
+            target=self._tcp.serve_forever, name="service-accept", daemon=True
+        )
+        self._serve_thread.start()
+        log.info("analysis service listening on %s:%d", *self.address)
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
+        """Stop accepting, drain in-flight work, close the socket."""
+        drained = self.service.stop(drain=drain, timeout=timeout)
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        t = self._serve_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        self._serve_thread = None
+        return drained
+
+
+def run_server(
+    config: Optional[ServiceConfig] = None,
+    host: str = "127.0.0.1",
+    port: int = 7344,
+    drain_timeout: Optional[float] = None,
+) -> int:
+    """Blocking entry point for ``myth serve``; returns an exit code.
+
+    SIGTERM/SIGINT trigger a graceful drain: no new submissions, every
+    in-flight flight runs to its terminal event, then the socket closes.
+    """
+    server = AnalysisServer(config, host=host, port=port).start()
+    stop = threading.Event()
+
+    def _on_signal(signum, _frame):
+        log.info("signal %d: draining analysis service", signum)
+        stop.set()
+
+    prev = {
+        sig: signal.signal(sig, _on_signal)
+        for sig in (signal.SIGTERM, signal.SIGINT)
+    }
+    try:
+        print(f"analysis service listening on {server.address[0]}:"
+              f"{server.address[1]}", flush=True)
+        stop.wait()
+        drained = server.stop(drain=True, timeout=drain_timeout)
+        return 0 if drained else 1
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+
+
+def wait_for_server(host: str, port: int, timeout: float = 30.0) -> bool:
+    """Poll until the server accepts connections (CI smoke helper)."""
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        except OSError:
+            _time.sleep(0.1)
+    return False

@@ -100,6 +100,11 @@ class Args:
     # MYTHRIL_TPU_COMPILATION_CACHE env var disables with 0/off or
     # relocates with a path)
     compile_cache_dir: Optional[str] = None
+    # one directory pinning BOTH persistent caches for service
+    # deployments: query cache under <root>/querycache, XLA compile
+    # cache under <root>/xla (facade/warm.resolve_cache_root); explicit
+    # per-cache dirs win over the derivation
+    cache_root: Optional[str] = None
     # flight deck (mythril_tpu/observability): heartbeat JSONL of sampled
     # queue depths, sampler period, flight-recorder bundle directory, and
     # the watchdog deadline (seconds without a completed segment before a

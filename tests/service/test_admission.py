@@ -1,0 +1,176 @@
+"""Admission control invariants: dedup, options grouping, interactive
+priority, result replay, error non-caching — all exercised without
+running any analysis (requests carry synthetic codehashes)."""
+
+import pytest
+
+from mythril_tpu.service.admission import AdmissionController
+from mythril_tpu.service.request import AnalysisOptions, AnalysisRequest
+
+OPTS = AnalysisOptions(transaction_count=1)
+OPTS_3TX = AnalysisOptions(transaction_count=3)
+
+
+def _req(rid, codehash="0x" + "ab" * 32, options=OPTS, tier="batch"):
+    return AnalysisRequest(
+        request_id=rid,
+        name=rid,
+        code=b"\x00",
+        codehash=codehash,
+        options=options,
+        tier=tier,
+    )
+
+
+@pytest.fixture
+def ctl():
+    return AdmissionController(result_cache_size=4)
+
+
+def test_first_submission_is_not_deduped(ctl):
+    _stream, deduped = ctl.submit(_req("r1"))
+    assert deduped is False
+    assert ctl.depths()["service.queue_depth"] == 1
+
+
+def test_duplicate_subscribes_to_pending_flight(ctl):
+    ctl.submit(_req("r1"))
+    _stream, deduped = ctl.submit(_req("r2"))
+    assert deduped is True
+    # one flight, two subscribers — not two queue entries
+    assert ctl.depths()["service.queue_depth"] == 1
+    [flight] = ctl.next_batch(max_width=4)
+    assert [r.request_id for r in flight.requests] == ["r1", "r2"]
+
+
+def test_duplicate_subscribes_to_running_flight(ctl):
+    ctl.submit(_req("r1"))
+    [flight] = ctl.next_batch(max_width=4)
+    _stream, deduped = ctl.submit(_req("r2"))
+    assert deduped is True
+    assert ctl.depths() == {
+        "service.queue_depth": 0,
+        "service.inflight": 1,
+        "service.result_cache": 0,
+    }
+    assert flight.requests[-1].request_id == "r2"
+
+
+def test_same_code_different_options_is_a_new_flight(ctl):
+    ctl.submit(_req("r1", options=OPTS))
+    _stream, deduped = ctl.submit(_req("r2", options=OPTS_3TX))
+    assert deduped is False
+    assert ctl.depths()["service.queue_depth"] == 2
+
+
+def test_next_batch_groups_one_options_key(ctl):
+    ctl.submit(_req("r1", codehash="0x" + "01" * 32, options=OPTS))
+    ctl.submit(_req("r2", codehash="0x" + "02" * 32, options=OPTS_3TX))
+    ctl.submit(_req("r3", codehash="0x" + "03" * 32, options=OPTS))
+    batch = ctl.next_batch(max_width=4)
+    # anchor r1 (oldest) pulls r3 (same options); r2 stays pending
+    assert [f.requests[0].request_id for f in batch] == ["r1", "r3"]
+    assert ctl.depths()["service.queue_depth"] == 1
+    assert [f.requests[0].request_id for f in ctl.next_batch(4)] == ["r2"]
+
+
+def test_next_batch_respects_max_width(ctl):
+    for i in range(5):
+        ctl.submit(_req(f"r{i}", codehash=f"0x{i:064x}"))
+    assert len(ctl.next_batch(max_width=3)) == 3
+    assert len(ctl.next_batch(max_width=3)) == 2
+
+
+def test_interactive_anchor_jumps_the_queue(ctl):
+    ctl.submit(_req("r1", codehash="0x" + "01" * 32, options=OPTS))
+    ctl.submit(
+        _req("r2", codehash="0x" + "02" * 32, options=OPTS_3TX,
+             tier="interactive")
+    )
+    assert ctl.has_interactive_pending()
+    batch = ctl.next_batch(max_width=4)
+    # the interactive flight anchors the batch even though r1 is older,
+    # and r1 (different options) cannot ride along
+    assert [f.requests[0].request_id for f in batch] == ["r2"]
+    assert not ctl.has_interactive_pending()
+
+
+def test_interactive_duplicate_upgrades_flight_tier(ctl):
+    ctl.submit(_req("r1"))
+    ctl.submit(_req("r2", tier="interactive"))
+    assert ctl.has_interactive_pending()
+
+
+def test_done_result_is_replayed_from_cache(ctl):
+    stream, _ = ctl.submit(_req("r1"))
+    [flight] = ctl.next_batch(max_width=1)
+    flight.emit("issue", {"swc_id": "106"})
+    flight.emit("done", {"issues": [{"swc_id": "106"}]})
+    ctl.finish(flight)
+    assert ctl.depths()["service.result_cache"] == 1
+
+    replay, deduped = ctl.submit(_req("r2"))
+    assert deduped is True
+    events = list(replay.events(timeout=1))
+    assert [k for k, _ in events] == ["issue", "done"]
+    # replay never enqueues new work
+    assert ctl.depths()["service.queue_depth"] == 0
+
+
+def test_error_results_are_not_cached(ctl):
+    ctl.submit(_req("r1"))
+    [flight] = ctl.next_batch(max_width=1)
+    flight.emit("error", "solver exploded")
+    ctl.finish(flight)
+    assert ctl.depths()["service.result_cache"] == 0
+    # the same contract re-analyzes instead of replaying the failure
+    _stream, deduped = ctl.submit(_req("r2"))
+    assert deduped is False
+
+
+def test_result_cache_is_bounded_lru(ctl):
+    for i in range(6):  # cache size is 4
+        ctl.submit(_req(f"r{i}", codehash=f"0x{i:064x}"))
+        [flight] = ctl.next_batch(max_width=1)
+        flight.emit("done", {"issues": []})
+        ctl.finish(flight)
+    assert ctl.depths()["service.result_cache"] == 4
+    # oldest entries evicted: hash 0 re-analyzes, hash 5 replays
+    assert ctl.submit(_req("x0", codehash=f"0x{0:064x}"))[1] is False
+    assert ctl.submit(_req("x5", codehash=f"0x{5:064x}"))[1] is True
+
+
+def test_drain_wait(ctl):
+    assert ctl.drain_wait(timeout=0.1) is True
+    ctl.submit(_req("r1"))
+    assert ctl.drain_wait(timeout=0.1) is False
+    [flight] = ctl.next_batch(max_width=1)
+    assert ctl.drain_wait(timeout=0.1) is False
+    flight.emit("done", {"issues": []})
+    ctl.finish(flight)
+    assert ctl.drain_wait(timeout=0.1) is True
+
+
+def test_dedup_counters_increment():
+    from mythril_tpu.observability.metrics import get_registry
+
+    reg = get_registry()
+    before_dedup = reg.counter("service.dedup_hits", persistent=True).snapshot()
+    before_replay = reg.counter("service.replay_hits", persistent=True).snapshot()
+
+    ctl = AdmissionController()
+    ctl.submit(_req("r1"))
+    ctl.submit(_req("r2"))  # in-flight dedup
+    [flight] = ctl.next_batch(max_width=1)
+    flight.emit("done", {"issues": []})
+    ctl.finish(flight)
+    ctl.submit(_req("r3"))  # replay dedup
+
+    assert (
+        reg.counter("service.dedup_hits", persistent=True).snapshot()
+        - before_dedup
+    ) == 2
+    assert (
+        reg.counter("service.replay_hits", persistent=True).snapshot()
+        - before_replay
+    ) == 1

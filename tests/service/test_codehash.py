@@ -1,0 +1,109 @@
+"""Canonical request identity: normalization, codehash, options key,
+issue digest — the units admission dedup and the determinism check
+stand on."""
+
+import pytest
+
+from mythril_tpu.service.codehash import (
+    canonical_codehash,
+    issue_digest,
+    normalize_code,
+    options_key,
+)
+
+CODE = bytes.fromhex("6080604052")
+
+
+def test_normalize_bytes_passthrough():
+    assert normalize_code(CODE) == CODE
+    assert normalize_code(bytearray(CODE)) == CODE
+
+
+def test_normalize_hex_presentation_variants():
+    # 0x prefix, casing and whitespace are presentation, not identity
+    for text in (
+        "6080604052",
+        "0x6080604052",
+        "0X6080604052",
+        "60 80 60\n40 52",
+        "0x6080604052".upper(),
+    ):
+        assert normalize_code(text) == CODE, text
+
+
+@pytest.mark.parametrize(
+    "bad", ["zz80", "0x608", "", "0x", None, 12345, b""]
+)
+def test_normalize_rejects_non_hex_and_empty(bad):
+    with pytest.raises(ValueError):
+        normalize_code(bad)
+
+
+def test_canonical_codehash_invariant_under_presentation():
+    hashes = {
+        canonical_codehash(CODE),
+        canonical_codehash("6080604052"),
+        canonical_codehash("0x60806040 52"),
+        canonical_codehash("0x6080604052".upper()),
+    }
+    assert len(hashes) == 1
+    h = hashes.pop()
+    assert h.startswith("0x") and len(h) == 66
+
+
+def test_canonical_codehash_matches_issue_attribution():
+    # must agree with get_code_hash: the daemon groups issues by
+    # Issue.bytecode_hash and looks flights up by canonical codehash
+    from mythril_tpu.support.support_utils import get_code_hash
+
+    assert canonical_codehash(CODE) == get_code_hash(CODE)
+
+
+def test_options_key_sorts_modules():
+    a = options_key(2, ["TxOrigin", "EtherThief"], "bfs", 60)
+    b = options_key(2, ["EtherThief", "TxOrigin"], "bfs", 60)
+    assert a == b
+
+
+def test_options_key_distinguishes_result_changing_options():
+    base = options_key(2, None, "bfs", 60)
+    assert options_key(3, None, "bfs", 60) != base
+    assert options_key(2, ["TxOrigin"], "bfs", 60) != base
+    assert options_key(2, None, "dfs", 60) != base
+    assert options_key(2, None, "bfs", 30) != base
+
+
+def test_options_key_empty_modules_is_default():
+    # empty selection means "all modules", same as None
+    assert options_key(2, [], "bfs", 60) == options_key(2, None, "bfs", 60)
+
+
+def test_issue_digest_dict_and_object_agree():
+    class _Issue:
+        swc_id = "106"
+        address = 132
+        bytecode_hash = "0xabc"
+        title = "Unprotected Selfdestruct"
+        function = "kill()"
+
+    wire = {
+        "swc_id": "106",
+        "address": 132,
+        "bytecode_hash": "0xabc",
+        "title": "Unprotected Selfdestruct",
+        "function": "kill()",
+        # wire-only presentation fields must not affect the digest
+        "description_head": "Any sender can kill this contract.",
+        "severity": "High",
+    }
+    assert issue_digest(_Issue()) == issue_digest(wire)
+
+
+def test_analysis_options_key_delegates():
+    from mythril_tpu.service.request import AnalysisOptions
+
+    opts = AnalysisOptions(
+        transaction_count=2, modules=("B", "A"), strategy="bfs",
+        execution_timeout=60,
+    )
+    assert opts.key() == options_key(2, ["A", "B"], "bfs", 60)

@@ -1,0 +1,126 @@
+"""Streaming-order contract: replay-then-live, issues strictly before
+the terminal event, identical sequences for early and late
+subscribers."""
+
+import queue
+import threading
+
+import pytest
+
+from mythril_tpu.service.admission import Flight
+from mythril_tpu.service.request import (
+    AnalysisOptions,
+    AnalysisRequest,
+    ResultStream,
+)
+
+
+def _req(rid, tier="batch"):
+    return AnalysisRequest(
+        request_id=rid,
+        name=rid,
+        code=b"\x00",
+        codehash="0x" + "ab" * 32,
+        options=AnalysisOptions(),
+        tier=tier,
+    )
+
+
+def _flight(request=None):
+    request = request or _req("r1")
+    return Flight((request.codehash, request.options.key()), request)
+
+
+def test_events_end_at_terminal():
+    flight = _flight()
+    stream = flight.subscribe(_req("r2"))
+    flight.emit("issue", {"swc_id": "106"})
+    flight.emit("done", {"issues": []})
+    assert [k for k, _ in stream.events(timeout=1)] == ["issue", "done"]
+
+
+def test_late_subscriber_sees_replay_then_live_in_order():
+    flight = _flight()
+    early = flight.subscribe(_req("r2"))
+    flight.emit("issue", {"swc_id": "106", "n": 1})
+    flight.emit("issue", {"swc_id": "107", "n": 2})
+    late = flight.subscribe(_req("r3"))  # two events already emitted
+    flight.emit("issue", {"swc_id": "101", "n": 3})
+    flight.emit("done", {"issues": []})
+
+    early_events = list(early.events(timeout=1))
+    late_events = list(late.events(timeout=1))
+    # the late subscriber sees EXACTLY what the early one did: replayed
+    # history first, then live events, no loss or duplication at the seam
+    assert late_events == early_events
+    assert [p.get("n") for k, p in late_events if k == "issue"] == [1, 2, 3]
+
+
+def test_issues_arrive_strictly_before_done():
+    flight = _flight()
+    stream = flight.subscribe(_req("r2"))
+    flight.emit("issue", {"swc_id": "106"})
+    flight.emit("done", {"issues": [{"swc_id": "106"}]})
+    kinds = [k for k, _ in stream.events(timeout=1)]
+    assert kinds[-1] == "done" and set(kinds[:-1]) == {"issue"}
+
+
+def test_emit_after_terminal_is_dropped():
+    flight = _flight()
+    stream = flight.subscribe(_req("r2"))
+    flight.emit("done", {"issues": []})
+    assert flight.finished
+    flight.emit("issue", {"swc_id": "999"})  # late straggler: no-op
+    flight.emit("error", "too late")
+    assert [k for k, _ in stream.events(timeout=1)] == ["done"]
+
+
+def test_result_collects_streamed_and_raises_on_error():
+    ok = _flight()
+    stream = ok.subscribe(_req("r2"))
+    ok.emit("issue", {"swc_id": "106"})
+    ok.emit("done", {"issues": [{"swc_id": "106"}]})
+    summary = stream.result(timeout=1)
+    assert summary["issues"] == [{"swc_id": "106"}]
+    assert summary["streamed"] == [{"swc_id": "106"}]
+
+    bad = _flight()
+    stream = bad.subscribe(_req("r3"))
+    bad.emit("error", "solver exploded")
+    with pytest.raises(RuntimeError, match="solver exploded"):
+        stream.result(timeout=1)
+
+
+def test_events_timeout_raises_instead_of_hanging():
+    stream = ResultStream("r1")
+    with pytest.raises(queue.Empty):
+        next(stream.events(timeout=0.05))
+
+
+def test_first_issue_source_attribution():
+    flight = _flight(_req("r1", tier="interactive"))
+    flight.emit("issue", {"swc_id": "106"}, source="probe")
+    flight.emit("issue", {"swc_id": "107"}, source="device")
+    assert flight.first_issue_source == "probe"
+
+
+def test_concurrent_emit_and_subscribe_never_loses_events():
+    flight = _flight()
+    streams = []
+
+    def _subscribe_loop():
+        for i in range(20):
+            streams.append(flight.subscribe(_req(f"s{i}")))
+
+    t = threading.Thread(target=_subscribe_loop)
+    t.start()
+    for i in range(50):
+        flight.emit("issue", {"n": i})
+    flight.emit("done", {"issues": []})
+    t.join(timeout=5)
+
+    for stream in streams:
+        events = list(stream.events(timeout=1))
+        ns = [p["n"] for k, p in events if k == "issue"]
+        # each subscriber sees a gap-free ordered suffix ending in done
+        assert ns == list(range(50)) and events[-1][0] == "done"
