@@ -35,6 +35,7 @@ latest snapshot is also mirrored to ``BENCH_partial.json``.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -1260,6 +1261,18 @@ def serve_load(clients: int = 8) -> dict:
     # -- warm service under concurrent traffic ---------------------------
     _clear_caches()
     reset_analysis_scope()
+    # request-scoped telemetry rides the measured window with the tracer
+    # ON: the determinism assertion below then doubles as proof that
+    # per-request span trees and phase accounting never perturb findings
+    from mythril_tpu.observability.tracer import get_tracer
+    from mythril_tpu.service.telemetry import PHASES as _SERVICE_PHASES
+
+    reg = get_registry()
+    for _p in _SERVICE_PHASES:
+        reg.histogram(f"service.{_p}_s", persistent=True).reset()
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.enabled = True
     service = AnalysisService(ServiceConfig(
         default_options=opts,
         max_batch_width=max(clients, 1),
@@ -1268,6 +1281,17 @@ def serve_load(clients: int = 8) -> dict:
         probe=True,
         warmup=True,
     )).start()
+    # validation hook for the phase gate: an injected admission-side
+    # sleep must blow the queue-wait percentiles past --against
+    inject_s = float(os.environ.get("BENCH_INJECT_ADMISSION_SLEEP", "0") or 0)
+    if inject_s > 0:
+        _real_submit = service.admission.submit
+
+        def _slow_submit(request):
+            time.sleep(inject_s)
+            return _real_submit(request)
+
+        service.admission.submit = _slow_submit
     # warmup is startup cost, not steady-state throughput: the timed
     # window starts from a warm process (the daemon's operating point)
     service.wait_warm(timeout=120)
@@ -1276,7 +1300,8 @@ def serve_load(clients: int = 8) -> dict:
 
     def _submit(client: str, cname: str, code: bytes, tier: str) -> None:
         t0 = time.perf_counter()
-        _req, stream, deduped = service.submit(code, name=client, tier=tier)
+        _req, stream, deduped = service.submit(code, name=client, tier=tier,
+                                               tenant=client)
         first_issue = None
         issues = None
         for kind, payload in stream.events(timeout=600):
@@ -1308,6 +1333,11 @@ def serve_load(clients: int = 8) -> dict:
         t.join(timeout=900)
     warm_wall = time.perf_counter() - t_warm
     drained = service.stop(drain=True, timeout=60)
+    tracer.enabled = False
+    request_span_count = sum(
+        1 for s in tracer.spans() if s["name"] == "service.request"
+    )
+    tracer.reset()
 
     # -- the three production claims ------------------------------------
     assert len(per_request) == clients, (
@@ -1344,6 +1374,20 @@ def serve_load(clients: int = 8) -> dict:
             ),
         },
     }
+    # per-phase service latency percentiles (queue-wait/execute/stream
+    # decomposition from the request telemetry plane) — the --against
+    # gate asserts these, so an admission or streaming regression fails
+    # CI like a production-rate regression does
+    phase_row = {}
+    for _p in _SERVICE_PHASES:
+        h = reg.histogram(f"service.{_p}_s", persistent=True)
+        if h.count:
+            phase_row[_p] = {
+                "count": h.count,
+                "p50": round(h.percentile(0.50), 4),
+                "p95": round(h.percentile(0.95), 4),
+            }
+    row["service_phase_s"] = phase_row
     passed = identical and dedup_hits > 0 and warm_rps > seq_rps and drained
     result = {
         "metric": "serve_load_requests_per_sec",
@@ -1356,6 +1400,7 @@ def serve_load(clients: int = 8) -> dict:
         "identical_issue_sets": identical,
         **({"mismatched_clients": mismatches} if mismatches else {}),
         "drained": drained,
+        "request_spans": request_span_count,
         "per_request": [
             {k: v for k, v in r.items() if k != "digests"}
             for r in sorted(per_request, key=lambda r: r["client"])
@@ -1610,6 +1655,7 @@ def _emit_snapshot(table: dict, budget_meta: dict, partial: bool) -> None:
 GATE_TOLERANCE = 0.35
 GATE_TTFE_SLACK_S = 2.0
 GATE_HARVEST_SLACK_PCT = 15.0  # absolute harvest-share points
+GATE_PHASE_SLACK_S = 0.75  # absolute slack on service phase p95s
 GATE_TRACING_BUDGET_PCT = 2.0  # tracing overhead must stay under 2% of wall
 # spans+flows+counters a fully-instrumented pipelined segment emits (dispatch,
 # chain_merge, segment, 4 harvest phases, replay/feasibility workers, 3-point
@@ -1822,6 +1868,25 @@ def regression_gate(
                 violations.append(
                     f"{name}: harvest_share_pct {ch:.1f} > {ceil:.1f} "
                     f"(prior {ph:.1f} + {GATE_HARVEST_SLACK_PCT:.0f}pt)"
+                )
+        # service latency decomposition: per-phase p95 (queue_wait /
+        # batch_wait / execute / stream from the serve-load row) must
+        # stay within the rate tolerance plus an absolute slack — an
+        # admission or streaming regression fails like a rate regression
+        p_phases = p.get("service_phase_s") or {}
+        c_phases = c.get("service_phase_s") or {}
+        for phase in sorted(set(p_phases) & set(c_phases)):
+            p95p = (p_phases.get(phase) or {}).get("p95")
+            p95c = (c_phases.get(phase) or {}).get("p95")
+            if p95p is None or p95c is None:
+                continue
+            checks += 1
+            ceil = p95p * (1.0 + tol) + GATE_PHASE_SLACK_S
+            if p95c > ceil:
+                violations.append(
+                    f"{name}: {phase} p95 {p95c:.3f}s > {ceil:.3f}s "
+                    f"(prior {p95p:.3f}s, tol {tol:.0%} + "
+                    f"{GATE_PHASE_SLACK_S:.2f}s)"
                 )
 
     overhead = _tracing_overhead_pct(_gate_span_rate(current_doc))

@@ -89,6 +89,7 @@ def run_cooperative_batch(
     caps=None,
     isolate_errors: bool = True,
     request_tags: Optional[Sequence[str]] = None,
+    request_flow_cb=None,
 ) -> Tuple[Dict[str, List], Dict[str, str], int]:
     """Lockstep-analyze ``jobs`` with per-job fault isolation.
 
@@ -103,6 +104,10 @@ def run_cooperative_batch(
     ``request_tags`` (parallel to ``jobs``) label this batch's frontier
     segments so a shared wide device segment is attributable to the requests
     riding it (``frontier.segment`` spans carry ``requests=...``).
+    ``request_flow_cb`` (a zero-arg callable, or None) is handed to the
+    frontier and invoked once inside the first segment span actually
+    dispatched — the service's trace-flow join point (see
+    ``frontier.engine.drain_lasers``).
     """
     from mythril_tpu.analysis.security import retrieve_callback_issues
     from mythril_tpu.analysis.symbolic import SymExecWrapper
@@ -202,6 +207,7 @@ def run_cooperative_batch(
                     [w.laser for _n, w in live], caps=caps,
                     bucket_floor=bucket_floor,
                     tags=request_tags,
+                    flow_cb=request_flow_cb,
                 )
             except Exception as e:  # graceful degradation, never lose a run
                 log.warning(

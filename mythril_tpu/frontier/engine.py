@@ -30,7 +30,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -295,6 +295,7 @@ def drain_lasers(
     caps: Optional[Caps] = None,
     bucket_floor: Optional[tuple] = None,
     tags: Optional[Sequence[str]] = None,
+    flow_cb: Optional[Callable[[], None]] = None,
 ) -> int:
     """Run eligible seeds from EVERY laser's work list as one multi-code
     frontier batch (the cooperative corpus entry point).  Parked paths land
@@ -307,7 +308,11 @@ def drain_lasers(
     code set shrinks (a smaller round must not trigger a fresh XLA compile
     mid-sweep).  ``tags`` (service request ids riding this batch) annotate
     every ``frontier.segment`` span so a shared wide device segment is
-    attributable to the requests it serves."""
+    attributable to the requests it serves.  ``flow_cb`` is invoked once,
+    inside the first ``frontier.segment`` span actually dispatched — the
+    service uses it to record per-request trace-flow endpoints there, so
+    request span trees join the segment that served them (and no arrow
+    dangles when a batch never reaches the device)."""
     groups: Dict[tuple, List[Tuple]] = {}
     for laser in lasers:
         if _is_concolic(laser):
@@ -328,6 +333,7 @@ def drain_lasers(
         engine = FrontierEngine(pairs[0][0], caps)
         if tags:
             engine.request_tags = tuple(tags)
+        engine.request_flow_cb = flow_cb
         executed += engine._drain_pairs(pairs, bucket_floor=bucket_floor)
     return executed
 
@@ -339,6 +345,19 @@ class FrontierEngine:
         # service request ids riding this engine's segments (set by
         # drain_lasers(tags=...)); stamped onto frontier.segment spans
         self.request_tags: Optional[tuple] = None
+        # one-shot callback fired inside the first segment span actually
+        # dispatched (drain_lasers(flow_cb=...)): the service records its
+        # per-request trace-flow endpoints there
+        self.request_flow_cb: Optional[Callable[[], None]] = None
+
+    def _fire_request_flows(self) -> None:
+        """Invoke the service's flow callback once, inside a segment span."""
+        cb, self.request_flow_cb = self.request_flow_cb, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # telemetry must never break a dispatch
+                log.debug("request flow callback failed", exc_info=True)
 
     # ------------------------------------------------------------------
 
@@ -981,6 +1000,7 @@ class FrontierEngine:
                 if _fid0 is not None:
                     _otrace.get_tracer().flow("s", _fid0, "flow.segment",
                                               cat="device")
+                self._fire_request_flows()
                 out_state, dev_arena, out_len, n_exec, seg_ml, nat_visited = (
                     nat_segment(push_state(st_nat), dev_arena, arena_len,
                                 nat_visited, nat_code_dev, cfg0)
@@ -1103,6 +1123,7 @@ class FrontierEngine:
                 if _fid is not None:
                     _otrace.get_tracer().flow("s", _fid, "flow.segment",
                                               cat="device")
+                self._fire_request_flows()
                 out_state, dev_arena, out_len, n_exec, seg_max_live, visited = (
                     segment(st_dev, dev_arena, arena_len, visited, code_dev, cfg)
                 )

@@ -400,6 +400,17 @@ def create_parser() -> argparse.ArgumentParser:
         "--heartbeat-interval", type=float, default=0.5, metavar="SECONDS",
         help="heartbeat sampling period (default 0.5s)",
     )
+    serve.add_argument(
+        "--request-log", metavar="FILE",
+        help="append one JSON line per terminal request event (ids, "
+        "tenant, phase decomposition, issue digests)",
+    )
+    serve.add_argument(
+        "--trace-out", metavar="FILE",
+        help="enable tracing for the daemon's lifetime and write a "
+        "Chrome-trace JSON on exit (request span trees flow-joined to "
+        "frontier segments)",
+    )
     _add_verbosity(serve)
 
     submit = subparsers.add_parser(
@@ -416,6 +427,10 @@ def create_parser() -> argparse.ArgumentParser:
         help="file containing hex-encoded runtime bytecode",
     )
     submit.add_argument("--name", help="request label")
+    submit.add_argument(
+        "--tenant", metavar="LABEL",
+        help="tenant label for per-tenant accounting in the daemon",
+    )
     submit.add_argument(
         "--tier", choices=["batch", "interactive"], default="batch",
         help="interactive jumps the admission queue and gets the "
@@ -438,6 +453,22 @@ def create_parser() -> argparse.ArgumentParser:
         help="output format",
     )
     _add_verbosity(submit)
+
+    top = subparsers.add_parser(
+        "top", help="live view of a running analysis service (in-flight "
+        "requests, phase latency percentiles, tenant totals)",
+    )
+    top.add_argument("--host", default="127.0.0.1", help="service host")
+    top.add_argument("--port", type=int, default=7344, help="service port")
+    top.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECONDS",
+        help="refresh period (default 2s)",
+    )
+    top.add_argument(
+        "--once", action="store_true",
+        help="render one snapshot and exit (no screen clearing)",
+    )
+    _add_verbosity(top)
 
     subparsers.add_parser("version", help="print version")
     subparsers.add_parser("help", help="print help")
@@ -752,6 +783,7 @@ def execute_command(parsed) -> None:
             warmup=parsed.warmup,
             heartbeat=True,
             heartbeat_interval_s=parsed.heartbeat_interval,
+            request_log=getattr(parsed, "request_log", None),
         )
         if getattr(parsed, "heartbeat_out", None):
             from mythril_tpu.observability import get_heartbeat
@@ -760,7 +792,18 @@ def execute_command(parsed) -> None:
                 period_s=parsed.heartbeat_interval,
                 out_path=parsed.heartbeat_out,
             )
-        sys.exit(run_server(config, host=parsed.host, port=parsed.port))
+        trace_out = getattr(parsed, "trace_out", None)
+        if trace_out:
+            from mythril_tpu.observability import get_tracer
+
+            get_tracer().enabled = True
+        rc = run_server(config, host=parsed.host, port=parsed.port)
+        if trace_out:
+            from mythril_tpu.observability import get_tracer
+
+            get_tracer().export_chrome_trace(trace_out)
+            print(f"trace written to {trace_out}", flush=True)
+        sys.exit(rc)
 
     if command == "submit":
         from mythril_tpu.service.client import ServiceClient
@@ -786,6 +829,7 @@ def execute_command(parsed) -> None:
                 transaction_count=parsed.transaction_count,
                 modules=modules,
                 execution_timeout=parsed.execution_timeout,
+                tenant=getattr(parsed, "tenant", None),
             ):
                 if as_json:
                     print(json.dumps(event), flush=True)
@@ -813,6 +857,16 @@ def execute_command(parsed) -> None:
         except (ConnectionError, OSError) as e:
             raise CriticalError(f"cannot reach analysis service: {e}") from e
         return
+
+    if command == "top":
+        from mythril_tpu.service.top import run_top
+
+        sys.exit(run_top(
+            host=parsed.host,
+            port=parsed.port,
+            interval=parsed.interval,
+            once=parsed.once,
+        ))
 
     if command == "analyze":
         _arm_observability(parsed)

@@ -27,6 +27,8 @@ from mythril_tpu.observability.flightrecorder import (  # noqa: F401
     arm_flight_recorder,
     disarm_flight_recorder,
     get_flight_recorder,
+    register_flight_context,
+    unregister_flight_context,
 )
 from mythril_tpu.observability.heartbeat import (  # noqa: F401
     HeartbeatSampler,
@@ -39,6 +41,7 @@ from mythril_tpu.observability.metrics import (  # noqa: F401
     LabeledCounter,
     MetricsRegistry,
     get_registry,
+    prometheus_text,
 )
 from mythril_tpu.observability.tracer import (  # noqa: F401
     Tracer,

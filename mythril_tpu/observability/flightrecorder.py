@@ -29,18 +29,36 @@ import sys
 import threading
 import time
 import traceback
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 __all__ = [
     "FlightRecorder",
     "arm_flight_recorder",
     "disarm_flight_recorder",
     "get_flight_recorder",
+    "register_flight_context",
+    "unregister_flight_context",
     "beat",
     "activity",
 ]
 
 SPAN_TAIL = 2000  # most recent spans included in a bundle
+
+# Pluggable context providers: subsystems register a callable whose
+# payload rides every bundle under ``bundle["context"][name]``.  The
+# analysis service registers its active-request table here so a
+# watchdog/SIGUSR1 snapshot of a stuck daemon names the requests (ids,
+# tenants, phases) it was serving.  Module-level — survives recorder
+# re-arms — and callables must be cheap and must not block.
+_context_sources: Dict[str, Callable[[], Any]] = {}
+
+
+def register_flight_context(name: str, fn: Callable[[], Any]) -> None:
+    _context_sources[name] = fn
+
+
+def unregister_flight_context(name: str) -> None:
+    _context_sources.pop(name, None)
 
 
 class FlightRecorder:
@@ -190,6 +208,12 @@ class FlightRecorder:
             bundle["heartbeat_tail"] = get_heartbeat().recent_samples()
         except Exception as e:
             bundle["heartbeat_error"] = repr(e)
+        for cname, fn in list(_context_sources.items()):
+            ctx = bundle.setdefault("context", {})
+            try:
+                ctx[cname] = fn()
+            except Exception as e:  # one bad source must not kill the dump
+                ctx[cname] = {"error": repr(e)}
         bundle["threads"] = self._thread_stacks()
         os.makedirs(self.out_dir, exist_ok=True)
         path = os.path.join(

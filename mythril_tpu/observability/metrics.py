@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import bisect
 import collections
+import re
 import threading
 from typing import Any, Dict, List, Optional, Tuple, Union
 
@@ -42,6 +43,7 @@ __all__ = [
     "LabeledCounter",
     "MetricsRegistry",
     "get_registry",
+    "prometheus_text",
 ]
 
 Number = Union[int, float]
@@ -114,10 +116,15 @@ class LabeledCounter(collections.Counter):
     ``stats.parks_by_opcode[op] += 1`` and ``.most_common()`` intact.
     """
 
-    def __init__(self, name: str, persistent: bool = False):
+    def __init__(self, name: str, persistent: bool = False,
+                 label_name: str = "label"):
         super().__init__()
         self.name = name
         self.persistent = persistent
+        # Prometheus label key used by the text exposition ({tenant="x"}
+        # reads better than {label="x"} for the service's per-tenant
+        # counters); keys stay plain strings everywhere else.
+        self.label_name = label_name
 
     def inc(self, label: str, n: Number = 1) -> None:
         """Thread-safe increment (``c[label] += n`` is not atomic)."""
@@ -183,6 +190,34 @@ class Histogram:
         self.min = None
         self.max = None
 
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimate the ``q``-quantile (0..1) from the bucket layout.
+
+        Linear interpolation inside the covering bucket, clamped to the
+        observed ``[min, max]`` — exact at the extremes, bucket-resolution
+        in between (the same estimate ``histogram_quantile`` makes).
+        Returns ``None`` when nothing has been observed.
+        """
+        with _MUTATION_LOCK:
+            count = self.count
+            if not count:
+                return None
+            counts = list(self.bucket_counts)
+            lo_obs, hi_obs = self.min, self.max
+        target = max(0.0, min(1.0, q)) * count
+        cum = 0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i] if i < len(self.buckets) else hi_obs
+                frac = (target - cum) / c
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                return min(max(est, lo_obs), hi_obs)
+            cum += c
+        return hi_obs
+
     def snapshot(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
             "count": self.count,
@@ -235,9 +270,11 @@ class MetricsRegistry:
             name, lambda: Gauge(name, persistent, default), Gauge
         )
 
-    def labeled_counter(self, name: str, persistent: bool = False) -> LabeledCounter:
+    def labeled_counter(self, name: str, persistent: bool = False,
+                        label_name: str = "label") -> LabeledCounter:
         return self._get_or_create(
-            name, lambda: LabeledCounter(name, persistent), LabeledCounter
+            name, lambda: LabeledCounter(name, persistent, label_name),
+            LabeledCounter,
         )
 
     def histogram(
@@ -283,3 +320,82 @@ _registry = MetricsRegistry()
 
 def get_registry() -> MetricsRegistry:
     return _registry
+
+
+# -- Prometheus text exposition (format 0.0.4) ---------------------------
+
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Registry names use dots; Prometheus metric names cannot."""
+    n = _PROM_BAD_CHARS.sub("_", name)
+    return "_" + n if n and n[0].isdigit() else n
+
+
+def _prom_label_value(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _prom_number(v: Number) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return repr(v)
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in the Prometheus text exposition format.
+
+    Counters and gauges become single samples; dict-valued gauges (the
+    heartbeat's per-shard depth maps) and labeled counters become one
+    labeled sample per key; histograms emit the standard *cumulative*
+    ``_bucket{le=...}`` series plus ``_sum``/``_count``.  Non-numeric
+    gauge payloads are skipped — the format has no place for them.
+    The analysis service serves this under the ``metrics`` verb.
+    """
+    reg = registry or get_registry()
+    with reg._lock:
+        items = sorted(reg._metrics.items())
+    lines: List[str] = []
+    for name, m in items:
+        pname = _prom_name(name)
+        if isinstance(m, Histogram):
+            with _MUTATION_LOCK:
+                counts = list(m.bucket_counts)
+                count, total = m.count, m.sum
+            lines.append(f"# TYPE {pname} histogram")
+            cum = 0
+            for i, c in enumerate(counts):
+                cum += c
+                le = ("+Inf" if i == len(m.buckets)
+                      else _prom_number(float(m.buckets[i])))
+                lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+            lines.append(f"{pname}_sum {_prom_number(float(total))}")
+            lines.append(f"{pname}_count {count}")
+        elif isinstance(m, LabeledCounter):
+            lines.append(f"# TYPE {pname} counter")
+            for label, v in sorted(m.snapshot().items()):
+                if isinstance(v, (int, float)):
+                    lines.append(
+                        f'{pname}{{{m.label_name}="{_prom_label_value(label)}"}}'
+                        f" {_prom_number(v)}"
+                    )
+        elif isinstance(m, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {_prom_number(m.value)}")
+        elif isinstance(m, Gauge):
+            v = m.value
+            if isinstance(v, dict):
+                numeric = {k: x for k, x in v.items()
+                           if isinstance(x, (int, float))}
+                if not numeric:
+                    continue
+                lines.append(f"# TYPE {pname} gauge")
+                for k, x in sorted(numeric.items()):
+                    lines.append(
+                        f'{pname}{{key="{_prom_label_value(k)}"}} {_prom_number(x)}'
+                    )
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {_prom_number(v)}")
+    return "\n".join(lines) + "\n"

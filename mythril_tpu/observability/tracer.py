@@ -167,6 +167,43 @@ class Tracer:
         self._record(name, cat, t, 0.0, threading.get_ident(), None,
                      ph=phase, fid=fid)
 
+    def flow_at(self, phase: str, fid: int, name: str, cat: str = "host",
+                tid: Optional[int] = None, t: Optional[float] = None) -> None:
+        """``flow`` with an explicit timestamp and track.
+
+        Post-hoc emission path: the service records a request's flow
+        "s" endpoint at terminal time, stamped back inside the request's
+        execute window.  Exports order events by ``ts``, so an "s"
+        recorded after its "f" but carrying an earlier stamp still
+        renders as a forward arrow.  ``t`` is an absolute
+        ``time.perf_counter()`` value.
+        """
+        if not self.enabled:
+            return
+        self._record(name, cat,
+                     t if t is not None else time.perf_counter(), 0.0,
+                     tid if tid is not None else threading.get_ident(),
+                     None, ph=phase, fid=fid)
+
+    def record_span(self, name: str, cat: str, t0: float, dur: float,
+                    tid: Optional[int] = None,
+                    args: Optional[dict] = None) -> None:
+        """Record a complete span from explicit ``perf_counter`` stamps.
+
+        The live ``span()`` context manager times code as it runs; this
+        is the post-hoc form for spans reconstructed from stamps taken
+        earlier (the service's per-request phase trees).  ``t0`` must be
+        an absolute ``time.perf_counter()`` value from this process so
+        it shares the clock domain of every live span.  Span args are an
+        explicit dict (not ``**kwargs``) so keys like ``name`` stay
+        usable.
+        """
+        if not self.enabled:
+            return
+        self._record(name, cat, t0, dur,
+                     tid if tid is not None else threading.get_ident(),
+                     dict(args) if args else None)
+
     def counter(self, name: str, values: Dict[str, float], tid: Optional[int] = None) -> None:
         """Record a counter sample ("C" event -> Perfetto counter track)."""
         if not self.enabled:

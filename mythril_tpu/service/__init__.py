@@ -16,6 +16,12 @@ package converts the batch tool into a server:
   replay of a completed one.
 * ``server.run_server`` / ``client.ServiceClient`` — a thin JSON-lines
   TCP layer (``myth serve`` / ``myth submit``) over the in-process API.
+* ``telemetry.RequestTelemetry`` — the request-scoped telemetry plane:
+  per-phase latency decomposition (queue-wait/batch-wait/execute/stream
+  histograms + percentiles in ``stats()``), per-tenant accounting,
+  per-request trace span trees flow-joined to the frontier's segment
+  spans, and the ``--request-log`` JSONL.  ``top.run_top`` renders a
+  live operator view (``myth top``) from polled stats.
 
 Determinism contract: each request's issue set (by
 ``codehash.issue_digest``) is bit-identical to a solo run of the same
@@ -35,6 +41,7 @@ from mythril_tpu.service.request import (  # noqa: F401
     ResultStream,
 )
 from mythril_tpu.service.admission import AdmissionController  # noqa: F401
+from mythril_tpu.service.telemetry import RequestTelemetry  # noqa: F401
 from mythril_tpu.service.daemon import (  # noqa: F401
     AnalysisService,
     ServiceConfig,

@@ -59,6 +59,9 @@ class Flight:
         self.finished = False
         # first-evidence attribution for the probe-vs-device counters
         self.first_issue_source: Optional[str] = None
+        # perf_counter stamp set when next_batch admits the flight, so
+        # late dedup subscribers can stamp their own queue-wait boundary
+        self.admitted_at: Optional[float] = None
 
     def subscribe(self, request: AnalysisRequest) -> ResultStream:
         stream = ResultStream(request.request_id)
@@ -67,6 +70,10 @@ class Flight:
                 self.requests.append(request)
                 if request.interactive:
                     self.tier = request.tier  # a dup upgrade counts
+                if self.admitted_at is not None:
+                    # joined after admission: this request never waited in
+                    # the queue — its queue_wait phase ends right here
+                    request.stamps.setdefault("admitted", time.perf_counter())
             for kind, payload in self.events:
                 stream.push(kind, payload)
             if not self.finished:
@@ -177,9 +184,13 @@ class AdmissionController:
                     continue
                 if key[1] == opts_key:
                     batch.append(flight)
+            now = time.perf_counter()
             for flight in batch:
                 del self._pending[flight.key]
                 self._running[flight.key] = flight
+                flight.admitted_at = now
+                for req in list(flight.requests):
+                    req.stamps.setdefault("admitted", now)
             self._c_admitted.inc(len(batch))
             return batch
 
@@ -200,6 +211,13 @@ class AdmissionController:
                     self._results.popitem(last=False)
 
     # -- introspection -------------------------------------------------
+
+    def cached_events(self, key: Key) -> List[Tuple[str, Any]]:
+        """Snapshot of the replay log for ``key`` (empty when evicted) —
+        lets the daemon attribute a replayed issue set to the request
+        it just served from cache."""
+        with self._lock:
+            return list(self._results.get(key) or ())
 
     def depths(self) -> Dict[str, int]:
         """Heartbeat source payload (sampled, never set on mutation)."""

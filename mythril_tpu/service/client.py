@@ -47,6 +47,12 @@ class ServiceClient:
             return event
         return {}
 
+    def metrics(self) -> str:
+        """The daemon's registry in Prometheus text exposition format."""
+        for event in self._roundtrip({"op": "metrics"}):
+            return event.get("text", "")
+        return ""
+
     def submit_stream(
         self,
         code: str,
@@ -56,11 +62,14 @@ class ServiceClient:
         modules: Optional[Sequence[str]] = None,
         strategy: Optional[str] = None,
         execution_timeout: Optional[int] = None,
+        tenant: Optional[str] = None,
     ) -> Iterator[Dict[str, Any]]:
         """Yield event dicts: ``accepted``, ``issue``*, ``done``/``error``."""
         msg: Dict[str, Any] = {"op": "submit", "code": code, "tier": tier}
         if name:
             msg["name"] = name
+        if tenant:
+            msg["tenant"] = tenant
         if transaction_count is not None:
             msg["transaction_count"] = transaction_count
         if modules:

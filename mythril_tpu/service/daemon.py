@@ -39,6 +39,10 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from mythril_tpu.observability import tracer as _otrace
+from mythril_tpu.observability.flightrecorder import (
+    register_flight_context,
+    unregister_flight_context,
+)
 from mythril_tpu.observability.heartbeat import get_heartbeat
 from mythril_tpu.observability.metrics import get_registry
 from mythril_tpu.service.admission import AdmissionController, Flight
@@ -50,6 +54,7 @@ from mythril_tpu.service.request import (
     TIER_BATCH,
     TIER_INTERACTIVE,
 )
+from mythril_tpu.service.telemetry import RequestTelemetry
 
 log = logging.getLogger(__name__)
 
@@ -82,6 +87,9 @@ class ServiceConfig:
     heartbeat: bool = False
     heartbeat_interval_s: float = 0.5
     result_cache_size: int = 256
+    #: append one JSON line per terminal request event (ids, tenant,
+    #: phase decomposition, issue digests) to this path
+    request_log: Optional[str] = None
 
 
 class AnalysisService:
@@ -107,6 +115,8 @@ class AnalysisService:
         self._c_probe_wins = reg.counter("service.probe_wins", persistent=True)
         self._c_device_wins = reg.counter("service.device_wins", persistent=True)
         self._c_probe_runs = reg.counter("service.probe_runs", persistent=True)
+        self._h_probe = reg.histogram("service.probe_s", persistent=True)
+        self.telemetry = RequestTelemetry(request_log=self.config.request_log)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -115,7 +125,10 @@ class AnalysisService:
             return self
         self._configure_process()
         hb = get_heartbeat()
-        hb.register("service", self.admission.depths)
+        hb.register("service", self._sample_depths)
+        register_flight_context(
+            "service.requests", self.telemetry.active_requests
+        )
         if self.config.heartbeat and not hb.running:
             hb.start(period_s=self.config.heartbeat_interval_s)
         self._stop.clear()
@@ -150,7 +163,15 @@ class AnalysisService:
         self._worker = None
         self._started = False
         get_heartbeat().unregister("service")
+        unregister_flight_context("service.requests")
+        self.telemetry.close()
         return drained
+
+    def _sample_depths(self) -> Dict[str, int]:
+        """Heartbeat source: admission depths + live request count."""
+        depths = self.admission.depths()
+        depths["service.active_requests"] = len(self.telemetry.active_requests())
+        return depths
 
     def _configure_process(self) -> None:
         """Arm the warm-process configuration once, at startup."""
@@ -194,6 +215,7 @@ class AnalysisService:
         name: Optional[str] = None,
         tier: str = TIER_BATCH,
         options: Optional[AnalysisOptions] = None,
+        tenant: Optional[str] = None,
     ) -> Tuple[AnalysisRequest, ResultStream, bool]:
         """Queue one contract; returns ``(request, stream, deduped)``."""
         if self._draining or not self._started:
@@ -209,8 +231,35 @@ class AnalysisService:
             codehash=codehash,
             options=options or self.config.default_options,
             tier=tier,
+            tenant=tenant,
         )
+        # register with telemetry BEFORE admission: once admitted the
+        # worker may finalize the request at any moment, and finalize of
+        # an unregistered request would be dropped
+        self.telemetry.request_started(request)
         stream, deduped = self.admission.submit(request)
+        if deduped:
+            self.telemetry.request_deduped(request)
+            if stream.closed:
+                # pure replay of a cached result: no flight will ever
+                # reference this request again — finalize it now, with
+                # the replayed issue set (it WAS delivered to this
+                # tenant, so it counts toward their accounting)
+                events = self.admission.cached_events(
+                    (request.codehash, request.options.key())
+                )
+                issues = next(
+                    (p.get("issues", []) for k, p in events if k == "done"),
+                    [],
+                )
+                self.telemetry.request_finished(
+                    request,
+                    events[-1][0] if events else "done",
+                    n_issues=len(issues),
+                    digests=[issue_digest(i) for i in issues],
+                    deduped=True,
+                    replayed=True,
+                )
         return request, stream, deduped
 
     def stats(self) -> Dict[str, Any]:
@@ -223,6 +272,16 @@ class AnalysisService:
             "service.device_wins", "service.probe_runs",
         ):
             out[name] = reg.counter(name, persistent=True).snapshot()
+        requests = out["service.requests"] or 0
+        out["cache"] = {
+            "dedup_hit_rate": round(out["service.dedup_hits"] / requests, 4)
+            if requests else 0.0,
+            "replay_hit_rate": round(out["service.replay_hits"] / requests, 4)
+            if requests else 0.0,
+        }
+        out["phases"] = self.telemetry.phase_stats()
+        out["tenants"] = self.telemetry.tenant_stats()
+        out["inflight_requests"] = self.telemetry.active_requests()
         return out
 
     # -- worker (single thread owns the engine) ------------------------
@@ -260,6 +319,12 @@ class AnalysisService:
                         flight.emit("error", f"batch failure: {exc!r}")
                         self._c_errors.inc()
                     self.admission.finish(flight)
+                    with flight.lock:
+                        flight_requests = list(flight.requests)
+                    self._finish_requests(
+                        flight, flight_requests, "error",
+                        batch_width=len(batch),
+                    )
 
     def _scope_reset(self) -> None:
         from mythril_tpu.facade.warm import reset_analysis_scope
@@ -311,6 +376,12 @@ class AnalysisService:
         sink_lock = threading.Lock()
         request_ids = [f.requests[0].request_id for f in batch]
         opts: AnalysisOptions = batch[0].options
+        tel = self.telemetry
+        self._stamp_batch(batch, None, "batch_wait")
+        # one trace flow id per primary request; the frontier emits the
+        # "f" endpoints inside its first segment span, the matching "s"
+        # endpoints ride each request's span tree at terminal time
+        flow_cb = tel.batch_flow_callback(request_ids)
 
         with _otrace.span(
             "service.batch", cat="service", width=len(batch),
@@ -326,6 +397,7 @@ class AnalysisService:
                 # re-detects everything it would have found solo
                 self._scope_reset()
 
+            self._stamp_batch(batch, "execute0", "execute")
             prev_sink = set_issue_sink(
                 self._make_sink(by_hash, streamed, "device", sink_lock)
             )
@@ -338,16 +410,30 @@ class AnalysisService:
                     execution_timeout=opts.execution_timeout,
                     isolate_errors=True,
                     request_tags=request_ids,
+                    request_flow_cb=flow_cb,
                 )
             finally:
                 set_issue_sink(prev_sink)
+            self._stamp_batch(batch, "execute1", "stream")
 
         elapsed = time.perf_counter() - t0
+        exec0 = batch[0].requests[0].stamps.get("execute0", t0)
+        exec1 = batch[0].requests[0].stamps.get("execute1", exec0)
+        device_wall = max(exec1 - exec0, 0.0)
         for flight in batch:
+            with flight.lock:
+                flight_requests = list(flight.requests)
+            # device wall attributed evenly: by flight, then by the
+            # requests sharing the flight
+            share = device_wall / len(batch) / max(len(flight_requests), 1)
             if flight.codehash in errors_by_name:
                 flight.emit("error", errors_by_name[flight.codehash])
                 self._c_errors.inc()
                 self.admission.finish(flight)
+                self._finish_requests(
+                    flight, flight_requests, "error",
+                    batch_width=len(batch), compute_share=share,
+                )
                 continue
             wires = [
                 _issue_to_wire(i)
@@ -374,10 +460,42 @@ class AnalysisService:
                 "batch_width": len(batch),
             })
             self.admission.finish(flight)
+            self._finish_requests(
+                flight, flight_requests, "done",
+                n_issues=len(wires),
+                digests=[issue_digest(w) for w in wires],
+                batch_width=len(batch), compute_share=share,
+            )
         log.info(
             "service batch of %d done in %.2fs (%d errored)",
             len(batch), elapsed, len(errors_by_name),
         )
+
+    def _stamp_batch(self, batch: List[Flight], stamp: Optional[str],
+                     phase: str) -> None:
+        """Stamp every request on every flight at a phase boundary."""
+        now = time.perf_counter()
+        for flight in batch:
+            with flight.lock:
+                requests = list(flight.requests)
+            for req in requests:
+                if stamp is not None:
+                    req.stamps.setdefault(stamp, now)
+                self.telemetry.set_phase(req, phase)
+
+    def _finish_requests(self, flight: Flight,
+                         requests: List[AnalysisRequest], event: str,
+                         *, n_issues: int = 0, digests=None,
+                         batch_width: Optional[int] = None,
+                         compute_share: float = 0.0) -> None:
+        primary = flight.requests[0]
+        for req in requests:
+            self.telemetry.request_finished(
+                req, event,
+                n_issues=n_issues, digests=digests,
+                batch_width=batch_width, compute_share=compute_share,
+                deduped=req is not primary,
+            )
 
     def _probe(
         self,
@@ -427,9 +545,7 @@ class AnalysisService:
         finally:
             args.frontier, args.probe_backend = saved
             set_issue_sink(prev_sink)
-        get_registry().histogram("service.probe_s", persistent=True).observe(
-            time.perf_counter() - t0
-        )
+        self._h_probe.observe(time.perf_counter() - t0)
 
 
 def _issue_to_wire(issue) -> Dict[str, Any]:

@@ -22,12 +22,23 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
-from mythril_tpu.observability.metrics import get_registry
+from mythril_tpu.observability.metrics import Histogram, get_registry
 
 __all__ = ["AnalysisOptions", "AnalysisRequest", "ResultStream"]
 
 TIER_BATCH = "batch"
 TIER_INTERACTIVE = "interactive"
+
+# Cached instrument: push() runs once per streamed event, and a registry
+# lookup per observation is a dict probe + isinstance we don't need.
+_H_TTFE: Optional[Histogram] = None
+
+
+def _ttfe_histogram() -> Histogram:
+    global _H_TTFE
+    if _H_TTFE is None:
+        _H_TTFE = get_registry().histogram("service.ttfe_s", persistent=True)
+    return _H_TTFE
 
 
 @dataclass(frozen=True)
@@ -59,6 +70,13 @@ class AnalysisRequest:
     options: AnalysisOptions
     tier: str = TIER_BATCH
     submitted_at: float = field(default_factory=time.time)
+    # optional tenant label for per-tenant accounting (None -> "-")
+    tenant: Optional[str] = None
+    # telemetry phase stamps, all in the perf_counter domain: t_submit is
+    # taken at construction; "admitted"/"execute0"/"execute1" are stamped
+    # by the admission controller and the worker as the request moves.
+    t_submit: float = field(default_factory=time.perf_counter)
+    stamps: Dict[str, float] = field(default_factory=dict)
 
     @property
     def interactive(self) -> bool:
@@ -92,12 +110,20 @@ class ResultStream:
             return
         if kind == "issue" and self.first_issue_at is None:
             self.first_issue_at = time.time()
-            get_registry().histogram("service.ttfe_s", persistent=True).observe(
-                self.first_issue_at - self.created_at
-            )
+            _ttfe_histogram().observe(self.first_issue_at - self.created_at)
         if kind in self._DONE_KINDS:
             self._closed = True
         self._q.put((kind, payload))
+
+    @property
+    def closed(self) -> bool:
+        """True once the terminal event has been pushed.
+
+        A dedup submission whose stream comes back already closed was a
+        pure replay — the daemon finalizes its telemetry immediately
+        instead of waiting on a batch that will never reference it.
+        """
+        return self._closed
 
     # -- consumer ------------------------------------------------------
 

@@ -14,6 +14,12 @@ Protocol (UTF-8, one JSON object per line):
 
     -> {"op": "ping"}    <- {"event": "pong"}
     -> {"op": "stats"}   <- {"event": "stats", ...counters...}
+    -> {"op": "metrics"} <- {"event": "metrics", "text": "<prometheus>"}
+
+``submit`` also accepts an optional ``"tenant"`` label for per-tenant
+accounting; ``metrics`` returns the full registry in the Prometheus
+text exposition format (``content_type`` names the version) so one
+sidecar bridge can serve it over HTTP unmodified.
 
 ``run_server`` installs SIGTERM/SIGINT handlers that stop accepting,
 drain every in-flight request (subscribers still receive their streamed
@@ -60,6 +66,14 @@ class _Handler(socketserver.StreamRequestHandler):
                 self._send({"event": "pong"})
             elif op == "stats":
                 self._send({"event": "stats", **service.stats()})
+            elif op == "metrics":
+                from mythril_tpu.observability.metrics import prometheus_text
+
+                self._send({
+                    "event": "metrics",
+                    "content_type": "text/plain; version=0.0.4",
+                    "text": prometheus_text(),
+                })
             elif op == "submit":
                 self._submit(service, msg)
             else:
@@ -91,6 +105,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 name=msg.get("name"),
                 tier=msg.get("tier", "batch"),
                 options=options,
+                tenant=msg.get("tenant"),
             )
         except (ValueError, RuntimeError) as exc:
             self._send({"event": "error", "error": str(exc)})

@@ -1,0 +1,130 @@
+"""``myth top`` — a live operator view of a running analysis daemon.
+
+Polls the daemon's ``stats`` verb and renders a refreshing terminal
+table: admission depths, cache hit rates, per-phase latency percentiles
+(the request-scoped telemetry histograms), per-tenant totals, and the
+in-flight request list with each request's current phase and age.
+
+``format_top`` is a pure function over one stats payload so tests can
+assert the rendering against a canned dict; ``run_top`` owns the
+connection/refresh loop.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Dict, Optional, TextIO
+
+from mythril_tpu.service.client import ServiceClient
+
+__all__ = ["format_top", "run_top"]
+
+# ANSI: clear screen + home.  Only emitted between refreshes, never in
+# --once mode, so piped output stays clean.
+_CLEAR = "\x1b[2J\x1b[H"
+
+_PHASE_ORDER = ("queue_wait", "batch_wait", "execute", "stream",
+                "ttfe", "probe")
+
+
+def _ms(v: Any) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    return f"{v * 1000:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
+    """Render one stats payload as the ``myth top`` screen."""
+    lines = []
+    title = "mythril-tpu service"
+    if address:
+        title += f" @ {address}"
+    lines.append(title)
+    cache = stats.get("cache") or {}
+    lines.append(
+        "queue {q}  inflight {i}  cached {c}  |  requests {r}  "
+        "batches {b}  errors {e}  |  dedup {d:.0%}  replay {p:.0%}".format(
+            q=stats.get("service.queue_depth", 0),
+            i=stats.get("service.inflight", 0),
+            c=stats.get("service.result_cache", 0),
+            r=stats.get("service.requests", 0),
+            b=stats.get("service.batches", 0),
+            e=stats.get("service.request_errors", 0),
+            d=cache.get("dedup_hit_rate", 0.0),
+            p=cache.get("replay_hit_rate", 0.0),
+        )
+    )
+
+    phases = stats.get("phases") or {}
+    if any((phases.get(p) or {}).get("count") for p in _PHASE_ORDER):
+        lines.append("")
+        lines.append(f"{'phase':<12}{'count':>7}{'avg':>10}{'p50':>10}"
+                     f"{'p95':>10}{'p99':>10}")
+        for p in _PHASE_ORDER:
+            row = phases.get(p) or {}
+            if not row.get("count"):
+                continue
+            lines.append(
+                f"{p:<12}{row['count']:>7}{_ms(row.get('avg')):>10}"
+                f"{_ms(row.get('p50')):>10}{_ms(row.get('p95')):>10}"
+                f"{_ms(row.get('p99')):>10}"
+            )
+
+    tenants = stats.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append(f"{'tenant':<16}{'requests':>9}{'issues':>8}"
+                     f"{'dedup':>7}{'compute_s':>11}")
+        for tenant, row in sorted(tenants.items()):
+            lines.append(
+                f"{tenant:<16}{row.get('requests', 0):>9}"
+                f"{row.get('issues', 0):>8}{row.get('dedup_hits', 0):>7}"
+                f"{row.get('compute_s', 0.0):>11.3f}"
+            )
+
+    inflight = stats.get("inflight_requests") or []
+    lines.append("")
+    lines.append(f"in flight: {len(inflight)}")
+    for req in inflight[:32]:
+        lines.append(
+            f"  {req.get('request_id', '?'):<10}"
+            f"{(req.get('tenant') or '-'):<14}"
+            f"{req.get('tier', '?'):<13}{req.get('phase', '?'):<12}"
+            f"{_ms(req.get('age_s'))}"
+        )
+    if len(inflight) > 32:
+        lines.append(f"  ... and {len(inflight) - 32} more")
+    return "\n".join(lines)
+
+
+def run_top(
+    host: str = "127.0.0.1",
+    port: int = 7344,
+    interval: float = 2.0,
+    once: bool = False,
+    iterations: Optional[int] = None,
+    out: Optional[TextIO] = None,
+) -> int:
+    """Poll ``host:port`` and render until interrupted; returns exit code."""
+    client = ServiceClient(host, port, timeout=10.0)
+    out = out or sys.stdout
+    n = 0
+    while True:
+        try:
+            stats = client.stats()
+        except OSError as exc:
+            print(f"cannot reach analysis service at {host}:{port}: {exc}",
+                  file=sys.stderr)
+            return 1
+        if not once and n:
+            out.write(_CLEAR)
+        out.write(format_top(stats, address=f"{host}:{port}") + "\n")
+        out.flush()
+        n += 1
+        if once or (iterations is not None and n >= iterations):
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0

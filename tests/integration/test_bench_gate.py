@@ -149,6 +149,39 @@ def test_gate_fails_on_ttfe_regression(tmp_path):
     assert bench.regression_gate(prior, {"corpus_sweep": slow}) == 1
 
 
+def test_gate_fails_on_service_phase_p95_regression(tmp_path, capsys):
+    phases = {
+        "queue_wait": {"count": 12, "p50": 0.08, "p95": 0.2},
+        "execute": {"count": 12, "p50": 1.1, "p95": 1.6},
+        "stream": {"count": 12, "p50": 0.01, "p95": 0.05},
+    }
+    prior = _write(
+        tmp_path, "prior.json", {"serve_load": dict(ROW, service_phase_s=phases)}
+    )
+    # identical phases pass
+    same = dict(ROW, service_phase_s=json.loads(json.dumps(phases)))
+    assert bench.regression_gate(prior, {"serve_load": same}) == 0
+    capsys.readouterr()
+    # a queue-wait blowup past tol + GATE_PHASE_SLACK_S fails and names
+    # the phase (the injected-admission-sleep CI check rides this path)
+    slow = json.loads(json.dumps(phases))
+    slow["queue_wait"]["p95"] = (
+        phases["queue_wait"]["p95"] * (1 + bench.GATE_TOLERANCE)
+        + bench.GATE_PHASE_SLACK_S + 1.0
+    )
+    rc = bench.regression_gate(
+        prior, {"serve_load": dict(ROW, service_phase_s=slow)}
+    )
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert any("queue_wait p95" in v for v in report["gate"]["violations"])
+    # a phase only present on one side is skipped, not a failure
+    partial = {"execute": phases["execute"]}
+    assert bench.regression_gate(
+        prior, {"serve_load": dict(ROW, service_phase_s=partial)}
+    ) == 0
+
+
 def test_gate_fails_on_harvest_share_growth(tmp_path):
     prior = _write(tmp_path, "prior.json", {"corpus_sweep": ROW})
     hot = dict(ROW, harvest_share_pct=ROW["harvest_share_pct"] + 30.0)

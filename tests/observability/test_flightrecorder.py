@@ -122,3 +122,20 @@ def test_rearm_replaces_recorder(tmp_path):
     b = frec.arm_flight_recorder(str(tmp_path / "b"))
     assert frec.get_flight_recorder() is b
     assert not a._armed
+
+
+def test_dump_includes_registered_context_sources(tmp_path):
+    frec.register_flight_context("t.ctx", lambda: {"k": 1})
+    frec.register_flight_context("t.bad", lambda: 1 / 0)
+    try:
+        rec = FlightRecorder(str(tmp_path))
+        bundle = json.loads(open(rec.dump("manual")).read())
+        assert bundle["context"]["t.ctx"] == {"k": 1}
+        # one broken source never takes the bundle down with it
+        assert "ZeroDivisionError" in bundle["context"]["t.bad"]["error"]
+    finally:
+        frec.unregister_flight_context("t.ctx")
+        frec.unregister_flight_context("t.bad")
+    # sources survive re-arms but honor unregistration
+    bundle = json.loads(open(FlightRecorder(str(tmp_path)).dump("again")).read())
+    assert "t.ctx" not in bundle.get("context", {})

@@ -113,3 +113,50 @@ def test_counter_metric_snapshot_is_plain_value():
     assert c.snapshot() == 3
     h = Histogram("y")
     assert h.snapshot() == {"count": 0, "sum": 0.0}
+
+
+def test_histogram_percentile_interpolation(reg):
+    h = reg.histogram("t.lat", buckets=(1.0, 2.0, 4.0))
+    assert h.percentile(0.5) is None  # empty
+    for v in (0.5, 1.5, 1.7, 3.0, 9.0):
+        h.observe(v)
+    # clamped to the observed range at the extremes
+    assert h.percentile(0.0) == 0.5
+    assert h.percentile(1.0) == 9.0
+    # rank 2.5 of 5 lands mid-way through the (1, 2] bucket's two obs
+    assert h.percentile(0.50) == pytest.approx(1.75)
+    # rank 4.75 interpolates the +Inf bucket up to the observed max
+    assert h.percentile(0.95) == pytest.approx(7.75)
+    # monotone in q
+    qs = [h.percentile(q) for q in (0.1, 0.5, 0.9, 0.99)]
+    assert qs == sorted(qs)
+
+
+def test_prometheus_text_exposition(reg):
+    from mythril_tpu.observability.metrics import prometheus_text
+
+    reg.counter("svc.requests").inc(3)
+    reg.labeled_counter(
+        "svc.tenant_requests", label_name="tenant"
+    ).inc("a-corp", 2)
+    h = reg.histogram("svc.wait_s", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(3.0)
+    reg.gauge("svc.depth", default=0).set(7)
+    reg.gauge("svc.shards", default={}).set({"s0": 4, "note": "text"})
+    reg.gauge("svc.blob", default=None).set("not-exposable")
+    text = prometheus_text(reg)
+    assert "# TYPE svc_requests counter\nsvc_requests 3" in text
+    assert 'svc_tenant_requests{tenant="a-corp"} 2' in text
+    # cumulative buckets + sum/count
+    assert 'svc_wait_s_bucket{le="1.0"} 1' in text
+    assert 'svc_wait_s_bucket{le="2.0"} 1' in text
+    assert 'svc_wait_s_bucket{le="+Inf"} 2' in text
+    assert "svc_wait_s_sum 3.5" in text
+    assert "svc_wait_s_count 2" in text
+    assert "svc_depth 7" in text
+    # dict gauges keep numeric keys only; non-numeric gauges are skipped
+    assert 'svc_shards{key="s0"} 4' in text
+    assert "note" not in text and "blob" not in text
+    # names are sanitized to the exposition charset
+    assert "svc.requests" not in text

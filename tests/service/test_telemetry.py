@@ -1,0 +1,313 @@
+"""Request-scoped telemetry plane: phase stamps, per-request span trees,
+tenant accounting, the request log, the flow join to frontier segments,
+the ``metrics`` verb, and the flight-recorder context hook.  Host engine
+(frontier off, warmup off) keeps every case in the tier-1 budget."""
+
+import io
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.observability.tracer import get_tracer
+from mythril_tpu.service import (
+    AnalysisOptions,
+    AnalysisService,
+    ServiceConfig,
+    issue_digest,
+)
+
+REPO = Path(__file__).resolve().parents[2]
+KILL_SIMPLE_HEX = (
+    REPO / "tests" / "testdata" / "inputs" / "kill_simple.bin-runtime"
+).read_text().strip()
+CLEAN_HEX = "0x60006000f3"  # PUSH1 0; PUSH1 0; RETURN — nothing to report
+
+OPTS = AnalysisOptions(transaction_count=1, execution_timeout=30)
+
+
+def _config(**overrides):
+    base = dict(
+        default_options=OPTS,
+        max_batch_width=4,
+        batch_window_s=0.25,
+        frontier=False,
+        probe=True,
+        warmup=False,
+    )
+    base.update(overrides)
+    return ServiceConfig(**base)
+
+
+@pytest.fixture
+def scoped_args():
+    """Snapshot/restore the global flag object the service arms."""
+    from mythril_tpu.facade.warm import reset_analysis_scope
+    from mythril_tpu.support.support_args import args
+
+    saved = dict(vars(args))
+    yield
+    vars(args).clear()
+    vars(args).update(saved)
+    from mythril_tpu.querycache import configure as configure_query_cache
+
+    configure_query_cache(
+        enabled=getattr(args, "query_cache", True),
+        cache_dir=getattr(args, "query_cache_dir", None),
+    )
+    reset_analysis_scope()
+
+
+@pytest.fixture
+def fresh_service_metrics():
+    """Exact-count assertions need the persistent ``service.`` namespace
+    zeroed — earlier tests in the session share the global registry."""
+    from mythril_tpu.observability.metrics import get_registry
+
+    get_registry().reset(include_persistent=True, prefix="service.")
+    yield
+
+
+@pytest.fixture
+def tracing():
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.enabled = True
+    yield tracer
+    tracer.enabled = False
+    tracer.reset()
+
+
+def test_shared_batch_two_tenants_spans_and_log(
+    scoped_args, fresh_service_metrics, tracing, tmp_path
+):
+    """Two tenants dedup onto one flight; every request still gets its
+    own span tree, log line, and tenant attribution — and digests match
+    across the shared batch with telemetry fully enabled."""
+    log_path = tmp_path / "requests.jsonl"
+    service = AnalysisService(
+        _config(request_log=str(log_path))
+    ).start()
+    try:
+        # back-to-back inside the batch window: bob joins alice's flight
+        req_a, stream_a, dd_a = service.submit(
+            KILL_SIMPLE_HEX, name="kill-a", tenant="alice"
+        )
+        req_b, stream_b, dd_b = service.submit(
+            KILL_SIMPLE_HEX, name="kill-b", tenant="bob"
+        )
+        req_c, stream_c, _ = service.submit(
+            CLEAN_HEX, name="clean", tenant="alice"
+        )
+        assert dd_a is False and dd_b is True
+        assert req_a.tenant == "alice" and req_b.tenant == "bob"
+        summaries = {}
+        for rid, stream in (("a", stream_a), ("b", stream_b), ("c", stream_c)):
+            events = list(stream.events(timeout=120))
+            assert events[-1][0] == "done"
+            summaries[rid] = events[-1][1]
+        # the dedup subscriber saw the identical issue set
+        dig = lambda s: sorted(issue_digest(i) for i in s["issues"])
+        assert dig(summaries["a"]) == dig(summaries["b"])
+        assert [i["swc_id"] for i in summaries["a"]["issues"]] == ["106"]
+        assert summaries["c"]["issues"] == []
+        # replay path: a finished flight serves carol from the cache and
+        # still finalizes her request (closed stream, replayed log line)
+        req_d, stream_d, dd_d = service.submit(
+            KILL_SIMPLE_HEX, name="kill-c", tenant="carol"
+        )
+        assert dd_d is True and stream_d.closed
+    finally:
+        service.stop(drain=True, timeout=60)
+
+    # -- span trees ----------------------------------------------------
+    spans = tracing.spans()
+    parents = {
+        s["args"]["request"]: s
+        for s in spans
+        if s["name"] == "service.request"
+    }
+    assert set(parents) == {
+        req_a.request_id, req_b.request_id, req_c.request_id,
+        req_d.request_id,
+    }
+    assert parents[req_a.request_id]["args"]["tenant"] == "alice"
+    assert parents[req_b.request_id]["args"]["tenant"] == "bob"
+    assert parents[req_b.request_id]["args"]["deduped"] is True
+    assert parents[req_d.request_id]["args"]["replayed"] is True
+    for rid, parent in parents.items():
+        assert parent["args"]["event"] == "done"
+        children = [
+            s for s in spans
+            if s["tid"] == parent["tid"] and s["name"] != "service.request"
+        ]
+        assert children, f"no phase children for {rid}"
+        p0, p1 = parent["ts"], parent["ts"] + parent["dur"]
+        for ch in children:
+            assert ch["name"].startswith("service.")
+            assert ch["ts"] >= p0 - 1e-6
+            assert ch["ts"] + ch["dur"] <= p1 + 1e-3
+    # executed requests carry the batch width; the replay does not
+    assert parents[req_a.request_id]["args"]["batch_width"] >= 2
+
+    # -- request log ---------------------------------------------------
+    lines = [
+        json.loads(l) for l in log_path.read_text().splitlines() if l
+    ]
+    by_rid = {l["request_id"]: l for l in lines}
+    assert set(by_rid) == set(parents)
+    a, b, d = (by_rid[r.request_id] for r in (req_a, req_b, req_d))
+    assert (a["tenant"], b["tenant"], d["tenant"]) == ("alice", "bob", "carol")
+    assert a["deduped"] is False and b["deduped"] is True
+    assert d["replayed"] is True
+    assert a["digests"] and a["digests"] == b["digests"]
+    for l in lines:
+        assert set(l["phases_s"]) == {
+            "queue_wait", "batch_wait", "execute", "stream"
+        }
+        assert all(v >= 0.0 for v in l["phases_s"].values())
+
+    # -- stats: phases, tenants, cache ---------------------------------
+    stats = service.stats()
+    for phase in ("queue_wait", "batch_wait", "execute", "stream"):
+        row = stats["phases"][phase]
+        assert row["count"] == 4
+        assert 0.0 <= row["p50"] <= row["p95"] <= row["p99"]
+    tenants = stats["tenants"]
+    assert tenants["alice"]["requests"] == 2
+    assert tenants["bob"]["requests"] == 1
+    assert tenants["bob"]["dedup_hits"] == 1
+    assert tenants["carol"]["dedup_hits"] == 1
+    assert tenants["alice"]["issues"] >= 1
+    assert tenants["bob"]["issues"] >= 1
+    assert tenants["alice"]["compute_s"] >= 0.0
+    assert stats["cache"]["dedup_hit_rate"] == pytest.approx(0.5)
+    assert stats["inflight_requests"] == []
+    # flat keys the CI smoke asserts stay put
+    assert stats["service.requests"] == 4
+    assert stats["service.dedup_hits"] == 2
+
+
+def test_flow_join_endpoints_pair_up(tracing):
+    """The flow arrow joining a request's execute child to the frontier
+    segment only materializes when the frontier actually fired the
+    callback, and both endpoints share one flow id."""
+    from mythril_tpu.service.request import AnalysisRequest
+    from mythril_tpu.service.telemetry import RequestTelemetry
+
+    tel = RequestTelemetry()
+    req = AnalysisRequest(
+        request_id="r-flow", name="t", code=b"\x00", codehash="h",
+        options=OPTS, tenant="acme",
+    )
+    tel.request_started(req)
+    cb = tel.batch_flow_callback([req.request_id])
+    assert cb is not None
+    cb()  # the frontier firing inside its first segment span
+    req.stamps["admitted"] = req.t_submit + 0.01
+    req.stamps["execute0"] = req.t_submit + 0.02
+    req.stamps["execute1"] = req.t_submit + 0.03
+    tel.request_finished(req, "done")
+    flows = [s for s in tracing.spans() if s["name"] == "flow.request"]
+    assert sorted(s["ph"] for s in flows) == ["f", "s"]
+    assert len({s["flow_id"] for s in flows}) == 1
+    # idempotent finalize: the dedup seam can deliver a second terminal
+    tel.request_finished(req, "done")
+    assert len([s for s in tracing.spans()
+                if s["name"] == "service.request"]) == 1
+
+
+def test_flow_source_suppressed_when_frontier_never_fires(tracing):
+    """Host-only batches (or errors) never reach a segment span; the
+    "s" endpoint must not dangle."""
+    from mythril_tpu.service.request import AnalysisRequest
+    from mythril_tpu.service.telemetry import RequestTelemetry
+
+    tel = RequestTelemetry()
+    req = AnalysisRequest(
+        request_id="r-noflow", name="t", code=b"\x00", codehash="h",
+        options=OPTS,
+    )
+    tel.request_started(req)
+    cb = tel.batch_flow_callback([req.request_id])
+    assert cb is not None  # allocated, but never invoked
+    req.stamps["execute0"] = req.t_submit + 0.01
+    tel.request_finished(req, "done")
+    assert [s for s in tracing.spans() if s["name"] == "flow.request"] == []
+
+
+def test_metrics_verb_and_top_over_tcp(scoped_args, fresh_service_metrics):
+    """End-to-end over the wire: tenant-labeled submit, Prometheus
+    scrape, and one ``myth top`` refresh against the live daemon."""
+    from mythril_tpu.service.client import ServiceClient
+    from mythril_tpu.service.server import AnalysisServer
+    from mythril_tpu.service.top import format_top, run_top
+
+    server = AnalysisServer(_config(), host="127.0.0.1", port=0).start()
+    host, port = server.address
+    try:
+        client = ServiceClient(host, port, timeout=120)
+        events = list(
+            client.submit_stream(KILL_SIMPLE_HEX, name="k", tenant="acme")
+        )
+        assert events[-1]["event"] == "done"
+        text = client.metrics()
+        assert '# TYPE service_tenant_requests counter' in text
+        assert 'service_tenant_requests{tenant="acme"} 1' in text
+        assert "service_queue_wait_s_bucket{le=" in text
+        assert "service_execute_s_count 1" in text
+        buf = io.StringIO()
+        assert run_top(host, port, once=True, out=buf) == 0
+        screen = buf.getvalue()
+        assert f"mythril-tpu service @ {host}:{port}" in screen
+        assert "acme" in screen and "queue_wait" in screen
+        # the pure renderer is what run_top printed
+        assert format_top(client.stats(), address=f"{host}:{port}"
+                          ).splitlines()[0] == screen.splitlines()[0]
+    finally:
+        server.stop()
+
+
+def test_top_unreachable_daemon_exits_nonzero(capsys):
+    from mythril_tpu.service.top import run_top
+
+    assert run_top("127.0.0.1", 1, once=True) == 1
+    assert "cannot reach analysis service" in capsys.readouterr().err
+
+
+def test_flight_recorder_bundle_lists_active_requests(
+    scoped_args, tmp_path, monkeypatch
+):
+    """Satellite: a dump taken mid-batch names the in-flight request ids
+    and their current phase via the registered context source."""
+    import mythril_tpu.analysis.cooperative as coop
+    from mythril_tpu.observability.flightrecorder import FlightRecorder
+
+    gate, release = threading.Event(), threading.Event()
+    real = coop.run_cooperative_batch
+
+    def blocking(*a, **kw):
+        gate.set()
+        release.wait(timeout=60)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(coop, "run_cooperative_batch", blocking)
+    service = AnalysisService(_config(probe=False)).start()
+    try:
+        req, stream, _ = service.submit(
+            KILL_SIMPLE_HEX, name="kill", tenant="acme"
+        )
+        assert gate.wait(timeout=60)
+        rec = FlightRecorder(str(tmp_path))
+        bundle = json.loads(open(rec.dump("test")).read())
+        ctx = bundle["context"]["service.requests"]
+        assert [r["request_id"] for r in ctx] == [req.request_id]
+        assert ctx[0]["tenant"] == "acme"
+        assert ctx[0]["phase"] in ("queue_wait", "batch_wait", "execute")
+        assert ctx[0]["age_s"] >= 0.0
+        release.set()
+        assert list(stream.events(timeout=120))[-1][0] == "done"
+    finally:
+        release.set()
+        service.stop(drain=True, timeout=60)
