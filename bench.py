@@ -409,6 +409,131 @@ def pipeline_compare() -> dict:
     return {"metric": "pipeline_compare", "workloads": results}
 
 
+def prefilter_compare() -> dict:
+    """Abstract pre-filter on-vs-off parity on two exploit workloads.
+
+    Runs each workload twice with the pipelined device frontier forced on —
+    once with the interval/known-bits pre-filter enabled, once with
+    ``--no-prefilter`` semantics — and asserts the zero-recall-loss
+    contract: the issue sets are IDENTICAL while the filtered run proved a
+    nonzero number of feasibility queries UNSAT before any exact solve, and
+    the harvest solver phase did not regress (generous CPU-jitter bound).
+    Returns (and ``main`` prints) one JSON-able dict with both walls, both
+    issue sets and the ``prefilter.*`` registry snapshot of the gated run.
+    """
+    from mythril_tpu import absdomain
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.frontier import engine as _eng
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.support.support_args import args as global_args
+
+    def issue_set(issues):
+        return sorted((i.swc_id, i.address) for i in issues)
+
+    suicide = bytes.fromhex("60003560e01c6341c0e1b51460145760006000fd5b33ff")
+    # x = calldataload(0); require(x < 10); x == 5 -> selfdestruct
+    # (feasible exploit), x == 20 -> selfdestruct (infeasible: the branch
+    # constraint contradicts the range pin, exactly the contradiction the
+    # abstract harvest refutes without an exact solve)
+    gated = bytes.fromhex(
+        "60003580600a9010600c57005b80600514601c5780601414601c57005b33ff"
+    )
+    workloads = [
+        # (name, contract-or-code, tx_count, modules, recall swc).
+        # killbilly runs ALL detection modules: its feasibility traffic is
+        # dominated by module confirmation demands and exercises the
+        # fallthrough/parity side; "gated" carries the infeasible branch
+        # that the pre-filter must kill before any exact solve
+        ("suicide", suicide, 1, ["AccidentallyKillable"], "106"),
+        ("gated", gated, 1, ["AccidentallyKillable"], "106"),
+        ("killbilly",
+         EVMContract(code=KILLBILLY, creation_code=KILLBILLY_CREATION,
+                     name="KillBilly"),
+         3, None, "106"),
+    ]
+
+    def one_run(target, txs, modules, filtered: bool):
+        global_args.prefilter = filtered
+        _clear_caches()
+        absdomain.reset_state()  # verdict memo must not leak across modes
+        _eng._SLOW_CODES.clear()
+        _eng._NARROW_CODES.clear()
+        _eng._SLOW_SEGMENTS.clear()
+        reg = get_registry()
+        reg.reset(prefix="prefilter.")
+        solver_before = reg.histogram("frontier.harvest.solver_s").sum
+        t0 = time.time()
+        _, issues = _analyze(target, 0x0901D12E, txs, modules=modules,
+                             timeout=300)
+        wall = time.time() - t0
+        solver_s = reg.histogram("frontier.harvest.solver_s").sum - solver_before
+        snap = {
+            k: v
+            for k, v in reg.snapshot().items()
+            if k.startswith("prefilter.")
+        }
+        return issue_set(issues), wall, solver_s, snap
+
+    prev = (global_args.prefilter, global_args.frontier,
+            global_args.frontier_force, global_args.frontier_width,
+            global_args.pipeline)
+    results = {}
+    total_killed = 0
+    try:
+        global_args.probe_backend = "auto"
+        global_args.frontier = True
+        global_args.frontier_force = True  # tiny contracts: bypass gates
+        global_args.frontier_width = 64
+        global_args.pipeline = True
+        # warm the XLA programs outside the timers (cold compiles would
+        # swamp the solver_s comparison)
+        one_run(suicide, 1, ["AccidentallyKillable"], True)
+        for name, target, txs, modules, swc in workloads:
+            on_issues, on_wall, on_solver, on_snap = one_run(
+                target, txs, modules, True
+            )
+            off_issues, off_wall, off_solver, off_snap = one_run(
+                target, txs, modules, False
+            )
+            assert any(s == swc for s, _ in on_issues), (
+                f"{name}: filtered run lost recall: {on_issues}"
+            )
+            assert on_issues == off_issues, (
+                f"{name}: pre-filter changed the issue set "
+                "(soundness broken): "
+                f"{on_issues} != {off_issues}"
+            )
+            assert off_snap.get("prefilter.evaluated", 0) == 0, (
+                f"{name}: --no-prefilter run still evaluated: {off_snap}"
+            )
+            killed = on_snap.get("prefilter.killed", 0)
+            total_killed += killed
+            # parity, not a race: the filter must not ADD solver time
+            # (generous bound absorbs CPU-backend jitter)
+            assert on_solver <= 1.5 * off_solver + 2.0, (
+                f"{name}: prefilter regressed harvest solver_s: "
+                f"{on_solver:.2f}s vs {off_solver:.2f}s unfiltered"
+            )
+            results[name] = {
+                "filtered_wall_s": round(on_wall, 3),
+                "unfiltered_wall_s": round(off_wall, 3),
+                "filtered_solver_s": round(on_solver, 3),
+                "unfiltered_solver_s": round(off_solver, 3),
+                "killed": killed,
+                "issues": on_issues,
+                "prefilter": on_snap,
+            }
+    finally:
+        (global_args.prefilter, global_args.frontier,
+         global_args.frontier_force, global_args.frontier_width,
+         global_args.pipeline) = prev
+    assert total_killed > 0, (
+        "pre-filter killed zero queries across every exploit workload: "
+        f"{results}"
+    )
+    return {"metric": "prefilter_compare", "workloads": results}
+
+
 def mesh_compare() -> dict:
     """Sharded-pipelined vs single-device parity across every mesh ×
     pipeline combination.
@@ -1467,6 +1592,7 @@ def _new_row_data():
         "residency": [],
         "harvest_shares": [],
         "harvest_phases": [],  # per-production-rep {phase: seconds} deltas
+        "prefilter": [],  # per-production-rep prefilter.* counter deltas
         "mids": [],  # per-production-rep (mid_reentered, mid_bounced, semantic_parked)
         # accumulated per-tag [hits, misses] deltas of the persistent XLA
         # compile cache — did this workload's programs come off disk?
@@ -1561,6 +1687,19 @@ def _row_summary(unit: str, d: dict) -> dict:
                 }
             }
             if d["harvest_phases"]
+            else {}
+        ),
+        # abstract pre-filter traffic (production runs): how many feasibility
+        # queries the interval/known-bits pass evaluated and proved UNSAT
+        # before any exact solve
+        **(
+            {
+                "prefilter": {
+                    k: _median([p[k] for p in d["prefilter"]])
+                    for k in ("evaluated", "killed", "fallthrough")
+                }
+            }
+            if d.get("prefilter")
             else {}
         ),
         # mid-frame residency (production runs): how many parked/resumed
@@ -1952,6 +2091,11 @@ def main() -> None:
         print(json.dumps(pipeline_compare()), flush=True)
         return
 
+    if "--prefilter-compare" in sys.argv:
+        # standalone abstract-prefilter parity mode: skip the suite, one line
+        print(json.dumps(prefilter_compare()), flush=True)
+        return
+
     if "--harvest-compare" in sys.argv:
         # standalone sharded-vs-serial harvest parity mode: one line
         print(json.dumps(harvest_compare()), flush=True)
@@ -2101,6 +2245,10 @@ def main() -> None:
                     ).sum
                     for p in _HARVEST_PHASES
                 }
+                pf_before = {
+                    k: get_registry().counter("prefilter.%s" % k).value
+                    for k in ("evaluated", "killed", "fallthrough")
+                }
                 cc_before = (
                     get_registry().counter(
                         "compilecache.hits", persistent=True
@@ -2160,6 +2308,12 @@ def main() -> None:
                             "frontier.harvest.%s_s" % p
                         ).sum - phases_before[p]
                         for p in _HARVEST_PHASES
+                    })
+                if production:
+                    d["prefilter"].append({
+                        k: get_registry().counter("prefilter.%s" % k).value
+                        - pf_before[k]
+                        for k in ("evaluated", "killed", "fallthrough")
                     })
                 if production:
                     # a workload with an internal warm-up supplies its own

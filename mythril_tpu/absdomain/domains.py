@@ -1,0 +1,685 @@
+"""Vectorized transfer functions over two sound abstract domains.
+
+Unsigned intervals
+    ``[lo, hi]`` per (node, row) in float64 with DIRECTED rounding:
+    every arithmetic result is widened one ulp outward (``np.nextafter``),
+    and integer constants that float64 cannot represent are rounded
+    outward at pack time.  The invariant is only ever ``lo <= v <= hi``
+    for every concrete model value ``v`` — the domain trades precision
+    for a dense dtype, never soundness.  Exactness is NOT assumed
+    anywhere: equality decisions come from the known-bits domain.
+
+Known bits
+    ``(km, kv)`` per (node, row): 16 uint32 limbs each, bit ``j`` of the
+    value is known iff bit ``j`` of ``km`` is set, in which case it
+    equals bit ``j`` of ``kv``.  Invariants: ``kv & ~km == 0`` and bits
+    at or above the node's width are always known zero.  This domain is
+    exact integer arithmetic — it decides equalities/comparisons between
+    fully-pinned 256-bit values that float64 intervals cannot.
+
+Both domains' kernels are written against an ``xp`` array namespace so
+the identical code runs under host numpy and under ``jax.numpy`` inside
+the device interpreter (``absdomain/device.py``).  Known-bits kernels use
+only uint32/int32/bool — sound without JAX x64 — which is what makes the
+known-bits pass device-residable at all; the interval pass needs float64
+and stays on host numpy (vectorized over the whole batch).
+
+A transformer may always return a coarser element (top); it must never
+exclude a value some concrete model can take.  The differential fuzz test
+(tests/absdomain/test_fuzz_differential.py) checks exactly that property
+against ``smt/concrete_eval.evaluate``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Tuple
+
+import numpy as np
+
+from mythril_tpu.native.bitblast import (
+    OP_CONST, OP_VAR, OP_EQ, OP_AND, OP_OR, OP_NOT, OP_XOR, OP_ITE,
+    OP_ADD, OP_SUB, OP_MUL, OP_UDIV, OP_UREM, OP_SDIV, OP_SREM,
+    OP_BAND, OP_BOR, OP_BXOR, OP_BNOT, OP_NEG, OP_SHL, OP_LSHR, OP_ASHR,
+    OP_CONCAT, OP_EXTRACT, OP_ZEXT, OP_SEXT, OP_ULT, OP_ULE, OP_SLT, OP_SLE,
+)
+
+from mythril_tpu.absdomain.tape import LIMBS, U32, PackedBatch
+
+_ALL = 0xFFFFFFFF
+_INF = np.inf
+
+
+class NodeParams(NamedTuple):
+    """Per-node scalars handed to every kernel (host ints / traced 0-d)."""
+
+    w: object        # node width in bits
+    x0: object       # extract hi / const offset
+    x1: object       # extract lo / const nbytes
+    wm: object       # [LIMBS] width mask
+    cl: object       # [LIMBS] OP_CONST payload limbs
+    wa: object       # width of operand a0 (0 when absent)
+    wb: object       # width of operand a1 (0 when absent)
+
+
+# ---------------------------------------------------------------------------
+# Known-bits kernels (xp-agnostic: numpy or jax.numpy)
+# ---------------------------------------------------------------------------
+
+
+def _u32(xp, x):
+    return xp.asarray(x, dtype=xp.uint32)
+
+
+def _fully_known(xp, km):
+    return (km == _u32(xp, _ALL)).all(axis=-1)
+
+
+def _bool_out(xp, wm, like, decided, value):
+    """Encode a bool node: bit0 known iff ``decided``, then equal ``value``."""
+    z = xp.zeros_like(like)
+    km = (z + (~wm)) | xp.where(decided[:, None], z + wm, z)
+    kv = xp.where((decided & value)[:, None], z + wm, z)
+    return km, kv
+
+
+def _kb_top(xp, p, A, B, C):
+    z = xp.zeros_like(A[0])
+    return z + (~p.wm), z
+
+
+def _kb_const(xp, p, A, B, C):
+    z = xp.zeros_like(A[0])
+    return z + _u32(xp, _ALL), z + p.cl
+
+
+def _kb_band(xp, p, A, B, C):
+    ka, va = A
+    kb, vb = B
+    km = (ka & kb) | (ka & ~va) | (kb & ~vb)
+    return km, va & vb & km
+
+
+def _kb_bor(xp, p, A, B, C):
+    ka, va = A
+    kb, vb = B
+    km = (ka & kb) | (ka & va) | (kb & vb)
+    return km, (va | vb) & km
+
+
+def _kb_bxor(xp, p, A, B, C):
+    ka, va = A
+    kb, vb = B
+    km = ka & kb
+    return km, (va ^ vb) & km
+
+
+def _kb_bnot(xp, p, A, B, C):
+    ka, va = A
+    return ka, (~va) & ka & p.wm
+
+
+def _ripple_add(xp, va, vb, carry_in):
+    """512-bit add over the limb axis without 64-bit intermediates."""
+    carry = xp.zeros_like(va[..., 0]) + _u32(xp, carry_in)
+    out = []
+    for i in range(LIMBS):
+        t = va[..., i] + vb[..., i]
+        c1 = t < va[..., i]
+        s = t + carry
+        c2 = s < t
+        out.append(s)
+        carry = (c1 | c2).astype(xp.uint32)
+    return xp.stack(out, axis=-1)
+
+
+def _kb_fullknown(xp, p, A, B, value):
+    """Known exactly where both operands are fully pinned, else top."""
+    fully = (_fully_known(xp, A[0]) & _fully_known(xp, B[0]))[:, None]
+    z = xp.zeros_like(A[0])
+    km = xp.where(fully, z + _u32(xp, _ALL), z + (~p.wm))
+    kv = xp.where(fully, value & p.wm, z)
+    return km, kv & km
+
+
+def _bitlen32(xp, v):
+    """Per-limb bit length (0..32) via smear + SWAR popcount, uint32-only."""
+    v = v | (v >> 1)
+    v = v | (v >> 2)
+    v = v | (v >> 4)
+    v = v | (v >> 8)
+    v = v | (v >> 16)
+    v = v - ((v >> 1) & _u32(xp, 0x55555555))
+    v = (v & _u32(xp, 0x33333333)) + ((v >> 2) & _u32(xp, 0x33333333))
+    v = (v + (v >> 4)) & _u32(xp, 0x0F0F0F0F)
+    v = (v * _u32(xp, 0x01010101)) >> 24
+    return v.astype(xp.int32)
+
+
+def _pbits(xp, km, kv):
+    """[rows] EXACT max bit-length of the value: the element guarantees
+    ``v <= 2**_pbits - 1``.  Bits that are known zero cannot contribute."""
+    x = ~(km & ~kv)  # possibly-one bits (zero at/above width by invariant)
+    bl = _bitlen32(xp, x)
+    li = xp.arange(LIMBS, dtype=xp.int32) * 32
+    per = xp.where(x != 0, bl + li, xp.zeros_like(bl))
+    return per.max(axis=-1)
+
+
+def _mask_ge(xp, n, like):
+    """[rows, LIMBS] mask of the bits at positions >= n (n per row)."""
+    return ~_mask_below(xp, n, like)
+
+
+def _kb_add(xp, p, A, B, C):
+    km, kv = _kb_fullknown(xp, p, A, B, _ripple_add(xp, A[1], B[1], 0))
+    # leading zeros: a + b <= 2^pa + 2^pb - 2 < 2^(max(pa,pb)+1); when that
+    # threshold exceeds the width the claim only covers bits already known
+    # zero, so wrap-around cannot be mis-modeled
+    thr = xp.maximum(_pbits(xp, *A), _pbits(xp, *B)) + 1
+    return km | _mask_ge(xp, thr, A[0]), kv
+
+
+def _kb_sub(xp, p, A, B, C):
+    return _kb_fullknown(xp, p, A, B, _ripple_add(xp, A[1], ~B[1], 1))
+
+
+def _kb_mul(xp, p, A, B, C):
+    """Exact leading-zero propagation: with a <= 2^pa - 1 and b <= 2^pb - 1,
+    ab <= (2^pa - 1)(2^pb - 1).  In particular ab == 0 when either factor
+    is 0, ab <= 2^pb - 1 when pa <= 1 (a is 0 or 1, symmetrically for b),
+    and ab < 2^(pa+pb) always.  The pa <= 1 case is what recovers
+    refutations like ``cnt <= 1 && cnt*value >= 2^256`` that float64
+    intervals lose at the 2^w - 1 representation boundary."""
+    pa = _pbits(xp, *A)
+    pb = _pbits(xp, *B)
+    thr = xp.where((pa == 0) | (pb == 0), 0,
+                   xp.where(pa <= 1, pb,
+                            xp.where(pb <= 1, pa, pa + pb)))
+    z = xp.zeros_like(A[0])
+    return (z + (~p.wm)) | _mask_ge(xp, thr, A[0]), z
+
+
+def _kb_div_rem(xp, p, A, B, C):
+    """udiv/urem never exceed the dividend (division by zero yields 0 in
+    this engine's EVM semantics), so the dividend's leading zeros carry."""
+    z = xp.zeros_like(A[0])
+    return (z + (~p.wm)) | _mask_ge(xp, _pbits(xp, *A), A[0]), z
+
+
+def _kb_neg(xp, p, A, B, C):
+    z = (xp.zeros_like(A[1]), xp.zeros_like(A[1]))
+    fully = _fully_known(xp, A[0])[:, None]
+    val = _ripple_add(xp, ~A[1], z[1], 1)
+    zz = xp.zeros_like(A[0])
+    km = xp.where(fully, zz + _u32(xp, _ALL), zz + (~p.wm))
+    kv = xp.where(fully, val & p.wm, zz)
+    return km, kv & km
+
+
+def _limb_ult(xp, va, vb):
+    """Exact (a < b, a == b) from fully-known limbs, high to low."""
+    lt = xp.zeros(va.shape[:-1], bool)
+    eq = xp.ones(va.shape[:-1], bool)
+    for i in reversed(range(LIMBS)):
+        lt = lt | (eq & (va[..., i] < vb[..., i]))
+        eq = eq & (va[..., i] == vb[..., i])
+    return lt, eq
+
+
+def _kb_eq(xp, p, A, B, C):
+    ka, va = A
+    kb, vb = B
+    conflict = ((ka & kb & (va ^ vb)) != 0).any(axis=-1)
+    both = _fully_known(xp, ka) & _fully_known(xp, kb)
+    must_true = both & ~conflict
+    return _bool_out(xp, p.wm, ka, conflict | must_true, must_true)
+
+
+def _kb_ult(xp, p, A, B, C):
+    both = _fully_known(xp, A[0]) & _fully_known(xp, B[0])
+    lt, _eq = _limb_ult(xp, A[1], B[1])
+    return _bool_out(xp, p.wm, A[0], both, lt)
+
+
+def _kb_ule(xp, p, A, B, C):
+    both = _fully_known(xp, A[0]) & _fully_known(xp, B[0])
+    lt, eq = _limb_ult(xp, A[1], B[1])
+    return _bool_out(xp, p.wm, A[0], both, lt | eq)
+
+
+def _kb_ite(xp, p, A, B, C):
+    ck = (A[0][..., 0] & 1) != 0
+    cv = (A[1][..., 0] & 1) != 0
+    kmj = B[0] & C[0] & ~(B[1] ^ C[1])
+    kvj = B[1] & kmj
+    then = (ck & cv)[:, None]
+    els = (ck & ~cv)[:, None]
+    km = xp.where(then, B[0], xp.where(els, C[0], kmj))
+    kv = xp.where(then, B[1], xp.where(els, C[1], kvj))
+    return km, kv
+
+
+def _mask_below(xp, n, like):
+    """Mask of bits strictly below ``n`` (scalar or per-row array),
+    broadcast against ``like``."""
+    base = xp.arange(LIMBS, dtype=xp.int32) * 32
+    n_arr = xp.asarray(n, dtype=xp.int32)
+    k = xp.clip(n_arr[..., None] - base, 0, 32)
+    one = _u32(xp, 1)
+    partial = (one << (k.astype(xp.uint32) & _u32(xp, 31))) - one
+    m = xp.where(k >= 32, _u32(xp, _ALL), partial)
+    return xp.zeros_like(like) + m
+
+
+def _shift_amount(xp, B):
+    """(fully-known?, clamped shift) — any amount >= 1024 acts as 1023."""
+    known = _fully_known(xp, B[0])
+    high = (B[1][..., 1:] != 0).any(axis=-1)
+    s = xp.where(high, _u32(xp, 1023), B[1][..., 0])
+    return known, xp.minimum(s, _u32(xp, 1023))
+
+
+def _limb_lshr(xp, v, s):
+    ls = (s >> _u32(xp, 5)).astype(xp.int32)
+    bs = (s & _u32(xp, 31))[:, None]
+    idx = xp.arange(LIMBS, dtype=xp.int32)[None, :] + ls[:, None]
+    z = xp.zeros_like(v)
+    v0 = xp.where(idx < LIMBS,
+                  xp.take_along_axis(v, xp.minimum(idx, LIMBS - 1), axis=-1),
+                  z)
+    idx1 = idx + 1
+    v1 = xp.where(idx1 < LIMBS,
+                  xp.take_along_axis(v, xp.minimum(idx1, LIMBS - 1), axis=-1),
+                  z)
+    back = (_u32(xp, 32) - bs) & _u32(xp, 31)
+    return (v0 >> bs) | xp.where(bs > 0, v1 << back, z)
+
+
+def _limb_shl(xp, v, s):
+    ls = (s >> _u32(xp, 5)).astype(xp.int32)
+    bs = (s & _u32(xp, 31))[:, None]
+    idx = xp.arange(LIMBS, dtype=xp.int32)[None, :] - ls[:, None]
+    z = xp.zeros_like(v)
+    v0 = xp.where(idx >= 0,
+                  xp.take_along_axis(v, xp.clip(idx, 0, LIMBS - 1), axis=-1),
+                  z)
+    idx1 = idx - 1
+    v1 = xp.where(idx1 >= 0,
+                  xp.take_along_axis(v, xp.clip(idx1, 0, LIMBS - 1), axis=-1),
+                  z)
+    back = (_u32(xp, 32) - bs) & _u32(xp, 31)
+    return (v0 << bs) | xp.where(bs > 0, v1 >> back, z)
+
+
+def _kb_shl(xp, p, A, B, C):
+    known, s = _shift_amount(xp, B)
+    zero = known & (s.astype(xp.int32) >= xp.asarray(p.w, dtype=xp.int32))
+    u_s = _limb_shl(xp, ~A[0], s)
+    km_s = (~u_s) | ~p.wm
+    kv_s = _limb_shl(xp, A[1], s) & p.wm & km_s
+    z = xp.zeros_like(A[0])
+    km = xp.where(zero[:, None], z + _u32(xp, _ALL),
+                  xp.where(known[:, None], km_s, z + (~p.wm)))
+    kv = xp.where(zero[:, None], z, xp.where(known[:, None], kv_s, z))
+    return km, kv
+
+
+def _lshr_pair(xp, p, A, known, s):
+    zero = known & (s.astype(xp.int32) >= xp.asarray(p.w, dtype=xp.int32))
+    u_s = _limb_lshr(xp, ~A[0], s)
+    km_s = (~u_s) | ~p.wm
+    kv_s = _limb_lshr(xp, A[1], s) & p.wm & km_s
+    z = xp.zeros_like(A[0])
+    km = xp.where(zero[:, None], z + _u32(xp, _ALL),
+                  xp.where(known[:, None], km_s, z + (~p.wm)))
+    kv = xp.where(zero[:, None], z, xp.where(known[:, None], kv_s, z))
+    return km, kv
+
+
+def _kb_lshr(xp, p, A, B, C):
+    known, s = _shift_amount(xp, B)
+    return _lshr_pair(xp, p, A, known, s)
+
+
+def _bit_at(xp, arr, pos):
+    li = xp.asarray(pos, dtype=xp.int32) >> 5
+    bi = (xp.asarray(pos, dtype=xp.uint32)) & _u32(xp, 31)
+    limb = xp.take(arr, li, axis=-1)
+    return ((limb >> bi) & 1) != 0
+
+
+def _kb_ashr(xp, p, A, B, C):
+    # sound only when the sign bit is provably 0 (then ashr == lshr,
+    # including the clamp-at-w-1 semantics: a >> (w-1) == 0 for sign-0 a)
+    sign_known_zero = (_bit_at(xp, A[0], p.w - 1)
+                       & ~_bit_at(xp, A[1], p.w - 1))
+    known, s = _shift_amount(xp, B)
+    km_s, kv_s = _lshr_pair(xp, p, A, known, s)
+    ok = sign_known_zero[:, None]
+    z = xp.zeros_like(A[0])
+    return xp.where(ok, km_s, z + (~p.wm)), xp.where(ok, kv_s, z)
+
+
+def _kb_concat(xp, p, A, B, C):
+    low = _mask_below(xp, p.wb, A[0])
+    s = xp.zeros(A[0].shape[:-1], xp.uint32) + _u32(xp, p.wb)
+    u_a = _limb_shl(xp, ~A[0], s)
+    km = ((~u_a) & ~low) | (B[0] & low) | ~p.wm
+    kv = ((_limb_shl(xp, A[1], s) & ~low) | (B[1] & low)) & p.wm & km
+    return km, kv
+
+
+def _kb_extract(xp, p, A, B, C):
+    s = xp.zeros(A[0].shape[:-1], xp.uint32) + _u32(xp, p.x1)
+    u_s = _limb_lshr(xp, ~A[0], s)
+    km = (~u_s) | ~p.wm
+    kv = _limb_lshr(xp, A[1], s) & p.wm & km
+    return km, kv
+
+
+def _kb_zext(xp, p, A, B, C):
+    return A  # bits above the old width are already known zero
+
+
+def _kb_sext(xp, p, A, B, C):
+    below = _mask_below(xp, p.wa, A[0])
+    sk = _bit_at(xp, A[0], p.wa - 1)[:, None]
+    sv = _bit_at(xp, A[1], p.wa - 1)[:, None]
+    ext = p.wm & ~below
+    z = xp.zeros_like(A[0])
+    km = (A[0] & below) | (~p.wm) | xp.where(sk, z + ext, z)
+    kv = ((A[1] & below) | xp.where(sk & sv, z + ext, z)) & km
+    return km, kv
+
+
+KB_KERNELS = {
+    OP_CONST: _kb_const,
+    OP_VAR: _kb_top,
+    OP_EQ: _kb_eq,
+    OP_AND: _kb_band,
+    OP_OR: _kb_bor,
+    OP_NOT: _kb_bnot,
+    OP_XOR: _kb_bxor,
+    OP_ITE: _kb_ite,
+    OP_ADD: _kb_add,
+    OP_SUB: _kb_sub,
+    OP_MUL: _kb_mul,
+    OP_UDIV: _kb_div_rem,
+    OP_UREM: _kb_div_rem,
+    OP_SDIV: _kb_top,
+    OP_SREM: _kb_top,
+    OP_BAND: _kb_band,
+    OP_BOR: _kb_bor,
+    OP_BXOR: _kb_bxor,
+    OP_BNOT: _kb_bnot,
+    OP_NEG: _kb_neg,
+    OP_SHL: _kb_shl,
+    OP_LSHR: _kb_lshr,
+    OP_ASHR: _kb_ashr,
+    OP_CONCAT: _kb_concat,
+    OP_EXTRACT: _kb_extract,
+    OP_ZEXT: _kb_zext,
+    OP_SEXT: _kb_sext,
+    OP_ULT: _kb_ult,
+    OP_ULE: _kb_ule,
+    OP_SLT: _kb_top,
+    OP_SLE: _kb_top,
+}
+
+
+def node_params(pack: PackedBatch, i: int) -> NodeParams:
+    a0, a1 = int(pack.a0[i]), int(pack.a1[i])
+    return NodeParams(
+        w=int(pack.w[i]),
+        x0=int(pack.x0[i]),
+        x1=int(pack.x1[i]),
+        wm=pack.wm[i],
+        cl=pack.c_limbs[i],
+        wa=int(pack.w[a0]) if a0 >= 0 else 0,
+        wb=int(pack.w[a1]) if a1 >= 0 else 0,
+    )
+
+
+def eval_kb_host(pack: PackedBatch) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Numpy known-bits pass: one loop over nodes, vectorized over rows."""
+    n, r = pack.n_nodes, pack.n_rows
+    km = np.zeros((n, r, LIMBS), U32)
+    kv = np.zeros((n, r, LIMBS), U32)
+    refuted = np.zeros(r, bool)
+    dummy = (np.zeros((r, LIMBS), U32), np.zeros((r, LIMBS), U32))
+
+    def child(j):
+        return (km[j], kv[j]) if j >= 0 else dummy
+
+    for i in range(n):
+        p = node_params(pack, i)
+        fn = KB_KERNELS.get(int(pack.op[i]), _kb_top)
+        k, v = fn(np, p, child(int(pack.a0[i])), child(int(pack.a1[i])),
+                  child(int(pack.a2[i])))
+        ov = pack.overrides.get(i)
+        if ov is not None:
+            _olo, _ohi, okm, okv = ov
+            refuted |= ((k & okm & (v ^ okv)) != 0).any(axis=-1)
+            k = k | okm
+            v = (v | okv) & k
+        km[i], kv[i] = k, v
+    return km, kv, refuted
+
+
+# ---------------------------------------------------------------------------
+# Interval pass (host-only: needs float64)
+# ---------------------------------------------------------------------------
+
+
+def _up(x):
+    return np.nextafter(x, _INF)
+
+
+def _dn(x):
+    return np.nextafter(x, -_INF)
+
+
+_WB_CACHE: Dict[int, Tuple[float, float, float, float]] = {}
+
+
+def _wbounds(w: int) -> Tuple[float, float, float, float]:
+    """(under(2^w-1), over(2^w-1), 2^w exact, 2^(w-1) exact) for width w."""
+    got = _WB_CACHE.get(w)
+    if got is None:
+        full = (1 << w) - 1
+        f = float(full)
+        fu = f if int(f) <= full else float(np.nextafter(f, -_INF))
+        fo = f if int(f) >= full else float(np.nextafter(f, _INF))
+        got = (fu, fo, float(1 << w), float(1 << (w - 1)) if w else 0.5)
+        _WB_CACHE[w] = got
+    return got
+
+
+def eval_iv_host(pack: PackedBatch) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Numpy interval pass: one loop over nodes, vectorized over rows."""
+    n, r = pack.n_nodes, pack.n_rows
+    lo = np.zeros((n, r), np.float64)
+    hi = np.zeros((n, r), np.float64)
+    refuted = np.zeros(r, bool)
+    W = np.where
+
+    # top*top at 512 bits overflows float64 to inf; the wrap guards
+    # (`ph <= fu`) treat that as "widen to full range", which is sound —
+    # silence the transient overflow/invalid warnings
+    with np.errstate(all="ignore"):
+        return _eval_iv_loop(pack, lo, hi, refuted, W)
+
+
+def _eval_iv_loop(pack, lo, hi, refuted, W):
+    n, r = pack.n_nodes, pack.n_rows
+    for i in range(n):
+        op = int(pack.op[i])
+        w = int(pack.w[i])
+        a0, a1, a2 = int(pack.a0[i]), int(pack.a1[i]), int(pack.a2[i])
+        fu, fo, p2, half = _wbounds(w)
+        if op == OP_CONST:
+            l_, h_ = np.full(r, pack.c_lo[i]), np.full(r, pack.c_hi[i])
+        elif op == OP_VAR:
+            l_, h_ = np.zeros(r), np.full(r, fo)
+        else:
+            la, ha = lo[a0], hi[a0]
+            lb = lo[a1] if a1 >= 0 else None
+            hb = hi[a1] if a1 >= 0 else None
+            if op == OP_EQ:
+                mf = (ha < lb) | (hb < la)
+                mt = (la == ha) & (lb == hb) & (la == lb)
+                l_, h_ = W(mt, 1.0, 0.0), W(mf, 0.0, 1.0)
+            elif op == OP_AND:
+                l_, h_ = np.minimum(la, lb), np.minimum(ha, hb)
+            elif op == OP_OR:
+                l_, h_ = np.maximum(la, lb), np.maximum(ha, hb)
+            elif op == OP_NOT:
+                l_, h_ = 1.0 - ha, 1.0 - la
+            elif op == OP_XOR:
+                pinned = (la == ha) & (lb == hb)
+                v = ((la >= 0.5) != (lb >= 0.5)).astype(np.float64)
+                l_, h_ = W(pinned, v, 0.0), W(pinned, v, 1.0)
+            elif op == OP_ITE:
+                lt, ht = lo[a1], hi[a1]
+                le, he = lo[a2], hi[a2]
+                ct, cf = la >= 1.0, ha <= 0.0
+                l_ = W(ct, lt, W(cf, le, np.minimum(lt, le)))
+                h_ = W(ct, ht, W(cf, he, np.maximum(ht, he)))
+            elif op == OP_ADD:
+                sh = _up(ha + hb)
+                nw = sh <= fu
+                l_, h_ = W(nw, _dn(la + lb), 0.0), W(nw, sh, fo)
+            elif op == OP_SUB:
+                nw = la >= hb
+                l_, h_ = W(nw, _dn(la - hb), 0.0), W(nw, _up(ha - lb), fo)
+            elif op == OP_MUL:
+                ph = _up(ha * hb)
+                nw = ph <= fu
+                l_, h_ = W(nw, _dn(la * lb), 0.0), W(nw, ph, fo)
+            elif op == OP_UDIV:
+                l_ = np.zeros(r)
+                h_ = W(lb >= 1.0, _up(ha / np.maximum(lb, 1.0)), ha)
+            elif op == OP_UREM:
+                l_ = np.zeros(r)
+                h_ = W(lb >= 1.0, np.minimum(ha, hb), ha)
+            elif op == OP_BAND:
+                l_, h_ = np.zeros(r), np.minimum(ha, hb)
+            elif op == OP_BOR:
+                l_ = np.maximum(la, lb)
+                h_ = np.minimum(fo, _up(ha + hb))
+            elif op == OP_BXOR:
+                l_, h_ = np.zeros(r), np.minimum(fo, _up(ha + hb))
+            elif op == OP_BNOT:
+                l_, h_ = _dn(fu - ha), _up(fo - la)
+            elif op == OP_NEG:
+                l_ = W(la >= 1.0, _dn(p2 - ha), 0.0)
+                h_ = np.minimum(fo, W(ha <= 0.0, 0.0, _up(p2 - la)))
+            elif op in (OP_SHL, OP_LSHR, OP_ASHR):
+                sk = lb == hb  # shift amount pinned to one (exact) float
+                k = np.minimum(lb, 1100.0)
+                pw = np.power(2.0, k)
+                big = lb >= float(w)
+                if op == OP_SHL:
+                    ph = _up(ha * pw)
+                    nw = ph <= fu
+                    l_ = W(sk, W(big, 0.0, W(nw, _dn(la * pw), 0.0)), 0.0)
+                    h_ = W(sk, W(big, 0.0, W(nw, ph, fo)), fo)
+                else:
+                    shr_l = np.maximum(0.0, _dn(la / pw) - 1.0)
+                    shr_h = np.minimum(_up(ha / pw), ha)
+                    ok = sk & ((op == OP_LSHR) | (ha < half))
+                    l_ = W(ok, W(big, 0.0, shr_l), 0.0)
+                    h_ = W(ok, W(big, 0.0, shr_h), fo)
+            elif op == OP_CONCAT:
+                pwl = float(1 << int(pack.w[a1]))
+                l_ = _dn(la * pwl + lb)
+                h_ = _up(ha * pwl + hb)
+            elif op == OP_EXTRACT:
+                hi_bit, lo_bit = int(pack.x0[i]), int(pack.x1[i])
+                in_range = ha < float(1 << (hi_bit + 1))
+                if lo_bit == 0:
+                    l_, h_ = W(in_range, la, 0.0), W(in_range, ha, fo)
+                else:
+                    plo = float(1 << lo_bit)
+                    l_ = W(in_range, np.maximum(0.0, _dn(la / plo) - 1.0), 0.0)
+                    h_ = W(in_range, _up(ha / plo), fo)
+            elif op == OP_ZEXT:
+                l_, h_ = la, ha
+            elif op == OP_SEXT:
+                in_half = _wbounds(int(pack.w[a0]))[3]
+                pos = ha < in_half
+                l_, h_ = W(pos, la, 0.0), W(pos, ha, fo)
+            elif op == OP_ULT:
+                l_, h_ = W(ha < lb, 1.0, 0.0), W(la >= hb, 0.0, 1.0)
+            elif op == OP_ULE:
+                l_, h_ = W(ha <= lb, 1.0, 0.0), W(la > hb, 0.0, 1.0)
+            else:  # SDIV/SREM/SLT/SLE and anything unmodeled: top
+                l_, h_ = np.zeros(r), np.full(r, fo)
+
+        l_ = np.maximum(l_, 0.0)
+        h_ = np.minimum(h_, fo)
+        ov = pack.overrides.get(i)
+        if ov is not None:
+            olo, ohi, _okm, _okv = ov
+            l_ = np.maximum(l_, olo)
+            h_ = np.minimum(h_, ohi)
+            refuted |= l_ > h_
+            h_ = np.maximum(h_, l_)
+        lo[i], hi[i] = l_, h_
+    return lo, hi, refuted
+
+
+# ---------------------------------------------------------------------------
+# Verdicts
+# ---------------------------------------------------------------------------
+
+_WEIGHTS = 2.0 ** (32.0 * np.arange(LIMBS, dtype=np.float64))
+
+
+def verdicts(pack: PackedBatch, lo: np.ndarray, hi: np.ndarray,
+             km: np.ndarray, kv: np.ndarray,
+             refuted: np.ndarray) -> np.ndarray:
+    """Combine both domains into one UNSAT-proof bit per row.
+
+    A row is refuted when (a) its harvested narrowings were contradictory,
+    (b) any node's interval/known-bits elements have empty intersection, or
+    (c) any of its asserted roots is must-false in either domain.
+    """
+    # cross-domain consistency: the kb element bounds the value from below
+    # (unknown bits zero) and above (unknown bits one); 16 float adds cost
+    # at most 16 ulps, widened outward before comparing
+    lo_kb = (kv.astype(np.float64) * _WEIGHTS).sum(axis=-1)
+    hi_bits = kv | (~km & pack.wm[:, None, :])
+    hi_kb = (hi_bits.astype(np.float64) * _WEIGHTS).sum(axis=-1)
+    lo_kb = lo_kb - 16.0 * np.spacing(lo_kb)
+    hi_kb = hi_kb + 16.0 * np.spacing(hi_kb)
+    cross = (lo_kb > hi) | (hi_kb < lo)
+    out = refuted | cross.any(axis=0) | pack.row_refuted
+
+    # exact re-check of every harvested demand: float64 cannot separate
+    # 2^w - 1 from 2^w, but the known-bits element and the harvested range
+    # are both exact integers, so compare them as such
+    for node, entries in pack.ov_exact.items():
+        wm_int = 0
+        for li in range(LIMBS):
+            wm_int |= int(pack.wm[node, li]) << (32 * li)
+        for row, lo_i, hi_i in entries:
+            if out[row]:
+                continue
+            kv_i = 0
+            km_i = 0
+            for li in range(LIMBS):
+                kv_i |= int(kv[node, row, li]) << (32 * li)
+                km_i |= int(km[node, row, li]) << (32 * li)
+            hi_kb_i = kv_i | (~km_i & wm_int)
+            if hi_kb_i < lo_i or kv_i > hi_i:
+                out[row] = True
+
+    must_false = (hi < 0.5) | (((km[..., 0] & 1) != 0) & ((kv[..., 0] & 1) == 0))
+    for r in range(pack.n_rows):
+        if out[r]:
+            continue
+        roots = pack.row_roots[r]
+        if roots and must_false[roots, r].any():
+            out[r] = True
+    return out

@@ -242,6 +242,7 @@ class Report:
         from mythril_tpu.observability import observability_meta
 
         meta["observability"] = observability_meta()
+        meta["prefilter"] = _prefilter_meta()
         result = [
             {
                 "issues": sorted(_issues, key=lambda k: k["swcID"]),
@@ -252,6 +253,22 @@ class Report:
             }
         ]
         return json.dumps(result, sort_keys=True)
+
+
+def _prefilter_meta() -> dict:
+    """Abstract pre-filter rollup for report ``meta`` (kill-rate at a
+    glance; the full counter set lives under meta.observability)."""
+    from mythril_tpu.observability import get_registry
+
+    reg = get_registry()
+    evaluated = reg.counter("prefilter.evaluated").value or 0
+    killed = reg.counter("prefilter.killed").value or 0
+    return {
+        "evaluated": evaluated,
+        "killed": killed,
+        "fallthrough": reg.counter("prefilter.fallthrough").value or 0,
+        "kill_rate": round(killed / evaluated, 4) if evaluated else 0.0,
+    }
 
 
 def _swc_title(swc_id: str) -> str:

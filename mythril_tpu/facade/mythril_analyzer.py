@@ -50,6 +50,7 @@ class AnalyzerArgs:
     query_cache_dir: Optional[str] = None
     staticpass: bool = True
     pipeline: bool = True
+    prefilter: bool = True
     frontier_mesh: bool = True
     solver_workers: int = 2
     harvest_workers: int = 4

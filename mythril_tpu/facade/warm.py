@@ -87,6 +87,7 @@ def apply_analyzer_args(cmd_args) -> None:
     args.query_cache = getattr(cmd_args, "query_cache", True)
     args.staticpass = getattr(cmd_args, "staticpass", True)
     args.pipeline = getattr(cmd_args, "pipeline", True)
+    args.prefilter = getattr(cmd_args, "prefilter", True)
     args.frontier_mesh = getattr(cmd_args, "frontier_mesh", True)
     args.solver_workers = getattr(cmd_args, "solver_workers", 2)
     args.harvest_workers = getattr(cmd_args, "harvest_workers", 4)

@@ -1518,12 +1518,22 @@ class FrontierEngine:
             # mirrors the solver fast path's canonical identity, so the
             # pool dedups in-flight twins and the worker hits the query
             # cache for everything already decided.
-            for slot, rec, n_cons, raws in todo:
+            # abstract pre-filter: one vectorized pass over the whole
+            # batch of rows; a proven-UNSAT verdict skips the worker and
+            # is published through the pool's normal done-queue so the
+            # existing rollback machinery (apply_verdicts) kills the path
+            kills = [False] * len(todo)
+            if getattr(args, "prefilter", True):
+                from mythril_tpu.absdomain import prefilter_batch
+
+                kills = prefilter_batch([raws for _, _, _, raws in todo])
+            for (slot, rec, n_cons, raws), killed in zip(todo, kills):
                 rec._submitted_at = n_cons
                 pipe.pool.submit(
                     slot, rec, n_cons, raws,
                     frozenset(t.tid for t in raws),
                     sid=getattr(pipe, "current_sid", -1),
+                    verdict=False if killed else None,
                 )
             return
         # harvest feasibility is one of the query cache's three entry points

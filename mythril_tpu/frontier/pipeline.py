@@ -225,7 +225,25 @@ class FeasibilityPool:
         self._done: list = []
 
     def submit(self, slot: int, rec, n_cons: int, raws, key: frozenset,
-               sid: int = -1) -> None:
+               sid: int = -1, verdict: Optional[bool] = None) -> None:
+        """Queue a feasibility check.  ``verdict=False`` means the abstract
+        pre-filter already PROVED the query UNSAT: no worker runs, and the
+        verdict is published to EVERY waiter deduplicated under ``key`` —
+        including ones already in flight, so concurrent identical lineages
+        never fall through to an exact solve the pre-filter refuted."""
+        if verdict is False:
+            with self._lock:
+                waiters = self._inflight.get(key)
+                if waiters is not None:
+                    waiters.append((slot, rec, n_cons))
+                    _pc("pool_inflight_dedup").inc()
+                else:
+                    self._inflight[key] = [(slot, rec, n_cons)]
+                # drain() tolerates a second (key, ok) entry for a query a
+                # worker also finishes: the later pop finds nothing
+                self._done.append((key, False))
+            _pc("pool_prefilter_kills").inc()
+            return
         with self._lock:
             waiters = self._inflight.get(key)
             if waiters is not None:

@@ -162,6 +162,15 @@ def _add_analysis_options(parser) -> None:
         "segment/harvest loop; the issue set is identical either way",
     )
     group.add_argument(
+        "--no-prefilter",
+        action="store_false",
+        dest="prefilter",
+        default=True,
+        help="disable the abstract feasibility pre-filter (vectorized "
+        "interval + known-bits pass ahead of the solver pool); the "
+        "issue set is identical either way",
+    )
+    group.add_argument(
         "--no-mesh",
         action="store_false",
         dest="frontier_mesh",
@@ -565,6 +574,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         query_cache_dir=getattr(parsed, "query_cache_dir", None),
         staticpass=not getattr(parsed, "no_staticpass", False),
         pipeline=getattr(parsed, "pipeline", True),
+        prefilter=getattr(parsed, "prefilter", True),
         frontier_mesh=getattr(parsed, "frontier_mesh", True),
         solver_workers=getattr(parsed, "solver_workers", 2),
         harvest_workers=getattr(parsed, "harvest_workers", 4),

@@ -31,6 +31,7 @@ side delivered a request's first evidence.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import threading
@@ -116,6 +117,14 @@ class AnalysisService:
         self._c_device_wins = reg.counter("service.device_wins", persistent=True)
         self._c_probe_runs = reg.counter("service.probe_runs", persistent=True)
         self._h_probe = reg.histogram("service.probe_s", persistent=True)
+        # per-analysis prefilter.* counters are scope-reset between batches;
+        # these persistent mirrors accumulate their deltas for stats()/top
+        self._c_pf_eval = reg.counter(
+            "service.prefilter_evaluated", persistent=True
+        )
+        self._c_pf_kill = reg.counter(
+            "service.prefilter_killed", persistent=True
+        )
         self.telemetry = RequestTelemetry(request_log=self.config.request_log)
 
     # -- lifecycle -----------------------------------------------------
@@ -270,8 +279,17 @@ class AnalysisService:
             "service.admitted", "service.batches", "service.streamed_issues",
             "service.request_errors", "service.probe_wins",
             "service.device_wins", "service.probe_runs",
+            "service.prefilter_evaluated", "service.prefilter_killed",
         ):
             out[name] = reg.counter(name, persistent=True).snapshot()
+        pf_eval = out["service.prefilter_evaluated"] or 0
+        out["prefilter"] = {
+            "evaluated": pf_eval,
+            "killed": out["service.prefilter_killed"] or 0,
+            "kill_rate": round(
+                (out["service.prefilter_killed"] or 0) / pf_eval, 4
+            ) if pf_eval else 0.0,
+        }
         requests = out["service.requests"] or 0
         out["cache"] = {
             "dedup_hit_rate": round(out["service.dedup_hits"] / requests, 4)
@@ -364,6 +382,23 @@ class AnalysisService:
 
         return _sink
 
+    @contextlib.contextmanager
+    def _account_prefilter(self):
+        """Fold this scope's abstract pre-filter activity into the
+        persistent service mirrors (the scoped counters reset per batch)."""
+        reg = get_registry()
+        e0 = reg.counter("prefilter.evaluated").value
+        k0 = reg.counter("prefilter.killed").value
+        try:
+            yield
+        finally:
+            de = reg.counter("prefilter.evaluated").value - e0
+            dk = reg.counter("prefilter.killed").value - k0
+            if de > 0:
+                self._c_pf_eval.inc(de)
+            if dk > 0:
+                self._c_pf_kill.inc(dk)
+
     def _run_batch(self, batch: List[Flight]) -> None:
         from mythril_tpu.analysis.cooperative import run_cooperative_batch
         from mythril_tpu.analysis.module.base import set_issue_sink
@@ -402,16 +437,17 @@ class AnalysisService:
                 self._make_sink(by_hash, streamed, "device", sink_lock)
             )
             try:
-                issues_by_name, errors_by_name, _states = run_cooperative_batch(
-                    [(f.codehash, f.requests[0].code) for f in batch],
-                    transaction_count=opts.transaction_count,
-                    modules=list(opts.modules) if opts.modules else None,
-                    strategy=opts.strategy,
-                    execution_timeout=opts.execution_timeout,
-                    isolate_errors=True,
-                    request_tags=request_ids,
-                    request_flow_cb=flow_cb,
-                )
+                with self._account_prefilter():
+                    issues_by_name, errors_by_name, _states = run_cooperative_batch(
+                        [(f.codehash, f.requests[0].code) for f in batch],
+                        transaction_count=opts.transaction_count,
+                        modules=list(opts.modules) if opts.modules else None,
+                        strategy=opts.strategy,
+                        execution_timeout=opts.execution_timeout,
+                        isolate_errors=True,
+                        request_tags=request_ids,
+                        request_flow_cb=flow_cb,
+                    )
             finally:
                 set_issue_sink(prev_sink)
             self._stamp_batch(batch, "execute1", "stream")
@@ -529,7 +565,10 @@ class AnalysisService:
             with _otrace.span(
                 "service.probe", cat="service",
                 request=flight.requests[0].request_id,
-            ):
+            ), self._account_prefilter():
+                # quick triage: the abstract pre-filter sits in the solver
+                # fast path, so the host-first probe gets its near-free
+                # UNSAT verdicts before any exact solve
                 run_cooperative_batch(
                     [(flight.codehash, flight.requests[0].code)],
                     transaction_count=1,

@@ -56,6 +56,16 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         )
     )
 
+    prefilter = stats.get("prefilter") or {}
+    if prefilter.get("evaluated"):
+        lines.append(
+            "prefilter: {e} evaluated  {k} killed  ({r:.0%} kill rate)".format(
+                e=prefilter.get("evaluated", 0),
+                k=prefilter.get("killed", 0),
+                r=prefilter.get("kill_rate", 0.0),
+            )
+        )
+
     phases = stats.get("phases") or {}
     if any((phases.get(p) or {}).get("count") for p in _PHASE_ORDER):
         lines.append("")

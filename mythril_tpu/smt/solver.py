@@ -1307,6 +1307,23 @@ def check_satisfiable_batch(
             if not pending:
                 break
 
+    # Abstract pre-filter over the residue: one vectorized interval +
+    # known-bits pass proves many sets UNSAT without bit-blasting; the
+    # verdict is sound (bottom-by-abstraction), so it is remembered like
+    # any exact UNSAT and the set never reaches the probe stack.
+    if pending and getattr(global_args, "prefilter", True):
+        from mythril_tpu.absdomain import prefilter_batch
+
+        killed = prefilter_batch([conj for _i, conj, _k in pending])
+        still = []
+        for (i, conj, key), dead in zip(pending, killed):
+            if dead:
+                results[i] = False
+                _model_cache.remember(key, UNSAT, None)
+            else:
+                still.append((i, conj, key))
+        pending = still
+
     # The merged-dispatch path pays off only when it amortizes over enough
     # sets: a 2-sibling JUMPI fork is cheaper through the per-set stack
     # (model-cache reuse solves the prefix; repair + CDCL finish the flip),
@@ -1556,6 +1573,19 @@ def _solve_conjunction_impl(
                     _model_cache.remember(cache_key, SAT, asg)
                 stats.inc("solver_time", time.perf_counter() - t0)
                 return SAT, asg
+
+    # tier 0.58: abstract pre-filter (interval + known-bits over the packed
+    # tape) — same bottom-by-abstraction soundness as the tiers below but
+    # memoized under the canonical key, so one-shot runs and detection
+    # confirmation queries share verdicts with the frontier gate
+    if getattr(global_args, "prefilter", True):
+        from mythril_tpu.absdomain import refute as _abs_refute
+
+        if _abs_refute(conjuncts):
+            if use_cache:
+                _model_cache.remember(cache_key, UNSAT, None)
+            stats.inc("solver_time", time.perf_counter() - t0)
+            return UNSAT, None
 
     # tier 0.6: interval-bound refutation — exact UNSAT for range-impossible
     # demands (a loop-exit path pinning cnt<=1 conjoined with an overflow

@@ -87,6 +87,11 @@ class Args:
     # feasibility pool.  Issue-set-identical to the synchronous loop;
     # --no-pipeline is the escape hatch (and the parity baseline)
     pipeline: bool = True
+    # abstract feasibility pre-filter (mythril_tpu/absdomain): vectorized
+    # interval + known-bits pass ahead of the feasibility pool and the
+    # solver fast path.  Sound (UNSAT verdicts only), issue-set-identical;
+    # --no-prefilter is the escape hatch (and the parity baseline)
+    prefilter: bool = True
     # feasibility-pool worker threads (solves share one lock — the win is
     # moving solve latency off the harvest critical path, not parallelism)
     solver_workers: int = 2

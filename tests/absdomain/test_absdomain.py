@@ -1,0 +1,193 @@
+"""Unit tests for the abstract feasibility pre-filter.
+
+Every ``refute(...) is True`` case here is a conjunction with NO concrete
+model; every ``is False`` case has one.  The filter may always say False
+(fall through), so the sat-side assertions are the load-bearing soundness
+checks and the unsat-side ones pin the precision the integration relies on.
+"""
+
+import pytest
+
+from mythril_tpu import absdomain
+from mythril_tpu.observability import get_registry
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.terms import (
+    add, band, concat2, const, eq, land, lnot, mul, udiv, ult, ule, var, zext,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memo():
+    absdomain.reset_state()
+    yield
+    absdomain.reset_state()
+
+
+def _v(name, w=256):
+    return var(name, w)
+
+
+class TestRefutes:
+    def test_eq_two_different_constants(self):
+        x = _v("pf_x1")
+        assert absdomain.refute([eq(x, const(5, 256)), eq(x, const(6, 256))])
+
+    def test_range_contradiction(self):
+        x = _v("pf_x2")
+        assert absdomain.refute([ult(x, const(10, 256)),
+                                 eq(x, const(20, 256))])
+
+    def test_flagship_mul_overflow_demand(self):
+        # cnt <= 1 and cnt * value >= 2**256 - epsilon: the classic
+        # loop-exit overflow confirmation demand.  float64 cannot even
+        # represent the threshold; the known-bits leading-zero rule can.
+        cnt = _v("pf_cnt")
+        value = _v("pf_val")
+        prod = mul(zext(cnt, 256), zext(value, 256))  # 512-bit product
+        thr = const((1 << 256), 512)
+        assert absdomain.refute([
+            ule(cnt, const(1, 256)),
+            lnot(ult(prod, thr)),
+        ])
+
+    def test_mul_overflow_not_refuted_when_possible(self):
+        # cnt <= 2 CAN overflow (2 * 2**255 == 2**256): must fall through
+        cnt = _v("pf_cnt3")
+        value = _v("pf_val3")
+        prod = mul(zext(cnt, 256), zext(value, 256))
+        thr = const((1 << 256), 512)
+        assert not absdomain.refute([
+            ule(cnt, const(2, 256)),
+            lnot(ult(prod, thr)),
+        ])
+
+    def test_add_leading_zeros(self):
+        # a < 2**16, b < 2**16  =>  a + b < 2**17, never >= 2**200
+        a, b = _v("pf_a4"), _v("pf_b4")
+        s = add(a, b)
+        assert absdomain.refute([
+            ult(a, const(1 << 16, 256)),
+            ult(b, const(1 << 16, 256)),
+            lnot(ult(s, const(1 << 200, 256))),
+        ])
+
+    def test_udiv_bounded_by_dividend(self):
+        # x < 100  =>  x / d < 100 for every d (EVM div-by-zero is 0)
+        x, d = _v("pf_x5"), _v("pf_d5")
+        q = udiv(x, d)
+        assert absdomain.refute([
+            ult(x, const(100, 256)),
+            lnot(ult(q, const(100, 256))),
+        ])
+
+    def test_big_const_equality(self):
+        # two adjacent 256-bit constants float64 cannot tell apart
+        big = (1 << 256) - 1
+        x = _v("pf_x6")
+        assert absdomain.refute([eq(x, const(big, 256)),
+                                 eq(x, const(big - 1, 256))])
+
+    def test_const_false_conjunct(self):
+        assert absdomain.refute([terms.false()])
+
+    def test_bitmask_contradiction(self):
+        # x & 1 == 1 pins bit0; x == 0 contradicts via known bits
+        x = _v("pf_x7")
+        assert absdomain.refute([
+            eq(band(x, const(1, 256)), const(1, 256)),
+            eq(x, const(0, 256)),
+        ])
+
+
+class TestNonRefutes:
+    def test_satisfiable_range(self):
+        x = _v("pf_y1")
+        assert not absdomain.refute([ult(x, const(10, 256)),
+                                     eq(x, const(5, 256))])
+
+    def test_top_var(self):
+        assert not absdomain.refute([eq(_v("pf_y2"), _v("pf_y3"))])
+
+    def test_tautology(self):
+        x = _v("pf_y4")
+        assert not absdomain.refute([ule(x, x)])
+
+    def test_conjunction_of_independents(self):
+        x, y = _v("pf_y5"), _v("pf_y6")
+        assert not absdomain.refute([
+            ult(x, const(100, 256)),
+            lnot(ult(y, const(100, 256))),
+        ])
+
+
+class TestBatchAPI:
+    def test_per_row_verdicts(self):
+        x = _v("pf_b1")
+        sat_row = [ult(x, const(10, 256))]
+        unsat_row = [ult(x, const(10, 256)), eq(x, const(20, 256))]
+        assert absdomain.prefilter_batch([sat_row, unsat_row, sat_row]) == [
+            False, True, False,
+        ]
+
+    def test_memo_skips_reevaluation(self):
+        reg = get_registry()
+        x = _v("pf_b2")
+        row = [ult(x, const(10, 256)), eq(x, const(20, 256))]
+        before = reg.counter("prefilter.evaluated").value or 0
+        assert absdomain.refute(row)
+        mid = reg.counter("prefilter.evaluated").value
+        assert absdomain.refute(row)  # memo hit: uncounted
+        assert reg.counter("prefilter.evaluated").value == mid
+        assert mid == before + 1
+
+    def test_duplicate_rows_in_one_batch_evaluate_once(self):
+        reg = get_registry()
+        x = _v("pf_b3")
+        row = [eq(x, const(5, 256)), eq(x, const(6, 256))]
+        before = reg.counter("prefilter.evaluated").value or 0
+        assert absdomain.prefilter_batch([row, list(row)]) == [True, True]
+        assert reg.counter("prefilter.evaluated").value == before + 1
+
+    def test_counters_move(self):
+        reg = get_registry()
+        x = _v("pf_b4")
+        k0 = reg.counter("prefilter.killed").value or 0
+        assert absdomain.refute([eq(x, const(1, 256)), eq(x, const(2, 256))])
+        assert reg.counter("prefilter.killed").value == k0 + 1
+
+
+class TestFallthrough:
+    def test_oversized_width_falls_through(self):
+        # 1024-bit node: wider than the 512-bit limb budget
+        a = var("pf_f1", 512)
+        wide = concat2(a, a)
+        reg = get_registry()
+        f0 = reg.counter("prefilter.fallthrough").value or 0
+        assert not absdomain.refute([eq(wide, const(0, 1024))])
+        assert reg.counter("prefilter.fallthrough").value == f0 + 1
+
+    def test_poisoned_row_does_not_sink_siblings(self):
+        # row 0 unsupported, row 1 refutable: batch still kills row 1
+        a = var("pf_f2", 512)
+        wide = [eq(concat2(a, a), const(0, 1024))]
+        x = _v("pf_f3")
+        bad = [eq(x, const(5, 256)), eq(x, const(6, 256))]
+        assert absdomain.prefilter_batch([wide, bad]) == [False, True]
+
+    def test_unsat_verdict_survives_reset_only_via_reeval(self):
+        x = _v("pf_f4")
+        row = [eq(x, const(5, 256)), eq(x, const(6, 256))]
+        assert absdomain.refute(row)
+        absdomain.reset_state()
+        reg = get_registry()
+        before = reg.counter("prefilter.evaluated").value or 0
+        assert absdomain.refute(row)  # fresh evaluation after reset
+        assert reg.counter("prefilter.evaluated").value == before + 1
+
+
+class TestLand:
+    def test_nested_and_is_harvested(self):
+        x = _v("pf_l1")
+        assert absdomain.refute([
+            land(ult(x, const(10, 256)), eq(x, const(20, 256))),
+        ])

@@ -1,0 +1,46 @@
+"""Device known-bits interpreter must match host numpy bit for bit."""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.absdomain import device, domains, tape
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.terms import add, band, const, eq, lnot, mul, ult, ule, var, zext
+
+
+def _rows():
+    x = var("pfdev_x", 256)
+    y = var("pfdev_y", 256)
+    prod = mul(zext(x, 256), zext(y, 256))
+    return [
+        [ult(x, const(10, 256)), eq(x, const(20, 256))],
+        [ule(x, const(1, 256)), lnot(ult(prod, const(1 << 256, 512)))],
+        [eq(band(x, const(0xFF, 256)), const(0x42, 256)),
+         ult(add(x, y), const(1 << 128, 256))],
+    ]
+
+
+@pytest.mark.slow
+def test_device_matches_host_bit_for_bit():
+    pack = tape.pack(_rows())
+    h_km, h_kv, h_ref = domains.eval_kb_host(pack)
+    device.warmup()
+    assert device.interpreter_ready()
+    d_km, d_kv, d_ref = device.run_kb(pack)
+    np.testing.assert_array_equal(h_km, np.asarray(d_km))
+    np.testing.assert_array_equal(h_kv, np.asarray(d_kv))
+    np.testing.assert_array_equal(h_ref, np.asarray(d_ref))
+
+
+@pytest.mark.slow
+def test_device_verdicts_match_host():
+    pack = tape.pack(_rows())
+    lo, hi, iv_ref = domains.eval_iv_host(pack)
+    h_km, h_kv, h_ref = domains.eval_kb_host(pack)
+    device.warmup()
+    d_km, d_kv, d_ref = device.run_kb(pack)
+    v_host = domains.verdicts(pack, lo, hi, h_km, h_kv, iv_ref | h_ref)
+    v_dev = domains.verdicts(pack, lo, hi, np.asarray(d_km),
+                             np.asarray(d_kv), iv_ref | np.asarray(d_ref))
+    np.testing.assert_array_equal(v_host, v_dev)
+    assert v_host[0] and v_host[1]  # both contradictions still refute

@@ -1,0 +1,34 @@
+"""Kill-rate surfacing: ``myth top`` line and report ``meta.prefilter``."""
+
+from mythril_tpu import absdomain
+from mythril_tpu.observability import get_registry
+from mythril_tpu.service.top import format_top
+from mythril_tpu.smt import terms
+
+
+def test_format_top_renders_prefilter_line():
+    stats = {
+        "service.queue_depth": 0,
+        "prefilter": {"evaluated": 40, "killed": 10, "kill_rate": 0.25},
+    }
+    out = format_top(stats)
+    assert "prefilter: 40 evaluated  10 killed  (25% kill rate)" in out
+
+
+def test_format_top_omits_prefilter_line_when_idle():
+    assert "prefilter" not in format_top({"service.queue_depth": 0})
+
+
+def test_report_meta_prefilter_rollup():
+    from mythril_tpu.analysis.report import _prefilter_meta
+
+    absdomain.reset_state()
+    get_registry().reset(prefix="prefilter.")
+    x = terms.var("pfsurf_x", 256)
+    assert absdomain.refute([terms.eq(x, terms.const(1, 256)),
+                             terms.eq(x, terms.const(2, 256))])
+    assert not absdomain.refute([terms.ult(x, terms.const(10, 256))])
+    meta = _prefilter_meta()
+    assert meta == {"evaluated": 2, "killed": 1, "fallthrough": 0,
+                    "kill_rate": 0.5}
+    absdomain.reset_state()
