@@ -148,6 +148,10 @@ def _harvest_row(conjuncts: Sequence[Term],
         _harvest(c, True, narrow)
 
 
+def _signed(v: int, w: int) -> int:
+    return v - (1 << w) if v >= (1 << (w - 1)) else v
+
+
 def _harvest(t: Term, want: bool, narrow) -> None:
     op = t.op
     if op == "const" and t.sort is terms.BOOL:
@@ -158,11 +162,34 @@ def _harvest(t: Term, want: bool, narrow) -> None:
         for a in t.args:
             _harvest(a, True, narrow)
         return
+    if op == "or" and not want:
+        # De Morgan: Not(a | b | ...) == Not(a) & Not(b) & ...
+        for a in t.args:
+            _harvest(a, False, narrow)
+        return
     if op == "not":
         _harvest(t.args[0], not want, narrow)
         return
+    if op == "xor":
+        # boolean xor against a constant is (possibly negated) assertion
+        # of the other side: x ^ true == Not(x)
+        a, b = t.args
+        if a.sort is terms.BOOL:
+            if a.op == "const":
+                _harvest(b, want != bool(a.aux), narrow)
+            elif b.op == "const":
+                _harvest(a, want != bool(b.aux), narrow)
+        return
     if op == "eq":
         a, b = t.args
+        if a.sort is terms.BOOL:
+            # boolean equality against a constant asserts the other side
+            # (negated for eq(x, false) / Not(eq(x, true)))
+            if a.op == "const":
+                _harvest(b, want == bool(a.aux), narrow)
+            elif b.op == "const":
+                _harvest(a, want == bool(b.aux), narrow)
+            return
         if not terms.is_bv_sort(a.sort):
             return
         if want:
@@ -185,6 +212,33 @@ def _harvest(t: Term, want: bool, narrow) -> None:
                 narrow(a, b.value + (0 if strict else 1), (1 << a.width) - 1)
             elif a.is_const and not b.is_const:
                 narrow(b, 0, a.value - (0 if strict else 1))
+        return
+    if op in ("slt", "sle"):
+        # signed comparisons pin one side only when the satisfying set is
+        # a single unsigned interval (the two's-complement wraparound
+        # splits the other polarity into a union the domain cannot hold)
+        a, b = t.args
+        strict = op == "slt"
+        if not want:
+            # Not(a <s b) == b <=s a ; Not(a <=s b) == b <s a
+            a, b = b, a
+            strict = not strict
+        if not terms.is_bv_sort(a.sort):
+            return
+        w = a.width
+        half, full = 1 << (w - 1), 1 << w
+        if b.is_const and not a.is_const:
+            # signed(a) < upper (strict normal form)
+            upper = _signed(b.value, w) + (0 if strict else 1)
+            if upper <= 0:
+                # wholly inside the negative half: [half, upper-1 mod 2^w]
+                narrow(a, half, (upper - 1) % full)
+        elif a.is_const and not b.is_const:
+            # signed(b) >= lower
+            lower = _signed(a.value, w) + (1 if strict else 0)
+            if lower >= 0:
+                # wholly inside the non-negative half
+                narrow(b, lower, half - 1)
         return
 
 

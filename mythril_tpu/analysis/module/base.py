@@ -9,6 +9,7 @@ flagged program points.
 from __future__ import annotations
 
 import logging
+from contextlib import contextmanager
 from enum import Enum
 from types import MappingProxyType
 from typing import List, Mapping, Optional, Set, Tuple
@@ -36,6 +37,19 @@ def set_issue_sink(sink):
     prev = _ISSUE_SINK
     _ISSUE_SINK = sink
     return prev
+
+
+@contextmanager
+def issue_sink_scope(sink):
+    """Scoped form of ``set_issue_sink``: install ``sink`` for the body
+    and restore the previous sink on exit.  The explicit-context entry
+    point (``facade.warm.WorkerContext``) uses this so the sink's
+    lifetime is structurally tied to the analysis that owns it."""
+    prev = set_issue_sink(sink)
+    try:
+        yield sink
+    finally:
+        set_issue_sink(prev)
 
 
 class EntryPoint(Enum):

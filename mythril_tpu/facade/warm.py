@@ -18,16 +18,26 @@ between batches.  This module is the shared half:
   caches are cleared; the SMT query cache, interned terms, and compiled
   XLA programs deliberately stay warm (their reuse is sound by
   construction — validated hits only).
+* ``WorkerContext`` — the explicit owner of one worker's engine-global
+  lifecycle.  The engine keeps genuinely process-global state (the flag
+  singleton, the ``module/base`` issue sink, ``smt/terms`` interning),
+  which is what confined the daemon to a single worker thread; this
+  class names that state and scopes every touch of it, so a worker —
+  the daemon's in-process thread or a pool worker *process* — is "the
+  thing that owns a WorkerContext".  Process isolation then makes N
+  contexts coexist: one per worker process, none shared.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from mythril_tpu.support.support_args import args
 
 __all__ = [
+    "WorkerContext",
     "apply_analyzer_args",
     "reset_analysis_scope",
     "resolve_cache_root",
@@ -114,6 +124,81 @@ def apply_analyzer_args(cmd_args) -> None:
         from mythril_tpu import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache(args.compile_cache_dir)
+
+
+class WorkerContext:
+    """Explicit per-worker handle on the engine's process-global state.
+
+    One worker (the daemon's inline thread, or one pool worker process)
+    constructs exactly one context and routes every engine-global touch
+    through it: flag-object configuration (``configure``), the per-batch
+    scope sweep (``reset_scope``), issue-sink installation
+    (``sink_scope``), the host-probe flag flip (``probe_scope``) and
+    abstract-pre-filter accounting (``prefilter_delta``).  Nothing here
+    is thread-safe by design — the context IS the single-ownership
+    contract the old implicit globals only implied.
+    """
+
+    def __init__(self, analyzer_args):
+        #: the AnalyzerArgs-shaped namespace this worker was armed with
+        self.analyzer_args = analyzer_args
+        self.configured = False
+
+    def configure(self) -> "WorkerContext":
+        """Arm the global flag object + caches for this worker process."""
+        apply_analyzer_args(self.analyzer_args)
+        self.configured = True
+        return self
+
+    def reset_scope(self) -> None:
+        """Per-batch sweep: next analysis behaves like a fresh process."""
+        reset_analysis_scope()
+
+    def sink_scope(self, sink):
+        """Install an issue sink for the scope of one analysis."""
+        from mythril_tpu.analysis.module.base import issue_sink_scope
+
+        return issue_sink_scope(sink)
+
+    @contextlib.contextmanager
+    def probe_scope(self):
+        """Host-first probe configuration: frontier off, host probe
+        backend — restored on exit.  (The probe's tighter execution
+        timeout travels as an explicit ``run_cooperative_batch``
+        argument, not through the flag object.)"""
+        saved = (args.frontier, args.probe_backend)
+        args.frontier = False
+        args.probe_backend = "host"
+        try:
+            yield
+        finally:
+            args.frontier, args.probe_backend = saved
+
+    @contextlib.contextmanager
+    def prefilter_delta(self, out: Dict[str, int]):
+        """Measure this scope's abstract pre-filter activity into ``out``
+        (keys ``evaluated``/``killed``) — the scoped counters reset per
+        batch, so callers that outlive the batch need the delta."""
+        from mythril_tpu.observability.metrics import get_registry
+
+        reg = get_registry()
+        e0 = reg.counter("prefilter.evaluated").value
+        k0 = reg.counter("prefilter.killed").value
+        try:
+            yield out
+        finally:
+            out["evaluated"] = out.get("evaluated", 0) + max(
+                reg.counter("prefilter.evaluated").value - e0, 0
+            )
+            out["killed"] = out.get("killed", 0) + max(
+                reg.counter("prefilter.killed").value - k0, 0
+            )
+
+    def stats(self) -> Dict[str, Any]:
+        """Worker-local engine-global sizes (heartbeat payload)."""
+        from mythril_tpu.smt.terms import intern_table_size
+
+        return {"interned_terms": intern_table_size()}
 
 
 def reset_analysis_scope() -> None:

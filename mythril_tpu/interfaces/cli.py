@@ -380,8 +380,31 @@ def create_parser() -> argparse.ArgumentParser:
     )
     serve.add_argument(
         "--cache-root", metavar="DIR",
-        help="pin the SMT query cache (DIR/querycache) and XLA compile "
-        "cache (DIR/xla) under one directory",
+        help="pin the SMT query cache (DIR/querycache), XLA compile "
+        "cache (DIR/xla) and cross-process completed-result LRU "
+        "(DIR/results) under one directory",
+    )
+    serve.add_argument(
+        "--workers", type=int, default=1, metavar="N",
+        help="analysis worker processes behind the admission plane "
+        "(default 1: classic in-process worker thread; N>1 spawns N "
+        "isolated engine processes sharing the --cache-root caches)",
+    )
+    serve.add_argument(
+        "--tenant-quota", type=int, default=0, metavar="N",
+        help="max pending flights one tenant may hold (0 = unlimited); "
+        "excess submissions are rejected immediately, not queued",
+    )
+    serve.add_argument(
+        "--shed-depth", type=int, default=0, metavar="N",
+        help="pending-queue depth at which batch-tier submissions are "
+        "shed (0 = never; interactive submissions always queue)",
+    )
+    serve.add_argument(
+        "--age-priority", type=float, default=30.0, metavar="SECONDS",
+        help="batch flights waiting this long are promoted to "
+        "interactive-class priority so a continuous interactive stream "
+        "cannot starve batch work (default 30s; <=0 disables aging)",
     )
     serve.add_argument(
         "-t", "--transaction-count", type=int, default=2,
@@ -794,6 +817,10 @@ def execute_command(parsed) -> None:
             heartbeat=True,
             heartbeat_interval_s=parsed.heartbeat_interval,
             request_log=getattr(parsed, "request_log", None),
+            workers=getattr(parsed, "workers", 1),
+            tenant_quota=getattr(parsed, "tenant_quota", 0),
+            shed_queue_depth=getattr(parsed, "shed_depth", 0),
+            age_priority_s=getattr(parsed, "age_priority", 0.0),
         )
         if getattr(parsed, "heartbeat_out", None):
             from mythril_tpu.observability import get_heartbeat

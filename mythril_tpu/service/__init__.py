@@ -5,15 +5,22 @@ re-pays XLA compilation, SMT query-cache warmup, and runs its contract
 alone on the device even when the slot batch is mostly empty.  This
 package converts the batch tool into a server:
 
-* ``daemon.AnalysisService`` — the warm process.  One worker thread owns
-  the (non-reentrant) analysis singletons and runs admitted requests as
-  shared wide device batches via the cooperative corpus sweep
+* ``daemon.AnalysisService`` — the admission plane + its workers.  With
+  ``workers=1`` (default) one worker thread owns the (non-reentrant)
+  analysis singletons and runs admitted requests as shared wide device
+  batches via the cooperative corpus sweep
   (``analysis/cooperative.run_cooperative_batch``), streaming issues back
-  per request as they confirm.
-* ``admission.AdmissionController`` — queue + dedup.  Submissions are
-  keyed by canonical codehash + options; duplicate submitters subscribe
-  to the in-flight result (replay-then-live ordering) or get a cached
-  replay of a completed one.
+  per request as they confirm.  With ``workers=N`` a horizontal pool of
+  N worker *processes* (``pool``/``worker``) runs N batches concurrently
+  behind the same admission queue, sharing the on-disk caches and the
+  cross-process completed-result LRU (``resultstore``) under one
+  ``--cache-root``.
+* ``admission.AdmissionController`` — queue + dedup + scheduling.
+  Submissions are keyed by canonical codehash + options; duplicate
+  submitters subscribe to the in-flight result (replay-then-live
+  ordering) or get a cached replay of a completed one.  An optional
+  ``scheduling.SchedulerPolicy`` adds tenant quotas, batch-tier load
+  shedding, and priority aging.
 * ``server.run_server`` / ``client.ServiceClient`` — a thin JSON-lines
   TCP layer (``myth serve`` / ``myth submit``) over the in-process API.
 * ``telemetry.RequestTelemetry`` — the request-scoped telemetry plane:
@@ -39,8 +46,13 @@ from mythril_tpu.service.request import (  # noqa: F401
     AnalysisOptions,
     AnalysisRequest,
     ResultStream,
+    issue_to_wire,
 )
 from mythril_tpu.service.admission import AdmissionController  # noqa: F401
+from mythril_tpu.service.scheduling import (  # noqa: F401
+    AdmissionRejected,
+    SchedulerPolicy,
+)
 from mythril_tpu.service.telemetry import RequestTelemetry  # noqa: F401
 from mythril_tpu.service.daemon import (  # noqa: F401
     AnalysisService,

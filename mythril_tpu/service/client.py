@@ -4,6 +4,11 @@ One TCP connection per submission: write the request line, then iterate
 the event lines the daemon streams back.  ``submit_stream`` yields each
 event dict as it arrives (issues the moment they confirm); ``submit``
 collects and returns the terminal summary.
+
+``submit_detached`` + ``poll``/``wait`` use the long-poll path instead:
+the submit connection returns after ``accepted`` and each poll is its
+own short connection, so a client watching a slow analysis holds no
+server thread between events.
 """
 
 from __future__ import annotations
@@ -88,6 +93,85 @@ class ServiceClient:
             raise ConnectionError(
                 "server closed the stream before a terminal event"
             )
+
+    def submit_detached(
+        self,
+        code: str,
+        name: Optional[str] = None,
+        tier: str = "batch",
+        tenant: Optional[str] = None,
+        **options: Any,
+    ) -> Dict[str, Any]:
+        """Fire-and-poll submit: returns the ``accepted`` event dict
+        (``request_id``, ``codehash``, ``deduped``) without waiting for
+        the analysis.  Follow up with ``poll``/``wait``.  Raises
+        ``RuntimeError`` on rejection (the message names quota/shed)."""
+        msg: Dict[str, Any] = {
+            "op": "submit", "code": code, "tier": tier, "detach": True,
+        }
+        if name:
+            msg["name"] = name
+        if tenant:
+            msg["tenant"] = tenant
+        for key in ("transaction_count", "modules", "strategy",
+                    "execution_timeout"):
+            if options.get(key) is not None:
+                msg[key] = options[key]
+        for event in self._roundtrip(msg):
+            if event.get("event") == "error":
+                raise RuntimeError(f"submit rejected: {event.get('error')}")
+            return event
+        raise ConnectionError("server closed before accepting")
+
+    def poll(self, request_id: str, cursor: int = 0,
+             wait_s: float = 0.0) -> Dict[str, Any]:
+        """One long-poll round: events past ``cursor`` (blocking up to
+        ``wait_s`` server-side), the advanced cursor, and ``closed``."""
+        for event in self._roundtrip({
+            "op": "poll", "request_id": request_id,
+            "cursor": cursor, "wait_s": wait_s,
+        }):
+            if event.get("event") == "error":
+                raise RuntimeError(f"poll failed: {event.get('error')}")
+            return event
+        raise ConnectionError("server closed during poll")
+
+    def wait(self, request_id: str, timeout: float = 300.0,
+             poll_wait_s: float = 10.0) -> Dict[str, Any]:
+        """Long-poll until the terminal event; returns the ``done``
+        summary (with ``streamed``/``request_id`` like ``submit``).
+        Raises ``RuntimeError`` on an ``error`` terminal."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        cursor = 0
+        streamed: List[Dict[str, Any]] = []
+        while True:
+            remaining = deadline - _time.time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"request {request_id} not terminal after {timeout}s"
+                )
+            out = self.poll(
+                request_id, cursor=cursor,
+                wait_s=min(poll_wait_s, max(remaining, 0.0)),
+            )
+            cursor = out.get("cursor", cursor)
+            for entry in out.get("events", []):
+                kind, payload = entry.get("kind"), entry.get("payload")
+                if kind == "issue":
+                    streamed.append(payload)
+                elif kind == "error":
+                    raise RuntimeError(f"analysis failed: {payload}")
+                elif kind == "done":
+                    summary = dict(payload)
+                    summary["streamed"] = streamed
+                    summary["request_id"] = request_id
+                    return summary
+            if out.get("closed"):
+                raise ConnectionError(
+                    f"request {request_id} closed without a done event"
+                )
 
     def submit(self, code: str, **kwargs) -> Dict[str, Any]:
         """Blocking submit; returns the ``done`` summary.

@@ -1,32 +1,47 @@
-"""The warm analysis process: admission worker, streaming, isolation.
+"""The warm analysis service: admission plane + N analysis workers.
 
-One daemon thread (``service-worker``) owns every non-reentrant analysis
-singleton — the global flag object, the time handler, the detection
-module loader — and runs admitted flights as shared wide device batches
-through ``analysis.cooperative.run_cooperative_batch``.  Submissions and
-stream consumption happen on arbitrary threads; only the worker touches
-the engine.
+The admission plane (this module) owns submission identity, dedup,
+scheduling policy, telemetry and result caching; analysis runs on
+*workers*.  Two worker shapes share one finalize path:
 
-Per-batch scope reset (``facade.warm.reset_analysis_scope``) makes every
-batch behave like a fresh process for *detection* while the SMT query
-cache, interned terms, and compiled XLA programs stay warm — that split
-is the determinism story: issue sets are bit-identical to solo runs
+* ``workers=1`` (default) — the classic inline worker: one daemon
+  thread (``service-worker``) owns every non-reentrant analysis
+  singleton through an explicit ``facade.warm.WorkerContext`` and runs
+  admitted flights as shared wide device batches.
+* ``workers=N>1`` — a horizontal pool of N worker *processes*
+  (``service/pool.py`` + ``service/worker.py``).  The engine's
+  process-globals (flag object, issue sink, interned SMT terms) confined
+  analysis to one thread per process; process isolation gives each
+  worker its own private copy, so N batches run truly concurrently.
+  Workers share the on-disk SMT query cache and XLA compile cache under
+  ``--cache-root`` plus the cross-process completed-result LRU
+  (``service/resultstore.py``), so dedup hits survive worker affinity
+  and daemon restarts.  A dead worker errors only its in-flight
+  requests (with a flight-recorder bundle naming them), is respawned,
+  and ``service.worker_restarts`` counts the event.
+
+Per-batch scope reset (``WorkerContext.reset_scope``) makes every batch
+behave like a fresh process for *detection* while the SMT query cache,
+interned terms, and compiled XLA programs stay warm — that split is the
+determinism story: issue sets are bit-identical to solo runs
 (differentially tested in tests/service/), throughput is not.
 
-Streaming: a process-wide issue sink (``module.base.set_issue_sink``)
-taps every confirmation the moment a module accepts it; the sink
-attributes issues to flights by ``Issue.bytecode_hash`` and emits each
-digest once per flight.  The terminal ``done`` event carries the
-authoritative end-of-batch issue list, so a client that ignores the
-stream loses latency, never findings.
+Streaming: a per-process issue sink taps every confirmation the moment
+a module accepts it; the sink attributes issues to flights by
+``Issue.bytecode_hash`` and emits each digest once per flight — inline
+via the flight directly, pool workers via the event queue the pump
+multiplexes back into the same flights.  The terminal ``done`` event
+carries the authoritative end-of-batch issue list, so a client that
+ignores the stream loses latency, never findings.  ``poll`` adds a
+long-poll subscribe path (cursor + bounded wait) so idle subscribers
+hold no handler thread between events.
 
 Interactive tier: flights submitted with ``tier="interactive"`` jump the
 admission queue, cut the batch window, and (by default) get a bounded
-host-first 1-tx probe *before* the authoritative batch — a cold XLA
-bucket then costs the probe nothing, so the TTFE budget holds even on
-first contact.  Probe findings stream marked ``provisional``; the
-``service.probe_wins`` / ``service.device_wins`` counters record which
-side delivered a request's first evidence.
+host-first 1-tx probe *before* the authoritative batch.  Scheduling
+policy (``service/scheduling.py``) layers tenant quotas, batch-tier
+load shedding, and priority aging on top, so one hot tenant cannot
+starve the interactive tier.
 """
 
 from __future__ import annotations
@@ -34,13 +49,16 @@ from __future__ import annotations
 import contextlib
 import itertools
 import logging
+import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.observability.flightrecorder import (
+    get_flight_recorder,
     register_flight_context,
     unregister_flight_context,
 )
@@ -54,7 +72,9 @@ from mythril_tpu.service.request import (
     ResultStream,
     TIER_BATCH,
     TIER_INTERACTIVE,
+    issue_to_wire,
 )
+from mythril_tpu.service.scheduling import AdmissionRejected, SchedulerPolicy
 from mythril_tpu.service.telemetry import RequestTelemetry
 
 log = logging.getLogger(__name__)
@@ -63,6 +83,9 @@ __all__ = ["AnalysisService", "ServiceConfig"]
 
 #: minimal STOP contract used to pull heavy imports during warmup
 _WARMUP_CODE = bytes.fromhex("00")
+
+#: bound on the request-id -> flight registry backing the poll API
+_RID_REGISTRY_CAP = 4096
 
 
 @dataclass
@@ -79,7 +102,8 @@ class ServiceConfig:
     #: a cold bucket must still meet the TTFE budget)
     probe: bool = True
     probe_timeout_s: int = 10
-    #: one directory pinning query cache + XLA compile cache
+    #: one directory pinning query cache + XLA compile cache + the
+    #: cross-process completed-result LRU
     cache_root: Optional[str] = None
     #: run a tiny analysis at start() so imports/solver are hot before
     #: the first real request lands
@@ -91,20 +115,58 @@ class ServiceConfig:
     #: append one JSON line per terminal request event (ids, tenant,
     #: phase decomposition, issue digests) to this path
     request_log: Optional[str] = None
+    #: analysis workers: 1 = inline worker thread (classic daemon),
+    #: N > 1 = a pool of N spawned worker processes behind this
+    #: admission plane
+    workers: int = 1
+    #: scheduling policy knobs (0 / 0.0 leave the base behavior intact)
+    tenant_quota: int = 0
+    shed_queue_depth: int = 0
+    age_priority_s: float = 0.0
+
+    def scheduler_policy(self) -> Optional[SchedulerPolicy]:
+        if not (self.tenant_quota or self.shed_queue_depth
+                or self.age_priority_s > 0):
+            return None
+        return SchedulerPolicy(
+            max_pending_per_tenant=self.tenant_quota,
+            shed_queue_depth=self.shed_queue_depth,
+            age_priority_s=self.age_priority_s,
+        )
 
 
 class AnalysisService:
     def __init__(self, config: Optional[ServiceConfig] = None):
         self.config = config or ServiceConfig()
+        if self.config.workers < 1:
+            raise ValueError("ServiceConfig.workers must be >= 1")
+        result_store = None
+        if self.config.cache_root:
+            from mythril_tpu.service.resultstore import ResultStore
+
+            result_store = ResultStore(
+                os.path.join(self.config.cache_root, "results")
+            )
         self.admission = AdmissionController(
-            result_cache_size=self.config.result_cache_size
+            result_cache_size=self.config.result_cache_size,
+            policy=self.config.scheduler_policy(),
+            result_store=result_store,
         )
         self._ids = itertools.count(1)
         self._worker: Optional[threading.Thread] = None
+        self._pool = None  # WorkerPool when workers > 1
+        self._jobs: Dict[int, Dict[str, Any]] = {}
+        self._jobs_lock = threading.Lock()
         self._stop = threading.Event()
         self._warm_ready = threading.Event()
         self._draining = False
         self._started = False
+        # request-id -> (key, flight-or-None): the poll/long-poll path
+        self._by_rid: "OrderedDict[str, Tuple[Tuple, Optional[Flight]]]" = (
+            OrderedDict()
+        )
+        self._rid_lock = threading.Lock()
+        self._ctx = None  # inline worker's WorkerContext
         reg = get_registry()
         self._c_batches = reg.counter("service.batches", persistent=True)
         self._h_width = reg.histogram(
@@ -117,6 +179,9 @@ class AnalysisService:
         self._c_device_wins = reg.counter("service.device_wins", persistent=True)
         self._c_probe_runs = reg.counter("service.probe_runs", persistent=True)
         self._h_probe = reg.histogram("service.probe_s", persistent=True)
+        self._c_restarts = reg.counter(
+            "service.worker_restarts", persistent=True
+        )
         # per-analysis prefilter.* counters are scope-reset between batches;
         # these persistent mirrors accumulate their deltas for stats()/top
         self._c_pf_eval = reg.counter(
@@ -127,12 +192,15 @@ class AnalysisService:
         )
         self.telemetry = RequestTelemetry(request_log=self.config.request_log)
 
+    @property
+    def pooled(self) -> bool:
+        return self.config.workers > 1
+
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "AnalysisService":
         if self._started:
             return self
-        self._configure_process()
         hb = get_heartbeat()
         hb.register("service", self._sample_depths)
         register_flight_context(
@@ -143,22 +211,41 @@ class AnalysisService:
         self._stop.clear()
         self._warm_ready.clear()
         self._draining = False
-        self._worker = threading.Thread(
-            target=self._worker_loop, name="service-worker", daemon=True
-        )
+        if self.pooled:
+            from mythril_tpu.service.pool import WorkerPool
+            from mythril_tpu.service.worker import worker_config
+
+            self._pool = WorkerPool(
+                self.config.workers,
+                worker_config(self.config),
+                self._on_worker_event,
+            )
+            self._worker = threading.Thread(
+                target=self._pool_dispatch_loop, name="service-dispatch",
+                daemon=True,
+            )
+        else:
+            self._configure_process()
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="service-worker", daemon=True
+            )
         self._started = True
         self._worker.start()
         return self
 
     def wait_warm(self, timeout: Optional[float] = None) -> bool:
         """Block until startup warmup has finished (immediately true when
-        ``warmup=False``).  Load generators use this so measured windows
-        start from a warm process, matching the service's steady state."""
+        ``warmup=False`` and inline; in pool mode, until every worker
+        process has reported ready).  Load generators use this so
+        measured windows start from a warm process, matching the
+        service's steady state."""
         return self._warm_ready.wait(timeout)
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
-        """Stop the worker; with ``drain`` (the SIGTERM path) finish every
-        pending and running flight first.  Returns True on clean drain."""
+        """Stop the worker(s); with ``drain`` (the SIGTERM path) finish
+        every pending and running flight first — busy pool workers run
+        their current batch to its terminal events before exiting.
+        Returns True on clean drain."""
         if not self._started:
             return True
         self._draining = True  # reject new submissions immediately
@@ -170,6 +257,9 @@ class AnalysisService:
         if w is not None and w.is_alive():
             w.join(timeout=30.0)
         self._worker = None
+        if self._pool is not None:
+            self._pool.stop(timeout=30.0)
+            self._pool = None
         self._started = False
         get_heartbeat().unregister("service")
         unregister_flight_context("service.requests")
@@ -177,25 +267,29 @@ class AnalysisService:
         return drained
 
     def _sample_depths(self) -> Dict[str, int]:
-        """Heartbeat source: admission depths + live request count."""
+        """Heartbeat source: admission + worker-slot depths + live
+        request count."""
         depths = self.admission.depths()
         depths["service.active_requests"] = len(self.telemetry.active_requests())
+        pool = self._pool
+        if pool is not None:
+            depths.update(pool.depths())
         return depths
 
     def _configure_process(self) -> None:
-        """Arm the warm-process configuration once, at startup."""
+        """Arm the inline worker's context once, at startup."""
         from mythril_tpu.facade.mythril_analyzer import AnalyzerArgs
-        from mythril_tpu.facade.warm import apply_analyzer_args
+        from mythril_tpu.facade.warm import WorkerContext
 
         opts = self.config.default_options
-        apply_analyzer_args(AnalyzerArgs(
+        self._ctx = WorkerContext(AnalyzerArgs(
             strategy=opts.strategy,
             transaction_count=opts.transaction_count,
             execution_timeout=opts.execution_timeout,
             modules=list(opts.modules) if opts.modules else None,
             frontier=self.config.frontier,
             cache_root=self.config.cache_root,
-        ))
+        )).configure()
 
     def _warmup(self) -> None:
         """Pull heavy imports + solver setup with a minimal contract so
@@ -226,7 +320,10 @@ class AnalysisService:
         options: Optional[AnalysisOptions] = None,
         tenant: Optional[str] = None,
     ) -> Tuple[AnalysisRequest, ResultStream, bool]:
-        """Queue one contract; returns ``(request, stream, deduped)``."""
+        """Queue one contract; returns ``(request, stream, deduped)``.
+
+        Raises ``AdmissionRejected`` when the scheduling policy refuses
+        the submission (tenant quota, load shed)."""
         if self._draining or not self._started:
             raise RuntimeError("service is not accepting submissions")
         if tier not in (TIER_BATCH, TIER_INTERACTIVE):
@@ -246,7 +343,13 @@ class AnalysisService:
         # worker may finalize the request at any moment, and finalize of
         # an unregistered request would be dropped
         self.telemetry.request_started(request)
-        stream, deduped = self.admission.submit(request)
+        try:
+            stream, deduped = self.admission.submit(request)
+        except AdmissionRejected:
+            self.telemetry.request_finished(request, "rejected")
+            raise
+        key = (request.codehash, request.options.key())
+        self._register_rid(request.request_id, key)
         if deduped:
             self.telemetry.request_deduped(request)
             if stream.closed:
@@ -254,9 +357,7 @@ class AnalysisService:
                 # reference this request again — finalize it now, with
                 # the replayed issue set (it WAS delivered to this
                 # tenant, so it counts toward their accounting)
-                events = self.admission.cached_events(
-                    (request.codehash, request.options.key())
-                )
+                events = self.admission.cached_events(key)
                 issues = next(
                     (p.get("issues", []) for k, p in events if k == "done"),
                     [],
@@ -271,6 +372,54 @@ class AnalysisService:
                 )
         return request, stream, deduped
 
+    def _register_rid(self, request_id: str, key: Tuple) -> None:
+        flight = self.admission.flight_for(key)
+        with self._rid_lock:
+            self._by_rid[request_id] = (key, flight)
+            while len(self._by_rid) > _RID_REGISTRY_CAP:
+                self._by_rid.popitem(last=False)
+
+    def poll(self, request_id: str, cursor: int = 0,
+             wait_s: float = 0.0) -> Dict[str, Any]:
+        """Long-poll subscribe: events past ``cursor`` for a submitted
+        request, blocking up to ``wait_s`` for the first new one.
+
+        Returns ``{"events": [(kind, payload), ...], "cursor": int,
+        "closed": bool}``.  An idle subscriber costs the service nothing
+        between polls — no handler thread, no worker, no stream queue.
+        Raises ``KeyError`` for an unknown (or long-evicted) request id.
+        """
+        with self._rid_lock:
+            entry = self._by_rid.get(request_id)
+        if entry is None:
+            raise KeyError(f"unknown request id {request_id!r}")
+        key, flight = entry
+        if flight is not None:
+            events, new_cursor, closed = flight.poll(
+                cursor, min(max(wait_s, 0.0), 120.0)
+            )
+        else:
+            cached = self.admission.cached_events(key)
+            events = cached[cursor:]
+            new_cursor = cursor + len(events)
+            closed = bool(cached) and new_cursor >= len(cached)
+        return {"events": events, "cursor": new_cursor, "closed": closed}
+
+    def worker_stats(self) -> List[Dict[str, Any]]:
+        """Per-worker rows for stats()/``myth top`` (pool or inline)."""
+        pool = self._pool
+        if pool is not None:
+            return pool.stats()
+        return [{
+            "id": 0,
+            "pid": os.getpid(),
+            "state": "inline",
+            "job": None,
+            "batches": int(self._c_batches.snapshot() or 0),
+            "restarts": 0,
+            "age_s": 0.0,
+        }]
+
     def stats(self) -> Dict[str, Any]:
         reg = get_registry()
         out = dict(self.admission.depths())
@@ -280,6 +429,8 @@ class AnalysisService:
             "service.request_errors", "service.probe_wins",
             "service.device_wins", "service.probe_runs",
             "service.prefilter_evaluated", "service.prefilter_killed",
+            "service.worker_restarts", "service.shed_total",
+            "service.quota_rejections", "service.result_store_hits",
         ):
             out[name] = reg.counter(name, persistent=True).snapshot()
         pf_eval = out["service.prefilter_evaluated"] or 0
@@ -297,12 +448,20 @@ class AnalysisService:
             "replay_hit_rate": round(out["service.replay_hits"] / requests, 4)
             if requests else 0.0,
         }
+        out["workers"] = self.worker_stats()
+        policy = self.config.scheduler_policy()
+        if policy is not None:
+            out["scheduler"] = {
+                "tenant_quota": policy.max_pending_per_tenant,
+                "shed_queue_depth": policy.shed_queue_depth,
+                "age_priority_s": policy.age_priority_s,
+            }
         out["phases"] = self.telemetry.phase_stats()
         out["tenants"] = self.telemetry.tenant_stats()
         out["inflight_requests"] = self.telemetry.active_requests()
         return out
 
-    # -- worker (single thread owns the engine) ------------------------
+    # -- inline worker (one thread owns the engine) --------------------
 
     def _worker_loop(self) -> None:
         if self.config.warmup:
@@ -317,14 +476,7 @@ class AnalysisService:
             # admission window: give compatible arrivals a moment to pile
             # into the same wide segment batch — unless an interactive
             # request is waiting (TTFE beats width) or we are draining
-            deadline = time.perf_counter() + cfg.batch_window_s
-            while (
-                time.perf_counter() < deadline
-                and not self._draining
-                and not self._stop.is_set()
-                and not self.admission.has_interactive_pending()
-            ):
-                time.sleep(min(0.005, cfg.batch_window_s))
+            self._admission_window(cfg)
             batch = self.admission.next_batch(cfg.max_batch_width)
             if not batch:
                 continue
@@ -344,10 +496,23 @@ class AnalysisService:
                         batch_width=len(batch),
                     )
 
-    def _scope_reset(self) -> None:
-        from mythril_tpu.facade.warm import reset_analysis_scope
+    def _admission_window(self, cfg: ServiceConfig) -> None:
+        deadline = time.perf_counter() + cfg.batch_window_s
+        while (
+            time.perf_counter() < deadline
+            and not self._draining
+            and not self._stop.is_set()
+            and not self.admission.has_interactive_pending()
+        ):
+            time.sleep(min(0.005, cfg.batch_window_s))
 
-        reset_analysis_scope()
+    def _scope_reset(self) -> None:
+        if self._ctx is not None:
+            self._ctx.reset_scope()
+        else:  # pool mode touches no engine state in-process
+            from mythril_tpu.facade.warm import reset_analysis_scope
+
+            reset_analysis_scope()
 
     def _make_sink(
         self,
@@ -374,7 +539,7 @@ class AnalysisService:
                     if digest in streamed[flight.key]:
                         continue
                     streamed[flight.key].add(digest)
-                wire = _issue_to_wire(issue)
+                wire = issue_to_wire(issue)
                 if provisional:
                     wire["provisional"] = True
                 flight.emit("issue", wire, source=source)
@@ -386,22 +551,18 @@ class AnalysisService:
     def _account_prefilter(self):
         """Fold this scope's abstract pre-filter activity into the
         persistent service mirrors (the scoped counters reset per batch)."""
-        reg = get_registry()
-        e0 = reg.counter("prefilter.evaluated").value
-        k0 = reg.counter("prefilter.killed").value
+        delta: Dict[str, int] = {}
         try:
-            yield
+            with self._ctx.prefilter_delta(delta):
+                yield
         finally:
-            de = reg.counter("prefilter.evaluated").value - e0
-            dk = reg.counter("prefilter.killed").value - k0
-            if de > 0:
-                self._c_pf_eval.inc(de)
-            if dk > 0:
-                self._c_pf_kill.inc(dk)
+            if delta.get("evaluated"):
+                self._c_pf_eval.inc(delta["evaluated"])
+            if delta.get("killed"):
+                self._c_pf_kill.inc(delta["killed"])
 
     def _run_batch(self, batch: List[Flight]) -> None:
         from mythril_tpu.analysis.cooperative import run_cooperative_batch
-        from mythril_tpu.analysis.module.base import set_issue_sink
 
         t0 = time.perf_counter()
         self._c_batches.inc()
@@ -433,37 +594,63 @@ class AnalysisService:
                 self._scope_reset()
 
             self._stamp_batch(batch, "execute0", "execute")
-            prev_sink = set_issue_sink(
+            with self._account_prefilter(), self._ctx.sink_scope(
                 self._make_sink(by_hash, streamed, "device", sink_lock)
-            )
-            try:
-                with self._account_prefilter():
-                    issues_by_name, errors_by_name, _states = run_cooperative_batch(
-                        [(f.codehash, f.requests[0].code) for f in batch],
-                        transaction_count=opts.transaction_count,
-                        modules=list(opts.modules) if opts.modules else None,
-                        strategy=opts.strategy,
-                        execution_timeout=opts.execution_timeout,
-                        isolate_errors=True,
-                        request_tags=request_ids,
-                        request_flow_cb=flow_cb,
-                    )
-            finally:
-                set_issue_sink(prev_sink)
+            ):
+                issues_by_name, errors_by_name, _states = run_cooperative_batch(
+                    [(f.codehash, f.requests[0].code) for f in batch],
+                    transaction_count=opts.transaction_count,
+                    modules=list(opts.modules) if opts.modules else None,
+                    strategy=opts.strategy,
+                    execution_timeout=opts.execution_timeout,
+                    isolate_errors=True,
+                    request_tags=request_ids,
+                    request_flow_cb=flow_cb,
+                )
             self._stamp_batch(batch, "execute1", "stream")
 
         elapsed = time.perf_counter() - t0
         exec0 = batch[0].requests[0].stamps.get("execute0", t0)
         exec1 = batch[0].requests[0].stamps.get("execute1", exec0)
         device_wall = max(exec1 - exec0, 0.0)
+        wires_by_hash = {
+            f.codehash: [
+                issue_to_wire(i) for i in issues_by_name.get(f.codehash, [])
+            ]
+            for f in batch
+        }
+        self._finalize_batch(
+            batch, streamed, wires_by_hash, dict(errors_by_name),
+            elapsed=elapsed, device_wall=device_wall, sink_lock=sink_lock,
+        )
+        log.info(
+            "service batch of %d done in %.2fs (%d errored)",
+            len(batch), elapsed, len(errors_by_name),
+        )
+
+    def _finalize_batch(
+        self,
+        batch: List[Flight],
+        streamed: Dict[Tuple, Set[Tuple]],
+        wires_by_hash: Dict[str, List[Dict[str, Any]]],
+        errors_by_hash: Dict[str, str],
+        *,
+        elapsed: float,
+        device_wall: float,
+        sink_lock: Optional[threading.Lock] = None,
+    ) -> None:
+        """Shared terminal path for inline batches and pool jobs:
+        stream any late findings, emit terminal events, retire flights,
+        finalize telemetry."""
+        sink_lock = sink_lock or threading.Lock()
         for flight in batch:
             with flight.lock:
                 flight_requests = list(flight.requests)
             # device wall attributed evenly: by flight, then by the
             # requests sharing the flight
             share = device_wall / len(batch) / max(len(flight_requests), 1)
-            if flight.codehash in errors_by_name:
-                flight.emit("error", errors_by_name[flight.codehash])
+            if flight.codehash in errors_by_hash:
+                flight.emit("error", errors_by_hash[flight.codehash])
                 self._c_errors.inc()
                 self.admission.finish(flight)
                 self._finish_requests(
@@ -471,10 +658,7 @@ class AnalysisService:
                     batch_width=len(batch), compute_share=share,
                 )
                 continue
-            wires = [
-                _issue_to_wire(i)
-                for i in issues_by_name.get(flight.codehash, [])
-            ]
+            wires = wires_by_hash.get(flight.codehash, [])
             # stream anything end-of-batch collection found that the sink
             # did not see mid-run (POST modules, late confirmations)
             for wire in wires:
@@ -502,10 +686,6 @@ class AnalysisService:
                 digests=[issue_digest(w) for w in wires],
                 batch_width=len(batch), compute_share=share,
             )
-        log.info(
-            "service batch of %d done in %.2fs (%d errored)",
-            len(batch), elapsed, len(errors_by_name),
-        )
 
     def _stamp_batch(self, batch: List[Flight], stamp: Optional[str],
                      phase: str) -> None:
@@ -549,23 +729,18 @@ class AnalysisService:
         confirmed probe finding is not re-streamed by the device pass.
         """
         from mythril_tpu.analysis.cooperative import run_cooperative_batch
-        from mythril_tpu.analysis.module.base import set_issue_sink
-        from mythril_tpu.support.support_args import args
 
         self._c_probe_runs.inc()
         opts = flight.options
-        saved = (args.frontier, args.probe_backend)
-        prev_sink = set_issue_sink(
-            self._make_sink(by_hash, streamed, "probe", sink_lock)
-        )
-        args.frontier = False
-        args.probe_backend = "host"
         t0 = time.perf_counter()
         try:
             with _otrace.span(
                 "service.probe", cat="service",
                 request=flight.requests[0].request_id,
-            ), self._account_prefilter():
+            ), self._account_prefilter(), self._ctx.probe_scope(), \
+                    self._ctx.sink_scope(
+                        self._make_sink(by_hash, streamed, "probe", sink_lock)
+                    ):
                 # quick triage: the abstract pre-filter sits in the solver
                 # fast path, so the host-first probe gets its near-free
                 # UNSAT verdicts before any exact solve
@@ -581,22 +756,162 @@ class AnalysisService:
                 )
         except Exception:
             log.exception("interactive probe failed; batch continues")
-        finally:
-            args.frontier, args.probe_backend = saved
-            set_issue_sink(prev_sink)
         self._h_probe.observe(time.perf_counter() - t0)
 
+    # -- pool mode (admission plane side) ------------------------------
 
-def _issue_to_wire(issue) -> Dict[str, Any]:
-    """JSON-safe wire form of one finding (digest-complete + context)."""
-    return {
-        "contract": issue.contract,
-        "function": issue.function,
-        "address": issue.address,
-        "swc_id": issue.swc_id,
-        "title": issue.title,
-        "severity": issue.severity,
-        "description_head": issue.description_head,
-        "bytecode_hash": issue.bytecode_hash,
-        "discovery_time": round(issue.discovery_time, 3),
-    }
+    def _pool_dispatch_loop(self) -> None:
+        """Dispatcher thread: admit batches and hand them to idle
+        worker processes.  The engine never runs on this thread — the
+        admission plane stays thin."""
+        pool = self._pool
+        if not pool.wait_ready(timeout=600):
+            log.warning("worker pool not fully ready after 600s; "
+                        "dispatching to whatever is")
+        self._warm_ready.set()
+        cfg = self.config
+        while True:
+            if not self.admission.wait_for_pending(timeout=0.1):
+                if self._stop.is_set():
+                    return
+                continue
+            handle = pool.acquire(timeout=0.5)
+            if handle is None:
+                if self._stop.is_set():
+                    return
+                continue
+            self._admission_window(cfg)
+            batch = self.admission.next_batch(cfg.max_batch_width)
+            if not batch:
+                pool.release(handle)
+                continue
+            self._dispatch_batch(handle, batch)
+
+    def _dispatch_batch(self, handle, batch: List[Flight]) -> None:
+        pool = self._pool
+        job_id = pool.new_job_id()
+        self._c_batches.inc()
+        self._h_width.observe(float(len(batch)))
+        self._stamp_batch(batch, None, "batch_wait")
+        with self._jobs_lock:
+            self._jobs[job_id] = {
+                "batch": batch,
+                "by_hash": {f.codehash: f for f in batch},
+                "streamed": {f.key: set() for f in batch},
+                "t0": time.perf_counter(),
+                "worker": handle.id,
+            }
+        self._stamp_batch(batch, "execute0", "execute")
+        pool.dispatch(
+            handle, job_id,
+            [
+                {
+                    "codehash": f.codehash,
+                    "code": f.requests[0].code,
+                    "request_id": f.requests[0].request_id,
+                    "tier": f.tier,
+                }
+                for f in batch
+            ],
+            batch[0].options.to_dict(),
+        )
+
+    def _on_worker_event(self, msg: tuple) -> None:
+        """Pump-thread callback: multiplex worker events onto flights."""
+        kind = msg[0]
+        if kind == "issue":
+            _, _wid, job_id, codehash, wire, source = msg
+            with self._jobs_lock:
+                job = self._jobs.get(job_id)
+            if job is None:
+                return
+            flight = job["by_hash"].get(codehash)
+            if flight is None:
+                return
+            digest = issue_digest(wire)
+            seen = job["streamed"][flight.key]
+            if digest in seen:
+                return
+            seen.add(digest)
+            flight.emit("issue", wire, source=source)
+            self._c_streamed.inc()
+        elif kind == "done":
+            _, _wid, job_id, payload = msg
+            with self._jobs_lock:
+                job = self._jobs.pop(job_id, None)
+            if job is None:
+                return
+            self._finalize_pool_job(job, payload)
+        elif kind == "worker_died":
+            _, wid, job_id, pid = msg
+            self._c_restarts.inc()
+            job = None
+            if job_id is not None:
+                with self._jobs_lock:
+                    job = self._jobs.pop(job_id, None)
+            self._fail_pool_job(job, wid, pid)
+
+    def _finalize_pool_job(self, job: Dict[str, Any],
+                           payload: Dict[str, Any]) -> None:
+        batch: List[Flight] = job["batch"]
+        self._stamp_batch(batch, "execute1", "stream")
+        elapsed = time.perf_counter() - job["t0"]
+        pf = payload.get("prefilter") or {}
+        if pf.get("evaluated"):
+            self._c_pf_eval.inc(pf["evaluated"])
+        if pf.get("killed"):
+            self._c_pf_kill.inc(pf["killed"])
+        for wall in payload.get("probe_s") or []:
+            self._c_probe_runs.inc()
+            self._h_probe.observe(wall)
+        self._finalize_batch(
+            batch, job["streamed"],
+            payload.get("issues") or {},
+            payload.get("errors") or {},
+            elapsed=elapsed,
+            device_wall=float(payload.get("elapsed_s") or 0.0),
+        )
+        log.info(
+            "pool job on worker %d: batch of %d done in %.2fs (%d errored)",
+            job["worker"], len(batch), elapsed,
+            len(payload.get("errors") or {}),
+        )
+
+    def _fail_pool_job(self, job: Optional[Dict[str, Any]], wid: int,
+                       pid) -> None:
+        """Worker-crash containment: error ONLY the dead worker's
+        in-flight requests (nothing is requeued silently), leave a
+        flight-recorder bundle naming them, and let the pool respawn."""
+        lost_rids: List[str] = []
+        if job is not None:
+            batch: List[Flight] = job["batch"]
+            reason = f"worker {wid} (pid {pid}) died mid-batch"
+            for flight in batch:
+                with flight.lock:
+                    flight_requests = list(flight.requests)
+                lost_rids.extend(r.request_id for r in flight_requests)
+                if not flight.finished:
+                    flight.emit("error", reason)
+                    self._c_errors.inc()
+                self.admission.finish(flight)
+                self._finish_requests(
+                    flight, flight_requests, "error",
+                    batch_width=len(batch),
+                )
+        log.error("worker %d (pid %s) crashed; lost requests: %s",
+                  wid, pid, ",".join(lost_rids) or "none")
+        rec = get_flight_recorder()
+        if rec is not None:
+            try:
+                rec.dump("service.worker_crash", extra={
+                    "worker": wid,
+                    "pid": pid,
+                    "lost_requests": lost_rids,
+                })
+            except Exception:
+                log.exception("flight-recorder dump failed after crash")
+
+
+# Backwards-compatible alias: the wire conversion moved to request.py so
+# pool workers can import it without pulling the daemon module.
+_issue_to_wire = issue_to_wire

@@ -24,7 +24,12 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from mythril_tpu.observability.metrics import Histogram, get_registry
 
-__all__ = ["AnalysisOptions", "AnalysisRequest", "ResultStream"]
+__all__ = [
+    "AnalysisOptions",
+    "AnalysisRequest",
+    "ResultStream",
+    "issue_to_wire",
+]
 
 TIER_BATCH = "batch"
 TIER_INTERACTIVE = "interactive"
@@ -59,6 +64,44 @@ class AnalysisOptions:
             self.strategy,
             self.execution_timeout,
         )
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Picklable/JSON-safe form for the worker-pool job protocol."""
+        return {
+            "transaction_count": self.transaction_count,
+            "modules": list(self.modules) if self.modules else None,
+            "strategy": self.strategy,
+            "execution_timeout": self.execution_timeout,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AnalysisOptions":
+        return cls(
+            transaction_count=int(d.get("transaction_count", 2)),
+            modules=tuple(d["modules"]) if d.get("modules") else None,
+            strategy=d.get("strategy", "bfs"),
+            execution_timeout=int(d.get("execution_timeout", 60)),
+        )
+
+
+def issue_to_wire(issue) -> Dict[str, Any]:
+    """JSON-safe wire form of one finding (digest-complete + context).
+
+    Shared by the in-daemon worker thread and the pool worker processes:
+    both sides of the worker protocol speak exactly this shape, so the
+    digests a client computes are identical either way.
+    """
+    return {
+        "contract": issue.contract,
+        "function": issue.function,
+        "address": issue.address,
+        "swc_id": issue.swc_id,
+        "title": issue.title,
+        "severity": issue.severity,
+        "description_head": issue.description_head,
+        "bytecode_hash": issue.bytecode_hash,
+        "discovery_time": round(issue.discovery_time, 3),
+    }
 
 
 @dataclass

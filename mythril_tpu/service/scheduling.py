@@ -1,0 +1,75 @@
+"""Admission scheduling policy: tenant quotas, priority aging, shedding.
+
+PR 9's tenant accounting made per-tenant load visible; this module makes
+it actionable.  The policy runs entirely inside the admission plane —
+workers never see it — and has three independent levers:
+
+* **Tenant quota** (``max_pending_per_tenant``): a tenant may hold at
+  most N *new* pending flights (dedup subscriptions are free — they add
+  no work).  The N+1st submission is rejected with a one-line error the
+  submitter sees immediately; nothing is queued.  This bounds how much
+  of the admission queue one hot tenant can own, which is what keeps the
+  interactive tier's queue-wait flat under a tenant flood.
+
+* **Load shedding** (``shed_queue_depth``): when the pending queue is
+  this deep, *batch-tier* submissions are refused outright (shed), while
+  interactive submissions still queue — a saturated service degrades by
+  dropping bulk work, not by stretching interactive p95s.  Shedding is
+  visible: ``service.shed_total`` counts every refusal.
+
+* **Priority aging** (``age_priority_s``): interactive flights jump the
+  queue; a batch flight that has waited ``age_priority_s`` is promoted
+  to the same priority class, so a continuous interactive stream ages
+  batch work forward instead of starving it forever.  Within a class,
+  FIFO by first submission.
+
+``AdmissionRejected`` is a ``RuntimeError`` so every existing transport
+path (server error event, client exception) reports it unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["AdmissionRejected", "SchedulerPolicy"]
+
+
+class AdmissionRejected(RuntimeError):
+    """Submission refused by admission policy (quota or load shed)."""
+
+    def __init__(self, reason: str, kind: str = "rejected"):
+        super().__init__(reason)
+        self.kind = kind  # "quota" | "shed"
+
+
+@dataclass(frozen=True)
+class SchedulerPolicy:
+    #: max new pending flights one tenant may hold (0 = unlimited)
+    max_pending_per_tenant: int = 0
+    #: pending-queue depth at which batch-tier submissions are shed
+    #: (0 = never shed)
+    shed_queue_depth: int = 0
+    #: batch flights waiting at least this long are promoted to
+    #: interactive-class priority (<= 0 disables aging)
+    age_priority_s: float = 30.0
+
+    @property
+    def active(self) -> bool:
+        return bool(
+            self.max_pending_per_tenant
+            or self.shed_queue_depth
+            or self.age_priority_s > 0
+        )
+
+    def priority_class(self, interactive: bool, created_at: float,
+                       now: Optional[float] = None) -> int:
+        """0 = dispatch-first class, 1 = normal batch backlog."""
+        if interactive:
+            return 0
+        if self.age_priority_s > 0:
+            now = time.time() if now is None else now
+            if now - created_at >= self.age_priority_s:
+                return 0
+        return 1

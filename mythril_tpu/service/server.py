@@ -17,9 +17,24 @@ Protocol (UTF-8, one JSON object per line):
     -> {"op": "metrics"} <- {"event": "metrics", "text": "<prometheus>"}
 
 ``submit`` also accepts an optional ``"tenant"`` label for per-tenant
-accounting; ``metrics`` returns the full registry in the Prometheus
-text exposition format (``content_type`` names the version) so one
-sidecar bridge can serve it over HTTP unmodified.
+accounting and ``"detach": true`` — the handler then answers with the
+``accepted`` line only and returns the connection, instead of holding a
+handler thread open for the whole analysis.  A detached client follows
+up over fresh connections with the long-poll op:
+
+    -> {"op": "poll", "request_id": "...", "cursor": 0, "wait_s": 10}
+    <- {"event": "poll", "events": [{"kind": ..., "payload": ...}],
+        "cursor": 3, "closed": false}
+
+which blocks server-side at most ``wait_s`` for the first event past
+``cursor`` — an idle subscriber holds no worker and no thread between
+polls.  A submission refused by scheduling policy (tenant quota, load
+shed) answers ``{"event": "error", "error": ..., "rejected":
+"quota"|"shed"}`` immediately.
+
+``metrics`` returns the full registry in the Prometheus text exposition
+format (``content_type`` names the version) so one sidecar bridge can
+serve it over HTTP unmodified.
 
 ``run_server`` installs SIGTERM/SIGINT handlers that stop accepting,
 drain every in-flight request (subscribers still receive their streamed
@@ -76,6 +91,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 })
             elif op == "submit":
                 self._submit(service, msg)
+            elif op == "poll":
+                self._poll(service, msg)
             else:
                 self._send({"event": "error", "error": f"unknown op {op!r}"})
         except (BrokenPipeError, ConnectionResetError):
@@ -108,7 +125,11 @@ class _Handler(socketserver.StreamRequestHandler):
                 tenant=msg.get("tenant"),
             )
         except (ValueError, RuntimeError) as exc:
-            self._send({"event": "error", "error": str(exc)})
+            err = {"event": "error", "error": str(exc)}
+            kind = getattr(exc, "kind", None)
+            if kind is not None:  # AdmissionRejected: quota | shed
+                err["rejected"] = kind
+            self._send(err)
             return
         self._send({
             "event": "accepted",
@@ -116,6 +137,8 @@ class _Handler(socketserver.StreamRequestHandler):
             "codehash": request.codehash,
             "deduped": deduped,
         })
+        if msg.get("detach"):
+            return  # client follows up via {"op": "poll"}
         for kind, payload in stream.events():
             if kind == "issue":
                 self._send({"event": "issue", **payload})
@@ -123,6 +146,26 @@ class _Handler(socketserver.StreamRequestHandler):
                 self._send({"event": "error", "error": payload})
             else:
                 self._send({"event": "done", **payload})
+
+    def _poll(self, service: AnalysisService, msg: dict) -> None:
+        try:
+            out = service.poll(
+                str(msg.get("request_id", "")),
+                cursor=int(msg.get("cursor", 0)),
+                wait_s=float(msg.get("wait_s", 0.0)),
+            )
+        except KeyError as exc:
+            self._send({"event": "error", "error": str(exc)})
+            return
+        self._send({
+            "event": "poll",
+            "events": [
+                {"kind": kind, "payload": payload}
+                for kind, payload in out["events"]
+            ],
+            "cursor": out["cursor"],
+            "closed": out["closed"],
+        })
 
     def _send(self, obj: dict) -> None:
         self.wfile.write((json.dumps(obj) + "\n").encode())

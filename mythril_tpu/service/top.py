@@ -56,6 +56,32 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         )
     )
 
+    workers = stats.get("workers") or []
+    if workers:
+        states = [w.get("state", "?") for w in workers]
+        restarts = stats.get("service.worker_restarts", 0) or 0
+        summary = (
+            f"workers {len(workers)}  idle {states.count('idle')}  "
+            f"busy {states.count('busy')}"
+        )
+        if restarts:
+            summary += f"  restarts {restarts}"
+        shed = stats.get("service.shed_total", 0) or 0
+        quota = stats.get("service.quota_rejections", 0) or 0
+        if shed or quota:
+            summary += f"  |  shed {shed}  quota-rejected {quota}"
+        lines.append(summary)
+        if not (len(workers) == 1 and workers[0].get("state") == "inline"):
+            lines.append(f"{'worker':<8}{'pid':>8}{'state':<10}"
+                         f"{'batches':>9}{'restarts':>10}{'age':>9}")
+            for w in workers:
+                lines.append(
+                    f"w{w.get('id', '?'):<7}{str(w.get('pid', '-')):>8}"
+                    f"{w.get('state', '?'):<10}{w.get('batches', 0):>9}"
+                    f"{w.get('restarts', 0):>10}"
+                    f"{_ms(w.get('age_s')) if w.get('age_s') else '-':>9}"
+                )
+
     prefilter = stats.get("prefilter") or {}
     if prefilter.get("evaluated"):
         lines.append(

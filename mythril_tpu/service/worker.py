@@ -1,0 +1,266 @@
+"""Worker protocol + process entry point for the horizontal worker pool.
+
+One pool worker is a separate *process* (spawned, never forked: the
+parent may hold a live JAX runtime) running ``worker_main``.  Process
+isolation is what makes N workers legal at all — the engine's
+process-globals (flag singleton, issue sink, interned SMT terms,
+detection caches) exist once per process, so each worker owns a private
+``facade.warm.WorkerContext`` and no engine state is ever shared.  What
+IS shared is on disk: the SMT query cache and the XLA compile cache
+under ``--cache-root`` (both concurrent-shard safe), plus the
+completed-result LRU (``service/resultstore.py``).
+
+Protocol (picklable tuples, first element is the kind):
+
+daemon -> worker, over the worker's private job queue::
+
+    ("batch", job_id, [flight_dict, ...], options_dict)
+    ("stop",)
+
+``flight_dict`` carries ``codehash``/``code``/``request_id``/``tier``;
+``options_dict`` is ``AnalysisOptions.to_dict()`` plus the probe config.
+
+worker -> daemon, over the pool's shared event queue::
+
+    ("ready",   worker_id, pid)                                # warm, idle
+    ("issue",   worker_id, job_id, codehash, wire, source)     # streamed
+    ("done",    worker_id, job_id, payload)                    # terminal
+    ("stopped", worker_id)
+
+``done.payload`` is the authoritative end-of-batch result:
+``issues`` (codehash -> wire list), ``errors`` (codehash -> one-line
+reason), ``elapsed_s``, ``prefilter`` (evaluated/killed deltas),
+``probe_s`` (per-probe walls) and ``first_source`` (codehash ->
+probe|device).  A worker never sends a partial ``done``: a batch-level
+crash inside the engine is converted to per-codehash errors, and a hard
+kill (SIGKILL, OOM) sends nothing — the daemon's liveness monitor turns
+that silence into per-request errors and a respawn (never a silent
+requeue).
+
+Event ordering: the mp queue preserves per-producer FIFO, so a job's
+``issue`` events always precede its ``done`` on the daemon side —
+exactly the replay-then-live contract ``Flight.emit`` needs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Dict, List
+
+from mythril_tpu.service.codehash import issue_digest
+from mythril_tpu.service.request import AnalysisOptions, issue_to_wire
+
+log = logging.getLogger(__name__)
+
+__all__ = ["worker_config", "worker_main"]
+
+#: minimal STOP contract used to pull heavy imports during worker warmup
+_WARMUP_CODE = bytes.fromhex("00")
+
+
+def worker_config(service_config) -> Dict[str, Any]:
+    """Picklable worker-process configuration from a ``ServiceConfig``.
+
+    The workers re-derive the engine configuration from this dict via
+    the same ``apply_analyzer_args`` path the daemon's inline worker
+    uses, so an N-worker pool and a solo run configure identically.
+    """
+    opts = service_config.default_options
+    return {
+        "options": opts.to_dict(),
+        "frontier": service_config.frontier,
+        "cache_root": service_config.cache_root,
+        "warmup": service_config.warmup,
+        "probe": service_config.probe,
+        "probe_timeout_s": service_config.probe_timeout_s,
+    }
+
+
+def _make_context(config: Dict[str, Any]):
+    """Build + arm this process's WorkerContext from the wire config."""
+    from mythril_tpu.facade.mythril_analyzer import AnalyzerArgs
+    from mythril_tpu.facade.warm import WorkerContext
+
+    opts = AnalysisOptions.from_dict(config["options"])
+    return WorkerContext(AnalyzerArgs(
+        strategy=opts.strategy,
+        transaction_count=opts.transaction_count,
+        execution_timeout=opts.execution_timeout,
+        modules=list(opts.modules) if opts.modules else None,
+        frontier=config.get("frontier", False),
+        cache_root=config.get("cache_root"),
+    )).configure()
+
+
+def _make_sink(event_q, worker_id: int, job_id: int,
+               streamed: Dict[str, set], source: str):
+    """Issue-sink closure forwarding confirmations onto the event queue.
+
+    The per-codehash streamed-digest sets span probe AND device phases
+    of one job, so a finding the probe already streamed is not re-sent
+    by the authoritative pass (the daemon keeps its own set as well —
+    belt and braces across the process boundary).
+    """
+    provisional = source == "probe"
+
+    def _sink(issues) -> None:
+        for issue in issues:
+            seen = streamed.get(issue.bytecode_hash)
+            if seen is None:
+                continue
+            digest = issue_digest(issue)
+            if digest in seen:
+                continue
+            seen.add(digest)
+            wire = issue_to_wire(issue)
+            if provisional:
+                wire["provisional"] = True
+            event_q.put(
+                ("issue", worker_id, job_id, issue.bytecode_hash, wire,
+                 source)
+            )
+
+    return _sink
+
+
+def _run_job(ctx, worker_id: int, job_id: int,
+             flights: List[Dict[str, Any]], options: Dict[str, Any],
+             config: Dict[str, Any], event_q) -> None:
+    """Run one admitted batch exactly as the inline worker would."""
+    from mythril_tpu.analysis.cooperative import run_cooperative_batch
+
+    opts = AnalysisOptions.from_dict(options)
+    t0 = time.perf_counter()
+    streamed: Dict[str, set] = {f["codehash"]: set() for f in flights}
+    first_source: Dict[str, str] = {}
+    probe_walls: List[float] = []
+    prefilter: Dict[str, int] = {}
+
+    def _note_first(source):
+        base = _make_sink(event_q, worker_id, job_id, streamed, source)
+
+        def _sink(issues):
+            for issue in issues:
+                first_source.setdefault(issue.bytecode_hash, source)
+            base(issues)
+
+        return _sink
+
+    ctx.reset_scope()
+    with ctx.prefilter_delta(prefilter):
+        if config.get("probe", True):
+            for flight in flights:
+                if flight.get("tier") != "interactive":
+                    continue
+                tp = time.perf_counter()
+                try:
+                    with ctx.probe_scope(), \
+                            ctx.sink_scope(_note_first("probe")):
+                        run_cooperative_batch(
+                            [(flight["codehash"], flight["code"])],
+                            transaction_count=1,
+                            modules=list(opts.modules) if opts.modules
+                            else None,
+                            strategy=opts.strategy,
+                            execution_timeout=min(
+                                config.get("probe_timeout_s", 10),
+                                opts.execution_timeout,
+                            ),
+                            isolate_errors=True,
+                        )
+                except Exception:
+                    log.exception("worker %d probe failed; batch continues",
+                                  worker_id)
+                probe_walls.append(time.perf_counter() - tp)
+            if probe_walls:
+                # the probe ran detectors: sweep their issue lists and
+                # caches so the authoritative pass re-detects everything
+                ctx.reset_scope()
+
+        with ctx.sink_scope(_note_first("device")):
+            issues_by_name, errors_by_name, _states = run_cooperative_batch(
+                [(f["codehash"], f["code"]) for f in flights],
+                transaction_count=opts.transaction_count,
+                modules=list(opts.modules) if opts.modules else None,
+                strategy=opts.strategy,
+                execution_timeout=opts.execution_timeout,
+                isolate_errors=True,
+                request_tags=[f["request_id"] for f in flights],
+            )
+
+    event_q.put(("done", worker_id, job_id, {
+        "issues": {
+            f["codehash"]: [
+                issue_to_wire(i)
+                for i in issues_by_name.get(f["codehash"], [])
+            ]
+            for f in flights
+        },
+        "errors": dict(errors_by_name),
+        "elapsed_s": round(time.perf_counter() - t0, 6),
+        "prefilter": dict(prefilter),
+        "probe_s": probe_walls,
+        "first_source": first_source,
+    }))
+
+
+def worker_main(worker_id: int, config: Dict[str, Any],
+                job_q, event_q) -> None:
+    """Entry point of one pool worker process (spawn target).
+
+    Configures this process's engine from ``config``, optionally runs a
+    warmup analysis, then serves batch jobs until a ``stop`` message.
+    Every failure mode that leaves the process alive is converted into
+    job-scoped errors; only a hard kill is left for the daemon's
+    liveness monitor.
+    """
+    logging.basicConfig(level=logging.ERROR)
+    try:
+        ctx = _make_context(config)
+        if config.get("warmup", False):
+            from mythril_tpu.analysis.cooperative import run_cooperative_batch
+
+            try:
+                run_cooperative_batch(
+                    [("warmup", _WARMUP_CODE)],
+                    transaction_count=1,
+                    execution_timeout=5,
+                    isolate_errors=True,
+                )
+            except Exception:
+                log.exception("worker %d warmup failed; continuing cold",
+                              worker_id)
+            ctx.reset_scope()
+    except Exception:
+        log.exception("worker %d failed to configure; exiting", worker_id)
+        return
+    event_q.put(("ready", worker_id, os.getpid()))
+    while True:
+        msg = job_q.get()
+        if not isinstance(msg, tuple) or not msg:
+            continue
+        if msg[0] == "stop":
+            break
+        if msg[0] != "batch":
+            continue
+        _, job_id, flights, options = msg
+        try:
+            _run_job(ctx, worker_id, job_id, flights, options, config,
+                     event_q)
+        except Exception as exc:
+            # never a partial result: the whole batch errors per-request
+            log.exception("worker %d job %s failed", worker_id, job_id)
+            event_q.put(("done", worker_id, job_id, {
+                "issues": {},
+                "errors": {
+                    f["codehash"]: f"worker batch failure: {exc!r}"
+                    for f in flights
+                },
+                "elapsed_s": 0.0,
+                "prefilter": {},
+                "probe_s": [],
+                "first_source": {},
+            }))
+    event_q.put(("stopped", worker_id))

@@ -12,7 +12,8 @@ from mythril_tpu import absdomain
 from mythril_tpu.observability import get_registry
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.terms import (
-    add, band, concat2, const, eq, land, lnot, mul, udiv, ult, ule, var, zext,
+    add, band, concat2, const, eq, land, lnot, lor, lxor, mul, sle, slt,
+    udiv, ult, ule, var, zext,
 )
 
 
@@ -183,6 +184,77 @@ class TestFallthrough:
         before = reg.counter("prefilter.evaluated").value or 0
         assert absdomain.refute(row)  # fresh evaluation after reset
         assert reg.counter("prefilter.evaluated").value == before + 1
+
+
+class TestWidenedHarvest:
+    """Demand patterns beyond eq/ult/ule/not/and: De Morgan'd or,
+    boolean equality/xor against constants, and the single-interval
+    halves of the signed comparisons."""
+
+    def test_negated_or_distributes(self):
+        # Not(x < 10 or y < 10) pins BOTH x >= 10 and y >= 10
+        x, y = _v("pf_w1"), _v("pf_w2")
+        assert absdomain.refute([
+            lnot(lor(ult(x, const(10, 256)), ult(y, const(10, 256)))),
+            eq(x, const(5, 256)),
+        ])
+
+    def test_negated_or_sat_side(self):
+        x, y = _v("pf_w3"), _v("pf_w4")
+        assert not absdomain.refute([
+            lnot(lor(ult(x, const(10, 256)), ult(y, const(10, 256)))),
+            eq(x, const(20, 256)),
+        ])
+
+    def test_bool_eq_false_asserts_negation(self):
+        # (x < 10) == false is Not(x < 10)
+        x = _v("pf_w5")
+        assert absdomain.refute([
+            eq(ult(x, const(10, 256)), terms.false()),
+            eq(x, const(5, 256)),
+        ])
+
+    def test_bool_xor_true_asserts_negation(self):
+        # (x < 10) xor true is Not(x < 10)
+        x = _v("pf_w6")
+        assert absdomain.refute([
+            lxor(ult(x, const(10, 256)), terms.true()),
+            eq(x, const(5, 256)),
+        ])
+
+    def test_slt_negative_const_upper_bound(self):
+        # x <s -3 confines x to [2^255, 2^256 - 4]; x == 5 contradicts
+        x = _v("pf_w7")
+        neg3 = const((1 << 256) - 3, 256)
+        assert absdomain.refute([slt(x, neg3), eq(x, const(5, 256))])
+        # sat side: x == -4 satisfies x <s -3
+        y = _v("pf_w8")
+        assert not absdomain.refute([
+            slt(y, neg3), eq(y, const((1 << 256) - 4, 256)),
+        ])
+
+    def test_slt_const_lower_bound(self):
+        # 5 <s x confines x to [6, 2^255 - 1]; x == 3 contradicts
+        x = _v("pf_w9")
+        assert absdomain.refute([
+            slt(const(5, 256), x), eq(x, const(3, 256)),
+        ])
+        y = _v("pf_w10")
+        assert not absdomain.refute([
+            slt(const(5, 256), y), eq(y, const(7, 256)),
+        ])
+
+    def test_sle_zero_excludes_negatives(self):
+        # 0 <=s x and x == -1 is a contradiction
+        x = _v("pf_w11")
+        assert absdomain.refute([
+            sle(const(0, 256), x), eq(x, const((1 << 256) - 1, 256)),
+        ])
+
+    def test_slt_min_signed_is_vacuous(self):
+        # x <s INT_MIN has no model at all
+        x = _v("pf_w12")
+        assert absdomain.refute([slt(x, const(1 << 255, 256))])
 
 
 class TestLand:

@@ -1,0 +1,25 @@
+"""Shared fixtures for the service test package."""
+
+import pytest
+
+
+@pytest.fixture
+def scoped_args():
+    """The service arms the global flag object at start(); snapshot and
+    restore it (plus the detector scope) so these tests do not leak
+    configuration into the rest of the suite."""
+    from mythril_tpu.facade.warm import reset_analysis_scope
+    from mythril_tpu.support.support_args import args
+
+    saved = dict(vars(args))
+    yield
+    vars(args).clear()
+    vars(args).update(saved)
+    # the service also re-armed the global query cache; point it back
+    from mythril_tpu.querycache import configure as configure_query_cache
+
+    configure_query_cache(
+        enabled=getattr(args, "query_cache", True),
+        cache_dir=getattr(args, "query_cache_dir", None),
+    )
+    reset_analysis_scope()

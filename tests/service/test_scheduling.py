@@ -1,0 +1,205 @@
+"""Scheduling-policy and long-poll invariants: tenant quotas, load
+shedding, priority aging, and the cursor-based subscribe path — policy
+mechanics against a bare AdmissionController (synthetic codehashes, no
+analysis) plus one real end-to-end long-poll through the service."""
+
+import time
+
+import pytest
+
+from mythril_tpu.service import (
+    AnalysisOptions,
+    AnalysisService,
+    AdmissionRejected,
+    SchedulerPolicy,
+    ServiceConfig,
+)
+from mythril_tpu.service.admission import AdmissionController, Flight
+from mythril_tpu.service.request import AnalysisRequest
+
+OPTS = AnalysisOptions(transaction_count=1)
+CLEAN_HEX = "0x60006000f3"
+
+
+def _req(rid, codehash=None, tier="batch", tenant=None, age_s=0.0):
+    return AnalysisRequest(
+        request_id=rid,
+        name=rid,
+        code=b"\x00",
+        codehash=codehash or ("0x" + rid.ljust(64, "0")),
+        options=OPTS,
+        tier=tier,
+        tenant=tenant,
+        submitted_at=time.time() - age_s,
+    )
+
+
+def _ctl(**policy):
+    return AdmissionController(
+        result_cache_size=8, policy=SchedulerPolicy(**policy)
+    )
+
+
+class TestTenantQuota:
+    def test_over_quota_submission_is_rejected(self):
+        ctl = _ctl(max_pending_per_tenant=2)
+        ctl.submit(_req("a1", tenant="acme"))
+        ctl.submit(_req("a2", tenant="acme"))
+        with pytest.raises(AdmissionRejected) as exc:
+            ctl.submit(_req("a3", tenant="acme"))
+        assert exc.value.kind == "quota"
+        assert ctl.depths()["service.queue_depth"] == 2
+
+    def test_quota_is_per_tenant(self):
+        ctl = _ctl(max_pending_per_tenant=1)
+        ctl.submit(_req("a1", tenant="acme"))
+        # a different tenant is not constrained by acme's quota
+        _stream, deduped = ctl.submit(_req("b1", tenant="blake"))
+        assert deduped is False
+
+    def test_dedup_subscription_is_never_refused(self):
+        # subscribing to an existing flight adds no load: it must not
+        # count against (or be blocked by) the tenant quota
+        ctl = _ctl(max_pending_per_tenant=1)
+        ctl.submit(_req("a1", codehash="0x" + "cc" * 32, tenant="acme"))
+        _stream, deduped = ctl.submit(
+            _req("a2", codehash="0x" + "cc" * 32, tenant="acme")
+        )
+        assert deduped is True
+
+    def test_quota_frees_as_flights_run(self):
+        ctl = _ctl(max_pending_per_tenant=1)
+        ctl.submit(_req("a1", tenant="acme"))
+        ctl.next_batch(max_width=4)  # a1 now running, not pending
+        _stream, deduped = ctl.submit(_req("a2", tenant="acme"))
+        assert deduped is False
+
+
+class TestLoadShed:
+    def test_batch_tier_is_shed_at_depth(self):
+        ctl = _ctl(shed_queue_depth=2)
+        ctl.submit(_req("r1"))
+        ctl.submit(_req("r2"))
+        with pytest.raises(AdmissionRejected) as exc:
+            ctl.submit(_req("r3"))
+        assert exc.value.kind == "shed"
+
+    def test_interactive_tier_is_exempt_from_shedding(self):
+        ctl = _ctl(shed_queue_depth=2)
+        ctl.submit(_req("r1"))
+        ctl.submit(_req("r2"))
+        _stream, deduped = ctl.submit(_req("r3", tier="interactive"))
+        assert deduped is False
+        assert ctl.depths()["service.queue_depth"] == 3
+
+
+class TestPriorityAging:
+    def test_aged_batch_flight_beats_fresh_interactive(self):
+        # a batch flight past age_priority_s joins the interactive
+        # class; within the class FIFO wins, and it is older
+        ctl = _ctl(age_priority_s=5.0)
+        ctl.submit(_req("old", age_s=30.0))
+        ctl.submit(_req("now", tier="interactive"))
+        batch = ctl.next_batch(max_width=1)
+        assert [f.requests[0].request_id for f in batch] == ["old"]
+
+    def test_fresh_batch_still_yields_to_interactive(self):
+        ctl = _ctl(age_priority_s=3600.0)
+        ctl.submit(_req("young"))
+        ctl.submit(_req("urgent", tier="interactive"))
+        batch = ctl.next_batch(max_width=1)
+        assert [f.requests[0].request_id for f in batch] == ["urgent"]
+
+    def test_hot_tenant_cannot_starve_interactive(self):
+        # the starvation scenario: one tenant floods the queue; the
+        # quota bounds what it can hold pending, and a later
+        # interactive submission still jumps straight to the anchor
+        ctl = _ctl(max_pending_per_tenant=4, age_priority_s=3600.0)
+        admitted, rejected = 0, 0
+        for i in range(50):
+            try:
+                ctl.submit(_req(f"hot{i:02d}", tenant="hot"))
+                admitted += 1
+            except AdmissionRejected:
+                rejected += 1
+        assert admitted == 4 and rejected == 46
+        ctl.submit(_req("user1", tier="interactive", tenant="user"))
+        batch = ctl.next_batch(max_width=2)
+        assert batch[0].requests[0].request_id == "user1"
+
+
+class TestFlightPoll:
+    def _flight(self):
+        return Flight(("0x" + "ee" * 32, OPTS.key()), _req("p1"))
+
+    def test_cursor_walks_the_event_log(self):
+        flight = self._flight()
+        flight.emit("accepted", {"request_id": "p1"})
+        events, cursor, closed = flight.poll(0)
+        assert [k for k, _ in events] == ["accepted"]
+        assert (cursor, closed) == (1, False)
+        flight.emit("issue", {"swc_id": "106"})
+        flight.emit("done", {"issues": []})
+        events, cursor, closed = flight.poll(cursor)
+        assert [k for k, _ in events] == ["issue", "done"]
+        assert (cursor, closed) == (3, True)
+        # polling past the end of a finished flight: empty and closed
+        assert flight.poll(cursor) == ([], 3, True)
+
+    def test_poll_blocks_until_event_or_timeout(self):
+        import threading
+
+        flight = self._flight()
+        t0 = time.perf_counter()
+        events, _cursor, _closed = flight.poll(0, wait_s=0.1)
+        assert events == [] and time.perf_counter() - t0 >= 0.09
+
+        timer = threading.Timer(0.05, flight.emit, ("done", {"issues": []}))
+        timer.start()
+        try:
+            events, _cursor, closed = flight.poll(0, wait_s=5.0)
+        finally:
+            timer.cancel()
+        assert [k for k, _ in events] == ["done"] and closed is True
+
+
+class TestServiceLongPoll:
+    def test_poll_replays_the_whole_stream(self, scoped_args):
+        from tests.service.test_service_core import _config
+
+        service = AnalysisService(_config(probe=False)).start()
+        try:
+            req, stream, _ = service.submit(CLEAN_HEX, name="lp")
+            polled, cursor = [], 0
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                out = service.poll(req.request_id, cursor, wait_s=5.0)
+                polled.extend(out["events"])
+                cursor = out["cursor"]
+                if out["closed"]:
+                    break
+            else:
+                pytest.fail("long-poll never closed")
+            streamed = list(stream.events(timeout=30))
+            assert [k for k, _ in polled] == [k for k, _ in streamed]
+            assert polled[-1][0] == "done"
+        finally:
+            service.stop(drain=True, timeout=30)
+
+    def test_unknown_request_id_raises(self, scoped_args):
+        from tests.service.test_service_core import _config
+
+        service = AnalysisService(_config()).start()
+        try:
+            with pytest.raises(KeyError):
+                service.poll("r999999")
+        finally:
+            service.stop(drain=False, timeout=10)
+
+
+def test_config_builds_policy_only_when_armed():
+    assert ServiceConfig().scheduler_policy() is None
+    policy = ServiceConfig(tenant_quota=3, age_priority_s=10.0).scheduler_policy()
+    assert policy is not None
+    assert policy.max_pending_per_tenant == 3
+    assert policy.age_priority_s == 10.0

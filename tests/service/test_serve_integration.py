@@ -80,7 +80,14 @@ def scoped_args():
 def test_concurrent_clients_bit_identical_to_solo(scoped_args):
     """N>=4 concurrent TCP clients (duplicates by construction) each get
     the solo one-shot issue set, streamed, with dedup and a clean drain."""
+    from mythril_tpu.observability.metrics import get_registry
     from mythril_tpu.support.support_args import args
+
+    # persistent counter: earlier tests (crash containment) legitimately
+    # error requests, so assert no NEW errors rather than zero ever
+    errors0 = get_registry().counter(
+        "service.request_errors", persistent=True
+    ).snapshot() or 0
 
     contracts = [
         ("kill", KILL_SIMPLE_HEX),
@@ -158,7 +165,7 @@ def test_concurrent_clients_bit_identical_to_solo(scoped_args):
 
         stats = ServiceClient(host, port).stats()
         assert stats["service.dedup_hits"] >= 3
-        assert stats["service.request_errors"] == 0
+        assert stats["service.request_errors"] == errors0
     finally:
         assert server.stop(drain=True, timeout=120) is True
 
