@@ -1589,20 +1589,28 @@ def _serve_pool_window(requests, opts, solo_digests, workers: int,
     ``serve_pool`` row plus its assertion verdicts.
 
     Digest identity to solo runs is asserted unconditionally (process
-    isolation must never change findings).  The scaling assertion is
-    hardware-gated: N spawned engine processes cannot beat one worker on
-    a single core, so the >= 2x claim (--workers 4, 8 clients) is only
-    enforced when this host has the cores to express it.
+    isolation must never change findings).  The window runs with the
+    fleet fabric ON — worker tracers enabled, delta flushes riding the
+    event multiplex — so the identity assertion doubles as proof the
+    cross-process telemetry never perturbs findings.  The scaling
+    assertion is hardware-gated: N spawned engine processes cannot beat
+    one worker on a single core, so the >= 2x claim (--workers 4,
+    8 clients) is only enforced when this host has the cores to express
+    it.
     """
     import threading
 
     from mythril_tpu.facade.warm import reset_analysis_scope
+    from mythril_tpu.observability.tracer import get_tracer
     from mythril_tpu.service import AnalysisService, ServiceConfig
     from mythril_tpu.service.codehash import issue_digest
 
     _clear_caches()
     reset_analysis_scope()
     clients = len(requests)
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.enabled = True
     service = AnalysisService(ServiceConfig(
         default_options=opts,
         # cap batch width so admitted work fans out across workers
@@ -1613,6 +1621,8 @@ def _serve_pool_window(requests, opts, solo_digests, workers: int,
         probe=True,
         warmup=True,
         workers=workers,
+        trace=True,
+        flush_interval_s=0.25,
     )).start()
     assert service.wait_warm(timeout=300), "worker pool never became ready"
     per_request = []
@@ -1646,7 +1656,13 @@ def _serve_pool_window(requests, opts, solo_digests, workers: int,
         t.join(timeout=900)
     pool_wall = time.perf_counter() - t0
     stats = service.stats()
+    fleet = service.fleet.summary()
     drained = service.stop(drain=True, timeout=60)
+    tracer.enabled = False
+    tsum = tracer.summary()
+    foreign_spans = int(tsum.get("foreign_spans", 0) or 0)
+    tracer.reset()
+    workers_reporting = len(fleet.get("workers") or {})
 
     assert len(per_request) == clients, (
         f"only {len(per_request)}/{clients} pool requests completed"
@@ -1671,7 +1687,10 @@ def _serve_pool_window(requests, opts, solo_digests, workers: int,
         else (speedup or 0.0) >= target
     )
     restarts = int(stats.get("service.worker_restarts") or 0)
-    passed = identical and drained and scaling_ok and restarts == 0
+    # every worker must have reported over the fabric during the window
+    fleet_ok = workers_reporting == workers and foreign_spans > 0
+    passed = (identical and drained and scaling_ok and restarts == 0
+              and fleet_ok)
     row = {
         "unit": "requests/sec",
         "baseline": round(single_rps, 3),
@@ -1696,6 +1715,15 @@ def _serve_pool_window(requests, opts, solo_digests, workers: int,
         "scaling_ok": scaling_ok,
         "worker_restarts": restarts,
         "drained": drained,
+        "fleet": {
+            "workers_reporting": workers_reporting,
+            "replayed": fleet.get("replayed", 0),
+            "discarded": fleet.get("discarded", 0),
+            "foreign_spans": foreign_spans,
+            "rollup_batches": (fleet.get("rollup") or {})
+            .get("counters", {}).get("worker.batches", 0),
+        },
+        "fleet_ok": fleet_ok,
         "pass": passed,
     }
 
@@ -2090,6 +2118,49 @@ def _tracing_overhead_pct(span_rate_hz: float) -> dict:
     }
 
 
+def _fleet_export_overhead_pct(flush_interval_s: float = 0.5) -> dict:
+    """Measure the worker-side cost of one fleet delta flush (collect a
+    registry delta + drain a batch of spans) on THIS machine and scale
+    it by the flush rate to a percent-of-wall figure.  The fabric's
+    contract is the same as the tracer's: leaving it on inside every
+    worker costs <2% of wall."""
+    from mythril_tpu.observability.fleet import FleetPublisher
+    from mythril_tpu.observability.metrics import MetricsRegistry
+    from mythril_tpu.observability.tracer import Tracer
+
+    # a representative worker registry: the scoped counter/gauge set a
+    # real batch leaves behind, plus phase histograms and span traffic
+    reg = MetricsRegistry()
+    tr = Tracer(capacity=8192)
+    tr.enabled = True
+    for i in range(48):
+        reg.counter(f"bench.c{i}").inc(i + 1)
+    for i in range(8):
+        reg.gauge(f"bench.g{i}").set(i)
+    lc = reg.labeled_counter("bench.issues", label_name="swc")
+    for i in range(6):
+        lc.inc(str(100 + i), 1)
+    h = reg.histogram("bench.lat_s")
+    pub = FleetPublisher(0, registry=reg, tracer=tr)
+    pub.collect()  # baseline the full metric set first
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        # keep every flush non-empty the way a busy worker's would be
+        reg.counter("bench.c0").inc()
+        h.observe(0.01)
+        with tr.span("bench.worker_batch", cat="bench"):
+            tr.flow("f", tr.new_flow_id(), "flow.request", cat="bench")
+        pub.collect()
+    per_flush_s = (time.perf_counter() - t0) / n
+    rate_hz = 1.0 / max(flush_interval_s, 1e-6)
+    return {
+        "per_flush_us": round(per_flush_s * 1e6, 3),
+        "flush_rate_hz": round(rate_hz, 1),
+        "overhead_pct": round(100.0 * per_flush_s * rate_hz, 4),
+    }
+
+
 def _gate_span_rate(doc) -> float:
     """Estimate the instrumented-run span emission rate (spans/sec) from a
     bench snapshot's observability block: completed segments over suite wall,
@@ -2205,6 +2276,15 @@ def regression_gate(
             f"({overhead['per_span_us']}us/span x "
             f"{overhead['span_rate_hz']}Hz)"
         )
+    fleet_overhead = _fleet_export_overhead_pct()
+    checks += 1
+    if fleet_overhead["overhead_pct"] >= GATE_TRACING_BUDGET_PCT:
+        violations.append(
+            f"fleet export overhead {fleet_overhead['overhead_pct']:.3f}% "
+            f">= {GATE_TRACING_BUDGET_PCT:.1f}% of wall "
+            f"({fleet_overhead['per_flush_us']}us/flush x "
+            f"{fleet_overhead['flush_rate_hz']}Hz)"
+        )
 
     report = {
         "gate": {
@@ -2214,6 +2294,7 @@ def regression_gate(
             "checks": checks,
             "violations": violations,
             "tracing_overhead": overhead,
+            "fleet_export_overhead": fleet_overhead,
             "tracing_overhead_budget_pct": GATE_TRACING_BUDGET_PCT,
             "pass": not violations,
         }
@@ -2229,7 +2310,9 @@ def regression_gate(
     print(
         f"[bench] regression gate ok vs {against_path}: {checks} checks over "
         f"{len(common)} workloads, tracing overhead "
-        f"{overhead['overhead_pct']:.3f}% < {GATE_TRACING_BUDGET_PCT:.1f}%",
+        f"{overhead['overhead_pct']:.3f}% + fleet export "
+        f"{fleet_overhead['overhead_pct']:.3f}% < "
+        f"{GATE_TRACING_BUDGET_PCT:.1f}%",
         file=sys.stderr,
     )
     return 0

@@ -441,7 +441,15 @@ def create_parser() -> argparse.ArgumentParser:
         "--trace-out", metavar="FILE",
         help="enable tracing for the daemon's lifetime and write a "
         "Chrome-trace JSON on exit (request span trees flow-joined to "
-        "frontier segments)",
+        "frontier segments; with --workers N the trace carries one "
+        "process track per worker, request flows crossing the seam)",
+    )
+    serve.add_argument(
+        "--flight-recorder", metavar="DIR",
+        help="arm the flight recorder for the daemon: an unhandled "
+        "exception, SIGUSR1 or the watchdog dumps a bundle into DIR, "
+        "and with --workers N every live worker contributes a linked "
+        "bundle (stacks + metrics + heartbeat tail) alongside it",
     )
     _add_verbosity(serve)
 
@@ -801,6 +809,7 @@ def execute_command(parsed) -> None:
             tuple(parsed.modules.split(","))
             if getattr(parsed, "modules", None) else None
         )
+        trace_out = getattr(parsed, "trace_out", None)
         config = ServiceConfig(
             default_options=AnalysisOptions(
                 transaction_count=parsed.transaction_count,
@@ -821,6 +830,7 @@ def execute_command(parsed) -> None:
             tenant_quota=getattr(parsed, "tenant_quota", 0),
             shed_queue_depth=getattr(parsed, "shed_depth", 0),
             age_priority_s=getattr(parsed, "age_priority", 0.0),
+            trace=bool(trace_out),
         )
         if getattr(parsed, "heartbeat_out", None):
             from mythril_tpu.observability import get_heartbeat
@@ -829,11 +839,17 @@ def execute_command(parsed) -> None:
                 period_s=parsed.heartbeat_interval,
                 out_path=parsed.heartbeat_out,
             )
-        trace_out = getattr(parsed, "trace_out", None)
         if trace_out:
             from mythril_tpu.observability import get_tracer
 
             get_tracer().enabled = True
+        flight_dir = getattr(parsed, "flight_recorder", None)
+        if flight_dir:
+            # armed on the main thread before run_server so the SIGUSR1
+            # handler lands here, not in a worker
+            from mythril_tpu.observability import arm_flight_recorder
+
+            arm_flight_recorder(flight_dir)
         rc = run_server(config, host=parsed.host, port=parsed.port)
         if trace_out:
             from mythril_tpu.observability import get_tracer

@@ -22,12 +22,20 @@ The convenience re-exports below are the recommended import surface::
         ...
 """
 
+from mythril_tpu.observability.fleet import (  # noqa: F401
+    WIRE_VERSION,
+    FleetAggregator,
+    FleetPublisher,
+)
 from mythril_tpu.observability.flightrecorder import (  # noqa: F401
     FlightRecorder,
     arm_flight_recorder,
+    build_bundle,
     disarm_flight_recorder,
     get_flight_recorder,
+    register_dump_listener,
     register_flight_context,
+    unregister_dump_listener,
     unregister_flight_context,
 )
 from mythril_tpu.observability.heartbeat import (  # noqa: F401

@@ -34,10 +34,13 @@ from typing import Any, Callable, Dict, Optional
 __all__ = [
     "FlightRecorder",
     "arm_flight_recorder",
+    "build_bundle",
     "disarm_flight_recorder",
     "get_flight_recorder",
     "register_flight_context",
     "unregister_flight_context",
+    "register_dump_listener",
+    "unregister_dump_listener",
     "beat",
     "activity",
 ]
@@ -59,6 +62,66 @@ def register_flight_context(name: str, fn: Callable[[], Any]) -> None:
 
 def unregister_flight_context(name: str) -> None:
     _context_sources.pop(name, None)
+
+
+# Dump listeners run after every bundle write with (reason, path, bundle).
+# The analysis service registers one to fan the dump out to its pool
+# workers so a daemon bundle arrives with a linked bundle per process.
+# Listeners must not raise and must not call dump() re-entrantly.
+_dump_listeners: Dict[str, Callable[[str, str, Dict[str, Any]], None]] = {}
+
+
+def register_dump_listener(
+    name: str, fn: Callable[[str, str, Dict[str, Any]], None]
+) -> None:
+    _dump_listeners[name] = fn
+
+
+def unregister_dump_listener(name: str) -> None:
+    _dump_listeners.pop(name, None)
+
+
+def build_bundle(reason: str, extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble a flight bundle dict for this process without writing it.
+
+    Module-level so a pool worker can answer the daemon's bundle request
+    over the event queue without arming a recorder of its own; the armed
+    recorder's ``dump`` builds on the same body.
+    """
+    from mythril_tpu.observability import observability_meta
+    from mythril_tpu.observability.heartbeat import get_heartbeat
+    from mythril_tpu.observability.tracer import get_tracer
+
+    bundle: Dict[str, Any] = {
+        "reason": reason,
+        "time": time.time(),
+        "pid": os.getpid(),
+    }
+    if extra:
+        bundle.update(extra)
+    try:
+        bundle["observability"] = observability_meta()
+    except Exception as e:  # never let the dump path throw
+        bundle["observability_error"] = repr(e)
+    try:
+        tracer = get_tracer()
+        spans = tracer.spans()
+        bundle["spans_tail"] = spans[-SPAN_TAIL:]
+        bundle["spans_dropped"] = tracer.dropped
+    except Exception as e:
+        bundle["spans_error"] = repr(e)
+    try:
+        bundle["heartbeat_tail"] = get_heartbeat().recent_samples()
+    except Exception as e:
+        bundle["heartbeat_error"] = repr(e)
+    for cname, fn in list(_context_sources.items()):
+        ctx = bundle.setdefault("context", {})
+        try:
+            ctx[cname] = fn()
+        except Exception as e:  # one bad source must not kill the dump
+            ctx[cname] = {"error": repr(e)}
+    bundle["threads"] = FlightRecorder._thread_stacks()
+    return bundle
 
 
 class FlightRecorder:
@@ -178,43 +241,13 @@ class FlightRecorder:
 
     def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None) -> str:
         """Write a bundle now; returns the path."""
-        from mythril_tpu.observability import observability_meta
-        from mythril_tpu.observability.heartbeat import get_heartbeat
-        from mythril_tpu.observability.tracer import get_tracer
-
         with self._lock:
             self._bundle_seq += 1
             seq = self._bundle_seq
-        bundle: Dict[str, Any] = {
-            "reason": reason,
-            "time": time.time(),
-            "pid": os.getpid(),
-            "seq": seq,
-        }
-        if extra:
-            bundle.update(extra)
-        try:
-            bundle["observability"] = observability_meta()
-        except Exception as e:  # never let the dump path throw
-            bundle["observability_error"] = repr(e)
-        try:
-            tracer = get_tracer()
-            spans = tracer.spans()
-            bundle["spans_tail"] = spans[-SPAN_TAIL:]
-            bundle["spans_dropped"] = tracer.dropped
-        except Exception as e:
-            bundle["spans_error"] = repr(e)
-        try:
-            bundle["heartbeat_tail"] = get_heartbeat().recent_samples()
-        except Exception as e:
-            bundle["heartbeat_error"] = repr(e)
-        for cname, fn in list(_context_sources.items()):
-            ctx = bundle.setdefault("context", {})
-            try:
-                ctx[cname] = fn()
-            except Exception as e:  # one bad source must not kill the dump
-                ctx[cname] = {"error": repr(e)}
-        bundle["threads"] = self._thread_stacks()
+        bundle = build_bundle(reason, extra)
+        bundle["seq"] = seq
+        # process-unique id so fanned-out worker bundles can link back
+        bundle["bundle_id"] = f"{os.getpid()}-{seq}"
         os.makedirs(self.out_dir, exist_ok=True)
         path = os.path.join(
             self.out_dir, f"flight-{reason}-{os.getpid()}-{seq}.json"
@@ -225,6 +258,11 @@ class FlightRecorder:
         os.replace(tmp, path)
         self.bundles.append(path)
         sys.stderr.write(f"[flight-recorder] {reason}: wrote {path}\n")
+        for _lname, fn in list(_dump_listeners.items()):
+            try:
+                fn(reason, path, bundle)
+            except Exception:
+                pass
         return path
 
     @staticmethod

@@ -61,13 +61,17 @@ class Counter:
     type-stable with the pre-registry singletons.
     """
 
-    __slots__ = ("name", "persistent", "value", "_initial")
+    __slots__ = ("name", "persistent", "value", "gen", "_initial")
 
     def __init__(self, name: str, persistent: bool = False, initial: Number = 0):
         self.name = name
         self.persistent = persistent
         self._initial = initial
         self.value: Number = initial
+        # reset generation: bumped by every reset() so delta consumers
+        # (observability/fleet.py) can tell "swept back to zero" from
+        # "never moved" without guessing from the value
+        self.gen = 0
 
     def inc(self, n: Number = 1) -> None:
         with _MUTATION_LOCK:
@@ -78,6 +82,7 @@ class Counter:
 
     def reset(self) -> None:
         self.value = self._initial
+        self.gen += 1
 
     def snapshot(self) -> Number:
         return self.value
@@ -86,19 +91,21 @@ class Counter:
 class Gauge:
     """Last-write-wins value; may hold any JSON-serializable object."""
 
-    __slots__ = ("name", "persistent", "value", "_default")
+    __slots__ = ("name", "persistent", "value", "gen", "_default")
 
     def __init__(self, name: str, persistent: bool = False, default: Any = 0):
         self.name = name
         self.persistent = persistent
         self._default = default
         self.value: Any = _copy_default(default)
+        self.gen = 0
 
     def set(self, v: Any) -> None:
         self.value = v
 
     def reset(self) -> None:
         self.value = _copy_default(self._default)
+        self.gen += 1
 
     def snapshot(self) -> Any:
         return self.value
@@ -125,6 +132,7 @@ class LabeledCounter(collections.Counter):
         # reads better than {label="x"} for the service's per-tenant
         # counters); keys stay plain strings everywhere else.
         self.label_name = label_name
+        self.gen = 0
 
     def inc(self, label: str, n: Number = 1) -> None:
         """Thread-safe increment (``c[label] += n`` is not atomic)."""
@@ -133,6 +141,7 @@ class LabeledCounter(collections.Counter):
 
     def reset(self) -> None:
         self.clear()
+        self.gen += 1
 
     def snapshot(self) -> Dict[str, Number]:
         return dict(self.most_common())
@@ -155,7 +164,7 @@ class Histogram:
 
     __slots__ = (
         "name", "persistent", "buckets", "bucket_counts",
-        "count", "sum", "min", "max",
+        "count", "sum", "min", "max", "gen",
     )
 
     def __init__(
@@ -172,6 +181,7 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self.gen = 0
 
     def observe(self, v: float) -> None:
         with _MUTATION_LOCK:
@@ -189,6 +199,7 @@ class Histogram:
         self.sum = 0.0
         self.min = None
         self.max = None
+        self.gen += 1
 
     def percentile(self, q: float) -> Optional[float]:
         """Estimate the ``q``-quantile (0..1) from the bucket layout.
@@ -373,11 +384,14 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
             lines.append(f"{pname}_sum {_prom_number(float(total))}")
             lines.append(f"{pname}_count {count}")
         elif isinstance(m, LabeledCounter):
+            # the label *name* is interpolated into the exposition verbatim,
+            # so it must be a legal Prometheus label identifier too
+            lkey = _prom_name(m.label_name or "label")
             lines.append(f"# TYPE {pname} counter")
             for label, v in sorted(m.snapshot().items()):
                 if isinstance(v, (int, float)):
                     lines.append(
-                        f'{pname}{{{m.label_name}="{_prom_label_value(label)}"}}'
+                        f'{pname}{{{lkey}="{_prom_label_value(label)}"}}'
                         f" {_prom_number(v)}"
                     )
         elif isinstance(m, Counter):

@@ -118,6 +118,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=capacity)
         self.dropped = 0
+        # monotonically increasing count of everything ever recorded —
+        # unlike len(_buf) it survives ring eviction, so flush cursors
+        # (observability/fleet.py) can drain exactly-once
+        self.total = 0
         self._origin = time.perf_counter()
         # tid -> human name, captured lazily on first record per thread
         # (worker pools name their threads mythril-feas-N etc.), plus
@@ -125,6 +129,10 @@ class Tracer:
         self._thread_names: Dict[int, str] = {}
         self._track_ids = itertools.count(1)
         self._flow_ids = itertools.count(1)
+        # pid -> {"name", "events" (deque of wire tuples with *absolute*
+        # perf_counter stamps), "tracks", "dropped"} for span batches
+        # folded in from other processes (pool workers)
+        self._foreign: Dict[int, Dict[str, Any]] = {}
 
     # -- recording -----------------------------------------------------
 
@@ -233,6 +241,65 @@ class Tracer:
                 if cur.ident == tid:
                     self._thread_names[tid] = cur.name
             self._buf.append((name, cat, t0 - self._origin, dur, tid, args, ph, fid))
+            self.total += 1
+
+    # -- cross-process fabric ------------------------------------------
+
+    def drain_since(self, cursor: int):
+        """Events recorded after ``cursor`` (a previous return's first
+        element), as wire-format lists with *absolute* ``perf_counter``
+        timestamps, plus the track-name map.
+
+        Returns ``(total, events, track_names)``; pass ``total`` back as
+        the next cursor.  Absolute stamps keep the batch meaningful in a
+        *different* process: ``perf_counter`` is CLOCK_MONOTONIC on
+        Linux, one clock domain for every process on the host, so the
+        aggregating daemon can rebase against its own origin.  Events
+        evicted from the ring between drains are simply lost (already
+        counted in ``dropped``).
+        """
+        with self._lock:
+            total = self.total
+            new = total - cursor
+            if new <= 0:
+                return total, [], {}
+            raw = list(self._buf)[-min(new, len(self._buf)):]
+            names = dict(self._thread_names)
+            origin = self._origin
+        events = [
+            [name, cat, ts + origin, dur, tid, args, ph, fid]
+            for name, cat, ts, dur, tid, args, ph, fid in raw
+        ]
+        return total, events, names
+
+    def ingest_foreign(self, pid: int, process_name: str,
+                       events: List[Any],
+                       track_names: Optional[Dict[Any, str]] = None) -> None:
+        """Fold a ``drain_since`` batch from another process into this
+        tracer, keyed by the producer's pid.
+
+        Timestamps stay absolute until export (``chrome_trace`` rebases
+        them against this tracer's origin), so a ``reset()`` here cannot
+        skew spans recorded remotely.  Each pid's buffer is bounded at
+        ``capacity`` with its own drop counter.
+        """
+        with self._lock:
+            entry = self._foreign.get(pid)
+            if entry is None:
+                entry = self._foreign[pid] = {
+                    "name": process_name,
+                    "events": deque(maxlen=self.capacity),
+                    "tracks": {},
+                    "dropped": 0,
+                }
+            entry["name"] = process_name
+            for tid, tname in (track_names or {}).items():
+                entry["tracks"][int(tid)] = str(tname)
+            buf = entry["events"]
+            for ev in events:
+                if len(buf) == buf.maxlen:
+                    entry["dropped"] += 1
+                buf.append(tuple(ev))
 
     # -- inspection ----------------------------------------------------
 
@@ -264,12 +331,18 @@ class Tracer:
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             n = len(self._buf)
-        return {
+            foreign = sum(len(e["events"]) for e in self._foreign.values())
+            processes = len(self._foreign)
+        out = {
             "enabled": self.enabled,
             "spans": n,
             "dropped": self.dropped,
             "capacity": self.capacity,
         }
+        if processes:
+            out["foreign_spans"] = foreign
+            out["foreign_processes"] = processes
+        return out
 
     def thread_names(self) -> Dict[int, str]:
         """Snapshot of tid -> track name seen so far."""
@@ -282,6 +355,7 @@ class Tracer:
             self.dropped = 0
             self._origin = time.perf_counter()
             self._thread_names.clear()
+            self._foreign.clear()
 
     # -- export --------------------------------------------------------
 
@@ -294,31 +368,24 @@ class Tracer:
             raw = list(self._buf)
             names = dict(self._thread_names)
             dropped = self.dropped
-        events: List[Dict[str, Any]] = [
-            {
-                "name": "process_name",
-                "ph": "M",
-                "pid": pid,
-                "tid": 0,
-                "args": {"name": "mythril-tpu"},
+            origin = self._origin
+            foreign = {
+                fpid: {
+                    "name": entry["name"],
+                    "events": list(entry["events"]),
+                    "tracks": dict(entry["tracks"]),
+                    "dropped": entry["dropped"],
+                }
+                for fpid, entry in self._foreign.items()
             }
-        ]
-        seen_tids = {tid for (_n, _c, _ts, _d, tid, _a, _ph, _f) in raw}
-        for tid in sorted(seen_tids | set(names)):
-            events.append({
-                "name": "thread_name",
-                "ph": "M",
-                "pid": pid,
-                "tid": tid,
-                "args": {"name": names.get(tid, f"thread-{tid}")},
-            })
-        for name, cat, ts, dur, tid, args, ph, fid in raw:
+
+        def _convert(name, cat, rel_ts, dur, tid, args, ph, fid, epid):
             ev = {
                 "name": name,
                 "cat": cat,
                 "ph": ph,
-                "ts": round(ts * 1e6, 3),
-                "pid": pid,
+                "ts": round(rel_ts * 1e6, 3),
+                "pid": epid,
                 "tid": tid,
             }
             if ph == _PH_SPAN:
@@ -331,7 +398,41 @@ class Tracer:
                 ev["id"] = fid
             if args:
                 ev["args"] = args
-            events.append(ev)
+            return ev
+
+        def _meta(epid, proc_name, seen_tids, tid_names):
+            out = [{
+                "name": "process_name",
+                "ph": "M",
+                "pid": epid,
+                "tid": 0,
+                "args": {"name": proc_name},
+            }]
+            for tid in sorted(seen_tids | set(tid_names)):
+                out.append({
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": epid,
+                    "tid": tid,
+                    "args": {"name": tid_names.get(tid, f"thread-{tid}")},
+                })
+            return out
+
+        seen_tids = {tid for (_n, _c, _ts, _d, tid, _a, _ph, _f) in raw}
+        events: List[Dict[str, Any]] = _meta(pid, "mythril-tpu", seen_tids, names)
+        for name, cat, ts, dur, tid, args, ph, fid in raw:
+            events.append(_convert(name, cat, ts, dur, tid, args, ph, fid, pid))
+        # one process track per pool worker; their stamps are absolute
+        # perf_counter values, rebased here against this tracer's origin
+        for fpid in sorted(foreign):
+            entry = foreign[fpid]
+            fseen = {tid for (_n, _c, _ts, _d, tid, _a, _ph, _f)
+                     in entry["events"]}
+            events.extend(_meta(fpid, entry["name"], fseen, entry["tracks"]))
+            for name, cat, abs_ts, dur, tid, args, ph, fid in entry["events"]:
+                events.append(_convert(name, cat, abs_ts - origin, dur, tid,
+                                       args, ph, fid, fpid))
+            dropped += entry["dropped"]
         if dropped:
             # Visible marker so a truncated timeline cannot be mistaken
             # for a complete one (otherData is easy to miss in viewers).

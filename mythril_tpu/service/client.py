@@ -53,10 +53,28 @@ class ServiceClient:
         return {}
 
     def metrics(self) -> str:
-        """The daemon's registry in Prometheus text exposition format."""
+        """The daemon's registry in Prometheus text exposition format.
+
+        When a worker pool is running, the text also carries the fleet's
+        worker-labeled ``fleet_*{worker="N"}`` series and their rollups.
+        """
         for event in self._roundtrip({"op": "metrics"}):
             return event.get("text", "")
         return ""
+
+    def profile(self, worker: int = 0,
+                duration_s: float = 1.0) -> Dict[str, Any]:
+        """Open a windowed ``jax.profiler`` capture in one worker.
+
+        Blocks for the window plus transport slack; returns a dict with
+        ``ok``, ``dir`` (the capture directory under the daemon's cache
+        root) and ``worker``.
+        """
+        for event in self._roundtrip({
+            "op": "profile", "worker": worker, "duration_s": duration_s,
+        }):
+            return event
+        return {"ok": False, "error": "server closed during profile"}
 
     def submit_stream(
         self,

@@ -48,8 +48,10 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import json
 import logging
 import os
+import tempfile
 import threading
 import time
 from collections import OrderedDict
@@ -57,9 +59,12 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from mythril_tpu.observability import tracer as _otrace
+from mythril_tpu.observability.fleet import FleetAggregator
 from mythril_tpu.observability.flightrecorder import (
     get_flight_recorder,
+    register_dump_listener,
     register_flight_context,
+    unregister_dump_listener,
     unregister_flight_context,
 )
 from mythril_tpu.observability.heartbeat import get_heartbeat
@@ -123,6 +128,11 @@ class ServiceConfig:
     tenant_quota: int = 0
     shed_queue_depth: int = 0
     age_priority_s: float = 0.0
+    #: pool workers enable their local tracer and ship span batches back
+    #: over the telemetry fabric (set when the daemon runs --trace-out)
+    trace: bool = False
+    #: worker-side telemetry flush cadence (control-thread idle timeout)
+    flush_interval_s: float = 0.5
 
     def scheduler_policy(self) -> Optional[SchedulerPolicy]:
         if not (self.tenant_quota or self.shed_queue_depth
@@ -191,6 +201,15 @@ class AnalysisService:
             "service.prefilter_killed", persistent=True
         )
         self.telemetry = RequestTelemetry(request_log=self.config.request_log)
+        # cross-process telemetry fold: worker delta payloads land here
+        # (kept separate from the daemon registry so daemon-side sweeps
+        # can never break the worker-sum == rollup invariant)
+        self.fleet = FleetAggregator(
+            flow_resolver=self.telemetry.adopt_worker_flow
+        )
+        self._profile_ids = itertools.count(1)
+        self._profile_waits: Dict[int, Dict[str, Any]] = {}
+        self._profile_lock = threading.Lock()
 
     @property
     def pooled(self) -> bool:
@@ -220,6 +239,11 @@ class AnalysisService:
                 worker_config(self.config),
                 self._on_worker_event,
             )
+            # daemon flight dumps (crash, SIGUSR1, watchdog) fan out a
+            # bundle request to every live worker so operators get one
+            # linked bundle set covering the whole process tree
+            register_dump_listener("service.fleet", self._fanout_bundles)
+            register_flight_context("service.workers", self.worker_stats)
             self._worker = threading.Thread(
                 target=self._pool_dispatch_loop, name="service-dispatch",
                 daemon=True,
@@ -263,6 +287,8 @@ class AnalysisService:
         self._started = False
         get_heartbeat().unregister("service")
         unregister_flight_context("service.requests")
+        unregister_flight_context("service.workers")
+        unregister_dump_listener("service.fleet")
         self.telemetry.close()
         return drained
 
@@ -406,10 +432,29 @@ class AnalysisService:
         return {"events": events, "cursor": new_cursor, "closed": closed}
 
     def worker_stats(self) -> List[Dict[str, Any]]:
-        """Per-worker rows for stats()/``myth top`` (pool or inline)."""
+        """Per-worker rows for stats()/``myth top`` (pool or inline).
+
+        Pool rows are pool liveness state joined with the fleet fold:
+        phase-time percentiles, prefilter kill rate, and the request ids
+        the worker is serving right now."""
         pool = self._pool
         if pool is not None:
-            return pool.stats()
+            with self._jobs_lock:
+                active: Dict[int, List[str]] = {}
+                for job in self._jobs.values():
+                    rids = [
+                        f.requests[0].request_id for f in job["batch"]
+                    ]
+                    active.setdefault(job["worker"], []).extend(rids)
+            rows = pool.stats()
+            for row in rows:
+                row["active_rids"] = active.get(row["id"], [])
+                fleet = self.fleet.worker_summary(row["id"])
+                for key in ("phase_s", "prefilter", "flushes",
+                            "flush_age_s"):
+                    if key in fleet:
+                        row[key] = fleet[key]
+            return rows
         return [{
             "id": 0,
             "pid": os.getpid(),
@@ -459,7 +504,16 @@ class AnalysisService:
         out["phases"] = self.telemetry.phase_stats()
         out["tenants"] = self.telemetry.tenant_stats()
         out["inflight_requests"] = self.telemetry.active_requests()
+        # "fleet" = this daemon aggregates worker processes; "daemon" =
+        # everything in-process (pre-fabric shape, inline worker)
+        out["scope"] = "fleet" if self.pooled else "daemon"
+        if self.pooled:
+            out["fleet"] = self.fleet.summary()
         return out
+
+    def fleet_prometheus_text(self) -> str:
+        """Worker-labeled ``fleet_*`` exposition ("" when not pooled)."""
+        return self.fleet.prometheus_text() if self.pooled else ""
 
     # -- inline worker (one thread owns the engine) --------------------
 
@@ -842,6 +896,19 @@ class AnalysisService:
             if job is None:
                 return
             self._finalize_pool_job(job, payload)
+        elif kind == "telemetry":
+            _, wid, payload = msg
+            self.fleet.apply(wid, payload)
+        elif kind == "flight_bundle":
+            _, wid, bundle_id, bundle = msg
+            self._write_worker_bundle(wid, bundle_id, bundle)
+        elif kind == "profiled":
+            _, _wid, profile_id, result = msg
+            with self._profile_lock:
+                waiter = self._profile_waits.pop(profile_id, None)
+            if waiter is not None:
+                waiter["result"] = result
+                waiter["event"].set()
         elif kind == "worker_died":
             _, wid, job_id, pid = msg
             self._c_restarts.inc()
@@ -910,6 +977,94 @@ class AnalysisService:
                 })
             except Exception:
                 log.exception("flight-recorder dump failed after crash")
+
+    # -- fleet observability (bundle fan-out + profiler windows) -------
+
+    def _fanout_bundles(self, reason: str, path: str,
+                        bundle: Dict[str, Any]) -> None:
+        """Dump listener: ask every live worker for a linked bundle.
+
+        Replies arrive asynchronously as ``flight_bundle`` events on the
+        pool multiplex; ``_write_worker_bundle`` files them next to the
+        daemon bundle with the shared ``bundle_id``.
+        """
+        pool = self._pool
+        if pool is None:
+            return
+        bundle_id = bundle.get("bundle_id") or f"{os.getpid()}-0"
+        reached = pool.broadcast_control(("bundle", bundle_id, reason))
+        log.info("flight dump %s fanned out to workers %s",
+                 bundle_id, reached)
+
+    def _write_worker_bundle(self, wid, bundle_id: str,
+                             bundle: Dict[str, Any]) -> None:
+        rec = get_flight_recorder()
+        out_dir = rec.out_dir if rec is not None else (
+            self.config.cache_root or tempfile.gettempdir()
+        )
+        reason = bundle.get("reason", "bundle")
+        bundle["fleet"] = {
+            "bundle_id": bundle_id,
+            "worker": wid,
+            "role": "worker",
+            "daemon_pid": os.getpid(),
+        }
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(
+                out_dir, f"flight-{reason}-w{wid}-{bundle_id}.json"
+            )
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(bundle, f, indent=1, default=repr)
+            os.replace(tmp, path)
+            if rec is not None:
+                rec.bundles.append(path)
+            log.info("worker %s flight bundle: wrote %s", wid, path)
+        except Exception:
+            log.exception("failed to write worker %s bundle", wid)
+
+    def profile(self, worker_id: int = 0,
+                duration_s: float = 1.0) -> Dict[str, Any]:
+        """Open a windowed ``jax.profiler`` capture inside one worker.
+
+        The capture directory lands under ``--cache-root`` (or the
+        system tempdir).  Pool mode round-trips through the worker's
+        control thread; inline mode profiles this process — the inline
+        worker thread's device work is visible to the process-wide
+        profiler.  Blocks for the window plus transport slack.
+        """
+        duration_s = min(max(float(duration_s), 0.05), 60.0)
+        root = self.config.cache_root or tempfile.gettempdir()
+        profile_id = next(self._profile_ids)
+        out_dir = os.path.join(
+            root, "profiles", f"w{worker_id}-{profile_id}"
+        )
+        pool = self._pool
+        if pool is None:
+            from mythril_tpu.service.worker import _run_profile
+
+            result = _run_profile(duration_s, out_dir, threading.Event())
+            result["worker"] = worker_id
+            return result
+        waiter = {"event": threading.Event(), "result": None}
+        with self._profile_lock:
+            self._profile_waits[profile_id] = waiter
+        if not pool.control(
+            worker_id, ("profile", profile_id, duration_s, out_dir)
+        ):
+            with self._profile_lock:
+                self._profile_waits.pop(profile_id, None)
+            return {"ok": False, "worker": worker_id,
+                    "error": f"worker {worker_id} is not reachable"}
+        if not waiter["event"].wait(duration_s + 30.0):
+            with self._profile_lock:
+                self._profile_waits.pop(profile_id, None)
+            return {"ok": False, "worker": worker_id,
+                    "error": "profile window timed out"}
+        result = dict(waiter["result"] or {})
+        result["worker"] = worker_id
+        return result
 
 
 # Backwards-compatible alias: the wire conversion moved to request.py so

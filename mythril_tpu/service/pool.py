@@ -61,13 +61,19 @@ class WorkerHandle:
         self.current_job: Optional[int] = None
         self.proc = None
         self.job_q = None
+        self.control_q = None
         self.started_at = 0.0
 
     def spawn(self) -> None:
         self.job_q = self._mp.Queue()
+        # fresh control queue per process: a queue fed to a dead process
+        # may hold a wedged feeder thread, and the respawned worker must
+        # not replay the old process's control backlog
+        self.control_q = self._mp.Queue()
         self.proc = self._mp.Process(
             target=worker_main,
-            args=(self.id, self.config, self.job_q, self.event_q),
+            args=(self.id, self.config, self.job_q, self.event_q,
+                  self.control_q),
             name=f"service-worker-{self.id}",
             daemon=True,
         )
@@ -175,6 +181,29 @@ class WorkerPool:
     def wait_ready(self, timeout: Optional[float] = None) -> bool:
         """Block until every worker has reported ready at least once."""
         return self._all_ready.wait(timeout)
+
+    def control(self, worker_id: int, msg: tuple) -> bool:
+        """Send one control message to a live worker's control thread."""
+        with self._lock:
+            if not 0 <= worker_id < len(self.handles):
+                return False
+            h = self.handles[worker_id]
+            if not h.alive() or h.control_q is None:
+                return False
+            q = h.control_q
+        try:
+            q.put(msg)
+            return True
+        except Exception:
+            return False
+
+    def broadcast_control(self, msg: tuple) -> List[int]:
+        """Send a control message to every live worker; returns their ids."""
+        reached = []
+        for h in self.handles:
+            if self.control(h.id, msg):
+                reached.append(h.id)
+        return reached
 
     def stats(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -15,6 +15,15 @@ Protocol (UTF-8, one JSON object per line):
     -> {"op": "ping"}    <- {"event": "pong"}
     -> {"op": "stats"}   <- {"event": "stats", ...counters...}
     -> {"op": "metrics"} <- {"event": "metrics", "text": "<prometheus>"}
+    -> {"op": "profile", "worker": 0, "duration_s": 1.0}
+    <- {"event": "profile", "ok": true, "dir": "...", "worker": 0}
+
+``metrics`` concatenates the daemon-local registry with the fleet's
+worker-labeled series (``fleet_*{worker="N"}`` plus unlabeled rollups)
+when a worker pool is running, so one scrape is pool-wide truth.
+``profile`` opens a windowed ``jax.profiler`` capture inside the chosen
+worker (the daemon process in inline mode) and blocks until the window
+closes; the capture directory lands under ``--cache-root``.
 
 ``submit`` also accepts an optional ``"tenant"`` label for per-tenant
 accounting and ``"detach": true`` — the handler then answers with the
@@ -84,10 +93,21 @@ class _Handler(socketserver.StreamRequestHandler):
             elif op == "metrics":
                 from mythril_tpu.observability.metrics import prometheus_text
 
+                # daemon-local registry first, then the fleet rollup of
+                # worker-labeled series (empty string in inline mode)
                 self._send({
                     "event": "metrics",
                     "content_type": "text/plain; version=0.0.4",
-                    "text": prometheus_text(),
+                    "text": prometheus_text()
+                    + service.fleet_prometheus_text(),
+                })
+            elif op == "profile":
+                self._send({
+                    "event": "profile",
+                    **service.profile(
+                        worker_id=int(msg.get("worker", 0)),
+                        duration_s=float(msg.get("duration_s", 1.0)),
+                    ),
                 })
             elif op == "submit":
                 self._submit(service, msg)

@@ -186,6 +186,12 @@ class RequestTelemetry:
                        n_issues=n_issues, digests=digests,
                        batch_width=batch_width, deduped=deduped,
                        replayed=replayed)
+        # pool mode allocates flows per request (adopt_worker_flow), not
+        # per batch, so retire the binding here to keep the table bounded
+        with self._lock:
+            fid = self._flows.pop(request.request_id, None)
+            if fid is not None:
+                self._flows_emitted.discard(fid)
 
     # -- span tree + flow join ----------------------------------------
 
@@ -215,6 +221,28 @@ class RequestTelemetry:
                     self._flows_emitted.add(fid)
 
         return _emit_flow_targets
+
+    def adopt_worker_flow(self, request_id: str) -> Optional[int]:
+        """Allocate (or reuse) this request's daemon-side flow id when a
+        pool worker reports a ``flow.request`` binding for it.
+
+        This is the fabric's ``flow_resolver``: the worker recorded the
+        "f" endpoint inside its own batch span under a worker-local id;
+        the aggregator remaps that id to the value returned here, and
+        marking it *emitted* licenses ``_emit_span_tree`` to record the
+        matching "s" at terminal time — the arrow crosses the process
+        seam without either side trusting the other's id space.
+        """
+        tr = get_tracer()
+        if not tr.enabled:
+            return None
+        with self._lock:
+            fid = self._flows.get(request_id)
+            if fid is None:
+                fid = tr.new_flow_id()
+                self._flows[request_id] = fid
+            self._flows_emitted.add(fid)
+        return fid
 
     def _emit_span_tree(self, request, entry, phases, now, event, *,
                         deduped, replayed, batch_width) -> None:

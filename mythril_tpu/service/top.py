@@ -40,6 +40,9 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
     title = "mythril-tpu service"
     if address:
         title += f" @ {address}"
+    scope = stats.get("scope")
+    if scope:
+        title += f"  [{scope}]"
     lines.append(title)
     cache = stats.get("cache") or {}
     lines.append(
@@ -72,14 +75,22 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
             summary += f"  |  shed {shed}  quota-rejected {quota}"
         lines.append(summary)
         if not (len(workers) == 1 and workers[0].get("state") == "inline"):
-            lines.append(f"{'worker':<8}{'pid':>8}{'state':<10}"
-                         f"{'batches':>9}{'restarts':>10}{'age':>9}")
+            lines.append(f"{'worker':<8}{'pid':>8} {'state':<10}"
+                         f"{'batches':>9}{'restarts':>10}{'age':>9}"
+                         f"{'exec p50':>10}{'kill%':>7}  rids")
             for w in workers:
+                exec_p50 = ((w.get("phase_s") or {}).get("execute")
+                            or {}).get("p50_s")
+                pf = w.get("prefilter") or {}
+                kill = (f"{pf['kill_rate'] * 100:.0f}%"
+                        if pf.get("evaluated") else "-")
+                rids = ",".join(w.get("active_rids") or []) or "-"
                 lines.append(
-                    f"w{w.get('id', '?'):<7}{str(w.get('pid', '-')):>8}"
+                    f"w{w.get('id', '?'):<7}{str(w.get('pid', '-')):>8} "
                     f"{w.get('state', '?'):<10}{w.get('batches', 0):>9}"
                     f"{w.get('restarts', 0):>10}"
                     f"{_ms(w.get('age_s')) if w.get('age_s') else '-':>9}"
+                    f"{_ms(exec_p50):>10}{kill:>7}  {rids}"
                 )
 
     prefilter = stats.get("prefilter") or {}

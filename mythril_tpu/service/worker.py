@@ -20,12 +20,27 @@ daemon -> worker, over the worker's private job queue::
 ``flight_dict`` carries ``codehash``/``code``/``request_id``/``tier``;
 ``options_dict`` is ``AnalysisOptions.to_dict()`` plus the probe config.
 
-worker -> daemon, over the pool's shared event queue::
+daemon -> worker, over the worker's private *control* queue (drained by
+a background control thread so a busy batch never blocks telemetry)::
+
+    ("bundle",  bundle_id, reason)                 # flight-bundle request
+    ("profile", profile_id, duration_s, out_dir)   # windowed jax.profiler
+
+worker -> daemon, over the pool's shared event queue (every kind keeps
+the worker id at index 1 — the pool's event pump keys liveness on it)::
 
     ("ready",   worker_id, pid)                                # warm, idle
     ("issue",   worker_id, job_id, codehash, wire, source)     # streamed
     ("done",    worker_id, job_id, payload)                    # terminal
+    ("telemetry", worker_id, payload)              # fleet delta snapshot
+    ("flight_bundle", worker_id, bundle_id, bundle_dict)
+    ("profiled", worker_id, profile_id, result_dict)
     ("stopped", worker_id)
+
+Telemetry rides the same multiplex as results, so per-producer FIFO
+gives the daemon a worker's span/metric flush *before* the ``done`` it
+describes — the fabric needs no second channel and no clock games
+(``observability/fleet.py`` has the wire format).
 
 ``done.payload`` is the authoritative end-of-batch result:
 ``issues`` (codehash -> wire list), ``errors`` (codehash -> one-line
@@ -46,8 +61,10 @@ from __future__ import annotations
 
 import logging
 import os
+import queue as queue_mod
+import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from mythril_tpu.service.codehash import issue_digest
 from mythril_tpu.service.request import AnalysisOptions, issue_to_wire
@@ -55,6 +72,9 @@ from mythril_tpu.service.request import AnalysisOptions, issue_to_wire
 log = logging.getLogger(__name__)
 
 __all__ = ["worker_config", "worker_main"]
+
+#: telemetry flush cadence when the control thread is otherwise idle
+DEFAULT_FLUSH_INTERVAL_S = 0.5
 
 #: minimal STOP contract used to pull heavy imports during worker warmup
 _WARMUP_CODE = bytes.fromhex("00")
@@ -75,6 +95,12 @@ def worker_config(service_config) -> Dict[str, Any]:
         "warmup": service_config.warmup,
         "probe": service_config.probe,
         "probe_timeout_s": service_config.probe_timeout_s,
+        "trace": getattr(service_config, "trace", False),
+        "heartbeat": service_config.heartbeat,
+        "heartbeat_interval_s": service_config.heartbeat_interval_s,
+        "flush_interval_s": getattr(
+            service_config, "flush_interval_s", DEFAULT_FLUSH_INTERVAL_S
+        ),
     }
 
 
@@ -127,11 +153,13 @@ def _make_sink(event_q, worker_id: int, job_id: int,
 
 def _run_job(ctx, worker_id: int, job_id: int,
              flights: List[Dict[str, Any]], options: Dict[str, Any],
-             config: Dict[str, Any], event_q) -> None:
+             config: Dict[str, Any], event_q, publisher=None) -> None:
     """Run one admitted batch exactly as the inline worker would."""
     from mythril_tpu.analysis.cooperative import run_cooperative_batch
+    from mythril_tpu.observability import get_registry, get_tracer
 
     opts = AnalysisOptions.from_dict(options)
+    tracer = get_tracer()
     t0 = time.perf_counter()
     streamed: Dict[str, set] = {f["codehash"]: set() for f in flights}
     first_source: Dict[str, str] = {}
@@ -149,7 +177,20 @@ def _run_job(ctx, worker_id: int, job_id: int,
         return _sink
 
     ctx.reset_scope()
-    with ctx.prefilter_delta(prefilter):
+    with ctx.prefilter_delta(prefilter), \
+            tracer.span("service.worker_batch", cat="service",
+                        job=job_id, width=len(flights)):
+        # flow.request arrows across the process seam: emit the "f"
+        # endpoint inside the batch span (the slice serving the request)
+        # and ship the fid -> request-id binding with the next flush so
+        # the daemon can remap it onto the request's own flow id.  The
+        # binding is noted BEFORE the event is recorded — no flush can
+        # ship the span without its binding.
+        if publisher is not None and tracer.enabled:
+            for flight in flights:
+                fid = tracer.new_flow_id()
+                publisher.note_flow(fid, flight["request_id"])
+                tracer.flow("f", fid, "flow.request", cat="service")
         if config.get("probe", True):
             for flight in flights:
                 if flight.get("tier") != "interactive":
@@ -190,6 +231,22 @@ def _run_job(ctx, worker_id: int, job_id: int,
                 request_tags=[f["request_id"] for f in flights],
             )
 
+    elapsed = time.perf_counter() - t0
+    # persistent: survives the per-batch analysis-scope sweep, so the
+    # fleet's per-worker phase-time series accumulate across batches
+    reg = get_registry()
+    reg.histogram("worker.execute_s", persistent=True).observe(elapsed)
+    for w in probe_walls:
+        reg.histogram("worker.probe_s", persistent=True).observe(w)
+    reg.counter("worker.batches", persistent=True).inc()
+    if publisher is not None:
+        # ship the batch's spans/metrics ahead of its "done" (FIFO)
+        try:
+            publisher.flush(event_q)
+        except Exception:
+            log.debug("worker %d telemetry flush failed", worker_id,
+                      exc_info=True)
+
     event_q.put(("done", worker_id, job_id, {
         "issues": {
             f["codehash"]: [
@@ -199,15 +256,86 @@ def _run_job(ctx, worker_id: int, job_id: int,
             for f in flights
         },
         "errors": dict(errors_by_name),
-        "elapsed_s": round(time.perf_counter() - t0, 6),
+        "elapsed_s": round(elapsed, 6),
         "prefilter": dict(prefilter),
         "probe_s": probe_walls,
         "first_source": first_source,
     }))
 
 
+def _run_profile(duration_s: float, out_dir: str,
+                 stop_ev: threading.Event) -> Dict[str, Any]:
+    """Windowed ``jax.profiler`` capture; always returns a result dict."""
+    t0 = time.perf_counter()
+    try:
+        import jax.profiler
+
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+        try:
+            # stop_ev short-circuits the window on worker shutdown
+            stop_ev.wait(min(max(float(duration_s), 0.05), 60.0))
+        finally:
+            jax.profiler.stop_trace()
+        return {
+            "ok": True,
+            "dir": out_dir,
+            "duration_s": round(time.perf_counter() - t0, 3),
+        }
+    except Exception as e:
+        return {"ok": False, "error": repr(e), "dir": out_dir}
+
+
+def _control_loop(worker_id: int, config: Dict[str, Any], control_q,
+                  event_q, publisher, stop_ev: threading.Event) -> None:
+    """Background thread: periodic telemetry flush + control verbs.
+
+    Runs beside the batch loop so a long-running batch still ships
+    deltas, answers flight-bundle fan-outs (``sys._current_frames``
+    captures the busy main thread mid-batch), and opens profiler
+    windows.  Pure observer: it never touches the WorkerContext, so it
+    cannot perturb issue digests.
+    """
+    interval = float(config.get("flush_interval_s",
+                                DEFAULT_FLUSH_INTERVAL_S))
+    while not stop_ev.is_set():
+        try:
+            msg = control_q.get(timeout=interval)
+        except queue_mod.Empty:
+            msg = None
+        except (EOFError, OSError):
+            break
+        if isinstance(msg, tuple) and msg:
+            kind = msg[0]
+            if kind == "bundle":
+                from mythril_tpu.observability.flightrecorder import (
+                    build_bundle,
+                )
+
+                _, bundle_id, reason = msg
+                try:
+                    bundle = build_bundle(reason)
+                except Exception as e:
+                    bundle = {"reason": reason, "pid": os.getpid(),
+                              "error": repr(e)}
+                event_q.put(
+                    ("flight_bundle", worker_id, bundle_id, bundle)
+                )
+            elif kind == "profile":
+                _, profile_id, duration_s, out_dir = msg
+                event_q.put(("profiled", worker_id, profile_id,
+                             _run_profile(duration_s, out_dir, stop_ev)))
+        try:
+            publisher.flush(event_q)
+        except (EOFError, OSError, ValueError):
+            break
+        except Exception:
+            log.debug("worker %d telemetry flush failed", worker_id,
+                      exc_info=True)
+
+
 def worker_main(worker_id: int, config: Dict[str, Any],
-                job_q, event_q) -> None:
+                job_q, event_q, control_q=None) -> None:
     """Entry point of one pool worker process (spawn target).
 
     Configures this process's engine from ``config``, optionally runs a
@@ -217,6 +345,12 @@ def worker_main(worker_id: int, config: Dict[str, Any],
     liveness monitor.
     """
     logging.basicConfig(level=logging.ERROR)
+    from mythril_tpu.observability import get_heartbeat, get_tracer
+    from mythril_tpu.observability.fleet import FleetPublisher
+
+    if config.get("trace"):
+        get_tracer().enabled = True
+    publisher = FleetPublisher(worker_id)
     try:
         ctx = _make_context(config)
         if config.get("warmup", False):
@@ -236,6 +370,27 @@ def worker_main(worker_id: int, config: Dict[str, Any],
     except Exception:
         log.exception("worker %d failed to configure; exiting", worker_id)
         return
+    # heartbeat runs here too — worker arena/queue-depth gauges exist in
+    # the worker's registry and reach the daemon as fleet gauge samples
+    if config.get("heartbeat", True):
+        hb = get_heartbeat()
+        hb.register(
+            "worker",
+            lambda: {"worker.interned_terms":
+                     ctx.stats().get("interned_terms", 0)},
+        )
+        hb.start(period_s=float(config.get("heartbeat_interval_s", 0.5)))
+    stop_ev = threading.Event()
+    control_thread: Optional[threading.Thread] = None
+    if control_q is not None:
+        control_thread = threading.Thread(
+            target=_control_loop,
+            args=(worker_id, config, control_q, event_q, publisher,
+                  stop_ev),
+            name=f"mythril-worker-{worker_id}-control",
+            daemon=True,
+        )
+        control_thread.start()
     event_q.put(("ready", worker_id, os.getpid()))
     while True:
         msg = job_q.get()
@@ -248,7 +403,7 @@ def worker_main(worker_id: int, config: Dict[str, Any],
         _, job_id, flights, options = msg
         try:
             _run_job(ctx, worker_id, job_id, flights, options, config,
-                     event_q)
+                     event_q, publisher=publisher)
         except Exception as exc:
             # never a partial result: the whole batch errors per-request
             log.exception("worker %d job %s failed", worker_id, job_id)
@@ -263,4 +418,11 @@ def worker_main(worker_id: int, config: Dict[str, Any],
                 "probe_s": [],
                 "first_source": {},
             }))
+    stop_ev.set()
+    if control_thread is not None:
+        control_thread.join(timeout=2.0)
+    try:
+        publisher.flush(event_q)
+    except Exception:
+        pass
     event_q.put(("stopped", worker_id))

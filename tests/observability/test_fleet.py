@@ -1,0 +1,247 @@
+"""Delta-merge algebra tests for the fleet telemetry fabric.
+
+Exercises publisher/aggregator pairs over private registries: replayed
+payloads must be idempotent, merge order across workers must not matter,
+histogram invariants must hold on the aggregated side, and the reset
+generations must keep deltas exact across the per-batch registry sweep
+without changing persistent-metric semantics.
+"""
+
+import pytest
+
+from mythril_tpu.observability.fleet import (
+    WIRE_VERSION,
+    FleetAggregator,
+    FleetPublisher,
+)
+from mythril_tpu.observability.metrics import MetricsRegistry
+from mythril_tpu.observability.tracer import Tracer
+
+
+def _pair(worker_id=0):
+    reg = MetricsRegistry()
+    tr = Tracer(capacity=1000)
+    return reg, tr, FleetPublisher(worker_id, registry=reg, tracer=tr)
+
+
+def _disabled_tracer():
+    return Tracer(capacity=16)
+
+
+def test_counter_delta_only_ships_increments():
+    reg, _tr, pub = _pair()
+    c = reg.counter("a")
+    c.inc(3)
+    p1 = pub.collect()
+    assert p1["counters"] == {"a": 3}
+    # nothing moved: no payload at all
+    assert pub.collect() is None
+    c.inc(2)
+    p2 = pub.collect()
+    assert p2["counters"] == {"a": 2}
+    assert p2["seq"] == p1["seq"] + 1
+
+
+def test_replayed_payload_is_idempotent():
+    reg, _tr, pub = _pair()
+    reg.counter("a").inc(5)
+    payload = pub.collect()
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    assert agg.apply(0, payload) is True
+    assert agg.apply(0, payload) is False  # same (pid, seq): dropped
+    assert agg.apply(0, dict(payload)) is False
+    assert agg.replayed == 2
+    assert agg.summary()["rollup"]["counters"]["a"] == 5
+
+
+def test_wire_version_mismatch_is_discarded():
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    assert agg.apply(0, {"v": WIRE_VERSION + 1, "seq": 1, "pid": 1}) is False
+    assert agg.apply(0, "not a payload") is False
+    assert agg.discarded == 2
+
+
+def test_respawned_worker_pid_resets_sequence_tracking():
+    reg, _tr, pub = _pair()
+    reg.counter("a").inc(2)
+    payload = pub.collect()
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    assert agg.apply(0, payload) is True
+    # a respawned worker restarts seq at 1 under a new pid: accepted
+    fresh = dict(payload)
+    fresh["pid"] = payload["pid"] + 1
+    fresh["seq"] = 1
+    assert agg.apply(0, fresh) is True
+    assert agg.summary()["rollup"]["counters"]["a"] == 4
+
+
+def test_merge_commutative_across_workers():
+    payloads = []
+    for wid in (0, 1):
+        reg, _tr, pub = _pair(wid)
+        reg.counter("a").inc(3 + wid)
+        reg.labeled_counter("issues", label_name="swc").inc("106", 2 + wid)
+        reg.histogram("lat", buckets=(0.1, 1.0)).observe(0.05 * (wid + 1))
+        payloads.append((wid, pub.collect()))
+
+    def fold(order):
+        agg = FleetAggregator(tracer=_disabled_tracer())
+        for wid, p in order:
+            assert agg.apply(wid, p) is True
+        return agg
+
+    fwd = fold(payloads)
+    rev = fold(list(reversed(payloads)))
+    assert fwd.summary()["rollup"] == rev.summary()["rollup"]
+    assert fwd.prometheus_text() == rev.prometheus_text()
+    assert fwd.summary()["rollup"]["counters"]["a"] == 7
+
+
+def test_histogram_invariants_after_merge():
+    reg, _tr, pub = _pair()
+    h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    agg.apply(0, pub.collect())
+    h.observe(0.02)
+    agg.apply(0, pub.collect())
+
+    merged = agg._workers[0].hists["lat"]
+    assert sum(merged.bucket_counts) == merged.count == 5
+    assert merged.sum == pytest.approx(5.575)
+    assert merged.min == pytest.approx(0.005)
+    assert merged.max == pytest.approx(5.0)
+
+    text = agg.prometheus_text()
+    # cumulative buckets end at the total count, and the +Inf bucket
+    # equals fleet_lat_count
+    assert 'fleet_lat_bucket{le="+Inf",worker="0"} 5' in text
+    assert 'fleet_lat_count{worker="0"} 5' in text
+
+
+def test_reset_generation_keeps_deltas_exact_across_sweep():
+    reg, _tr, pub = _pair()
+    c = reg.counter("a")
+    c.inc(3)
+    p1 = pub.collect()
+    # the per-batch sweep: non-persistent metrics reset between flushes
+    reg.reset()
+    c.inc(5)
+    p2 = pub.collect()
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    agg.apply(0, p1)
+    agg.apply(0, p2)
+    # naive current-minus-baseline would have shipped 5 - 3 = 2
+    assert agg.summary()["rollup"]["counters"]["a"] == 8
+
+
+def test_persistent_metrics_survive_sweep_with_exact_deltas():
+    reg, _tr, pub = _pair()
+    p = reg.counter("keep", persistent=True)
+    p.inc(4)
+    assert pub.collect()["counters"] == {"keep": 4}
+    reg.reset()  # sweep must not touch the persistent counter
+    assert p.snapshot() == 4
+    p.inc(1)
+    assert pub.collect()["counters"] == {"keep": 1}
+
+
+def test_gauges_ship_absolute_values_on_change_only():
+    reg, _tr, pub = _pair()
+    g = reg.gauge("depth")
+    g.set(7)
+    assert pub.collect()["gauges"] == {"depth": 7}
+    assert pub.collect() is None  # unchanged: not resent
+    g.set(3)
+    payload = pub.collect()
+    assert payload["gauges"] == {"depth": 3}
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    agg.apply(0, payload)
+    # gauges overwrite, they never accumulate
+    assert agg._workers[0].gauges["depth"] == 3
+
+
+def test_labeled_counter_rollup_sums_per_worker_series():
+    payloads = []
+    for wid in (0, 1):
+        reg, _tr, pub = _pair(wid)
+        reg.labeled_counter("issues", label_name="swc").inc("106", wid + 1)
+        payloads.append((wid, pub.collect()))
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    for wid, p in payloads:
+        agg.apply(wid, p)
+    text = agg.prometheus_text()
+    assert 'fleet_issues{swc="106",worker="0"} 1' in text
+    assert 'fleet_issues{swc="106",worker="1"} 2' in text
+    assert 'fleet_issues{swc="106"} 3' in text
+
+
+def test_prometheus_rollup_equals_worker_sum():
+    payloads = []
+    for wid, n in ((0, 3), (1, 9)):
+        reg, _tr, pub = _pair(wid)
+        reg.counter("batches").inc(n)
+        payloads.append((wid, pub.collect()))
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    for wid, p in payloads:
+        agg.apply(wid, p)
+    lines = agg.prometheus_text().splitlines()
+    per = sum(
+        float(l.rsplit(" ", 1)[1]) for l in lines
+        if l.startswith("fleet_batches{")
+    )
+    rollup = [
+        float(l.rsplit(" ", 1)[1]) for l in lines
+        if l.startswith("fleet_batches ")
+    ]
+    assert rollup == [per] == [12.0]
+
+
+def test_span_batches_remap_flow_ids_across_the_seam():
+    reg, wtr, pub = _pair()
+    wtr.enabled = True
+    fid = wtr.new_flow_id()
+    pub.note_flow(fid, "rid-1")
+    with wtr.span("service.worker_batch", cat="service"):
+        wtr.flow("f", fid, "flow.request", cat="service")
+    payload = pub.collect()
+    assert payload["flows"] == [[fid, "rid-1"]]
+    assert payload["spans"]
+
+    dtr = Tracer(capacity=1000)
+    dtr.enabled = True
+    daemon_fid = dtr.new_flow_id()
+    resolved = []
+
+    def resolver(rid):
+        resolved.append(rid)
+        return daemon_fid
+
+    agg = FleetAggregator(tracer=dtr, flow_resolver=resolver)
+    assert agg.apply(0, payload) is True
+    assert resolved == ["rid-1"]
+    trace = dtr.chrome_trace()
+    flows = [e for e in trace["traceEvents"] if e.get("ph") == "f"]
+    assert flows and all(e["id"] == daemon_fid for e in flows)
+    procs = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "mythril-worker-0" in procs
+
+
+def test_worker_summary_exposes_phase_times_and_kill_rate():
+    reg, _tr, pub = _pair()
+    reg.histogram("worker.execute_s", persistent=True).observe(0.25)
+    reg.counter("prefilter.evaluated").inc(8)
+    reg.counter("prefilter.killed").inc(2)
+    agg = FleetAggregator(tracer=_disabled_tracer())
+    agg.apply(0, pub.collect())
+    row = agg.worker_summary(0)
+    assert row["phase_s"]["execute"]["count"] == 1
+    assert row["phase_s"]["execute"]["avg_s"] == pytest.approx(0.25)
+    assert row["prefilter"] == {
+        "evaluated": 8, "killed": 2, "kill_rate": 0.25,
+    }

@@ -160,3 +160,39 @@ def test_prometheus_text_exposition(reg):
     assert "note" not in text and "blob" not in text
     # names are sanitized to the exposition charset
     assert "svc.requests" not in text
+
+
+def test_prometheus_label_values_escaped(reg):
+    """Regression: label *values* are interpolated into the exposition
+    inside double quotes, so backslash, quote and newline must be
+    escaped per the text-format spec or one hostile tenant label breaks
+    the whole scrape."""
+    from mythril_tpu.observability.metrics import prometheus_text
+
+    reg.labeled_counter("svc.tenant_requests", label_name="tenant").inc(
+        'evil"corp\\with\nnewline', 1
+    )
+    text = prometheus_text(reg)
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("svc_tenant_requests{")
+    )
+    assert line == (
+        'svc_tenant_requests{tenant="evil\\"corp\\\\with\\nnewline"} 1'
+    )
+    # the exposition stays one-sample-per-line: no raw newline leaked
+    assert all(
+        l.startswith(("#", "svc_")) for l in text.splitlines() if l
+    )
+
+
+def test_prometheus_label_names_sanitized(reg):
+    """A label *name* is interpolated verbatim (it cannot be quoted), so
+    it is sanitized to the identifier charset like metric names are."""
+    from mythril_tpu.observability.metrics import prometheus_text
+
+    reg.labeled_counter("svc.by_kind", label_name="kind-of.thing").inc(
+        "x", 2
+    )
+    text = prometheus_text(reg)
+    assert 'svc_by_kind{kind_of_thing="x"} 2' in text

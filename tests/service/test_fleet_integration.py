@@ -1,0 +1,143 @@
+"""Fleet fabric end-to-end: a 2-worker pool feeding one daemon-side
+aggregator.  One pooled service serves every assertion (worker spawn is
+the expensive part): worker-labeled scrape summing to the rollup,
+per-worker stats rows, cross-seam trace flows, and the linked
+multi-process flight bundles."""
+
+import json
+import os
+import time
+
+import pytest
+
+from mythril_tpu.service import (
+    AnalysisOptions,
+    AnalysisService,
+    ServiceConfig,
+)
+
+from .test_pool import CLEAN_HEX, KILL_SIMPLE_HEX
+
+OPTS = AnalysisOptions(transaction_count=1, execution_timeout=30)
+
+
+@pytest.fixture
+def fleet_tracer():
+    from mythril_tpu.observability import get_tracer
+
+    tr = get_tracer()
+    tr.enabled = True
+    yield tr
+    tr.enabled = False
+    tr.reset()
+
+
+def _wait(predicate, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_fleet_scrape_trace_and_bundles(scoped_args, tmp_path, fleet_tracer):
+    from mythril_tpu.observability import (
+        arm_flight_recorder,
+        disarm_flight_recorder,
+    )
+
+    rec = arm_flight_recorder(str(tmp_path / "flight"))
+    service = AnalysisService(ServiceConfig(
+        default_options=OPTS,
+        max_batch_width=1,  # one flight per job: fan out across workers
+        batch_window_s=0.05,
+        frontier=False,
+        probe=False,
+        warmup=False,
+        workers=2,
+        cache_root=str(tmp_path / "cache"),
+        trace=True,
+        flush_interval_s=0.1,
+    )).start()
+    try:
+        assert service.wait_warm(timeout=600) is True
+        _r1, s1, _ = service.submit(KILL_SIMPLE_HEX, name="a", tenant="t1")
+        _r2, s2, _ = service.submit(CLEAN_HEX, name="b", tenant="t2")
+        assert [i["swc_id"] for i in s1.result(timeout=180)["issues"]]
+        assert s2.result(timeout=180)["issues"] == []
+
+        # both workers have flushed at least once (heartbeat gauges ride
+        # the delta payloads even on the worker that ran no batch)
+        assert _wait(lambda: len(service.fleet.workers()) == 2)
+
+        # scrape: every worker-labeled fleet series sums to its rollup
+        text = service.fleet_prometheus_text()
+        per, rollup = {}, {}
+        for line in text.splitlines():
+            if line.startswith("#") or "_bucket{" in line:
+                continue
+            name, value = line.rsplit(" ", 1)
+            if 'worker="' in name:
+                base = name.split("{")[0]
+                if "," in name:
+                    continue  # labeled/dict series: label-keyed totals
+                per[base] = per.get(base, 0.0) + float(value)
+            elif "{" not in name:
+                rollup[name] = float(value)
+        assert per and rollup
+        for base, total in per.items():
+            assert rollup[base] == pytest.approx(total), base
+        batches = service.fleet.summary()["rollup"]["counters"]
+        assert batches.get("worker.batches", 0) >= 2
+
+        # stats: fleet scope + per-worker operator columns
+        stats = service.stats()
+        assert stats["scope"] == "fleet"
+        assert "fleet" in stats
+        rows = service.worker_stats()
+        assert len(rows) == 2
+        executed = [r for r in rows if (r.get("phase_s") or {}).get("execute")]
+        assert executed, "no worker row carries execute phase times"
+        assert all("active_rids" in r for r in rows)
+
+        # flight bundles: the daemon dump fans out to every live worker
+        path = rec.dump("fleet.test")
+        daemon_bundle = json.load(open(path))
+        bundle_id = daemon_bundle["bundle_id"]
+        out_dir = rec.out_dir
+
+        def worker_bundles():
+            return sorted(
+                f for f in os.listdir(out_dir)
+                if f"-{bundle_id}.json" in f and "-w" in f
+            )
+
+        assert _wait(lambda: len(worker_bundles()) == 2), worker_bundles()
+        for fname in worker_bundles():
+            b = json.load(open(os.path.join(out_dir, fname)))
+            assert b["fleet"]["bundle_id"] == bundle_id
+            assert b["fleet"]["role"] == "worker"
+            assert b["pid"] != daemon_bundle["pid"]
+            assert "threads" in b and "observability" in b
+    finally:
+        assert service.stop(drain=True, timeout=60) is True
+        disarm_flight_recorder()
+
+    # trace: daemon track + at least one worker process track, and each
+    # cross-seam flow start has a matching finish on a shared id
+    trace = fleet_tracer.chrome_trace()
+    events = trace["traceEvents"]
+    procs = {
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "mythril-tpu" in procs
+    assert any(p.startswith("mythril-worker-") for p in procs)
+    starts = {e["id"] for e in events if e.get("ph") == "s"}
+    ends = {e["id"] for e in events if e.get("ph") == "f"}
+    assert starts and starts == ends
+    # worker spans were rebased into the daemon clock domain: no event
+    # may land before the daemon's own first event
+    ts = [e["ts"] for e in events if e.get("ph") == "X"]
+    assert ts and min(ts) >= 0
