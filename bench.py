@@ -1780,6 +1780,7 @@ def _new_row_data():
         "harvest_shares": [],
         "harvest_phases": [],  # per-production-rep {phase: seconds} deltas
         "prefilter": [],  # per-production-rep prefilter.* counter deltas
+        "exploration": [],  # per-production-rep termination/coverage deltas
         "mids": [],  # per-production-rep (mid_reentered, mid_bounced, semantic_parked)
         # accumulated per-tag [hits, misses] deltas of the persistent XLA
         # compile cache — did this workload's programs come off disk?
@@ -1805,6 +1806,28 @@ def _prefilter_summary(samples) -> dict:
         round(out["killed"] / out["evaluated"], 4) if out["evaluated"] else 0.0
     )
     return out
+
+
+def _exploration_summary(samples) -> dict:
+    """Median termination-class deltas + instruction coverage per rep —
+    the exploration-quality row the coverage gate compares."""
+    from mythril_tpu.observability.exploration import TERM_CLASSES
+
+    term = {
+        cls: _median([s["terminated"].get(cls, 0) for s in samples])
+        for cls in TERM_CLASSES
+    }
+    covs = [
+        s["coverage_pct"] for s in samples
+        if s.get("coverage_pct") is not None
+    ]
+    return {
+        "terminated": {cls: n for cls, n in term.items() if n},
+        "terminated_total": _median(
+            [s["terminated_total"] for s in samples]
+        ),
+        "coverage_pct": round(_median(covs), 2) if covs else None,
+    }
 
 
 def _row_summary(unit: str, d: dict) -> dict:
@@ -1896,6 +1919,14 @@ def _row_summary(unit: str, d: dict) -> dict:
         **(
             {"prefilter": _prefilter_summary(d["prefilter"])}
             if d.get("prefilter")
+            else {}
+        ),
+        # exploration quality (production runs): how many paths stopped,
+        # why (the eight-class termination partition), and how much of
+        # each contract's instruction space the run actually visited
+        **(
+            {"exploration": _exploration_summary(d["exploration"])}
+            if d.get("exploration")
             else {}
         ),
         # mid-frame residency (production runs): how many parked/resumed
@@ -1991,6 +2022,7 @@ GATE_TOLERANCE = 0.35
 GATE_TTFE_SLACK_S = 2.0
 GATE_HARVEST_SLACK_PCT = 15.0  # absolute harvest-share points
 GATE_PHASE_SLACK_S = 0.75  # absolute slack on service phase p95s
+GATE_COVERAGE_SLACK_PCT = 10.0  # absolute instruction-coverage points
 GATE_TRACING_BUDGET_PCT = 2.0  # tracing overhead must stay under 2% of wall
 # spans+flows+counters a fully-instrumented pipelined segment emits (dispatch,
 # chain_merge, segment, 4 harvest phases, replay/feasibility workers, 3-point
@@ -2246,6 +2278,20 @@ def regression_gate(
                 violations.append(
                     f"{name}: harvest_share_pct {ch:.1f} > {ceil:.1f} "
                     f"(prior {ph:.1f} + {GATE_HARVEST_SLACK_PCT:.0f}pt)"
+                )
+        # exploration quality: instruction coverage must not collapse —
+        # a run can be fast because it silently stopped exploring, and the
+        # rate checks alone would call that an improvement
+        pcov = (p.get("exploration") or {}).get("coverage_pct")
+        ccov = (c.get("exploration") or {}).get("coverage_pct")
+        if pcov is not None and ccov is not None:
+            checks += 1
+            floor_cov = pcov - GATE_COVERAGE_SLACK_PCT
+            if ccov < floor_cov:
+                violations.append(
+                    f"{name}: exploration coverage_pct {ccov:.1f} < "
+                    f"{floor_cov:.1f} (prior {pcov:.1f} - "
+                    f"{GATE_COVERAGE_SLACK_PCT:.0f}pt)"
                 )
         # service latency decomposition: per-phase p95 (queue_wait /
         # batch_wait / execute / stream from the serve-load row) must
@@ -2511,6 +2557,11 @@ def main() -> None:
                     k: get_registry().counter("prefilter.%s" % k).value
                     for k in ("evaluated", "killed", "fallthrough")
                 }
+                from mythril_tpu.observability.exploration import (
+                    get_exploration_ledger,
+                )
+
+                expl_before = get_exploration_ledger().terminated()
                 cc_before = (
                     get_registry().counter(
                         "compilecache.hits", persistent=True
@@ -2576,6 +2627,23 @@ def main() -> None:
                         k: get_registry().counter("prefilter.%s" % k).value
                         - pf_before[k]
                         for k in ("evaluated", "killed", "fallthrough")
+                    })
+                    led = get_exploration_ledger()
+                    t_after = led.terminated()
+                    # partition invariant: every stamped path carries
+                    # exactly one class (stamp() increments both sides)
+                    assert sum(t_after.values()) == led.terminated_total(), (
+                        "exploration termination classes do not partition: "
+                        f"{t_after} != total {led.terminated_total()}"
+                    )
+                    term_delta = {
+                        cls: max(n - expl_before.get(cls, 0), 0)
+                        for cls, n in t_after.items()
+                    }
+                    d["exploration"].append({
+                        "terminated": term_delta,
+                        "terminated_total": sum(term_delta.values()),
+                        "coverage_pct": led.coverage_pct(),
                     })
                 if production:
                     # a workload with an internal warm-up supplies its own

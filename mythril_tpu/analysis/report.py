@@ -241,8 +241,11 @@ class Report:
         # execution-info rollups
         from mythril_tpu.observability import observability_meta
 
+        from mythril_tpu.observability.exploration import exploration_meta
+
         meta["observability"] = observability_meta()
         meta["prefilter"] = _prefilter_meta()
+        meta["exploration"] = exploration_meta()
         result = [
             {
                 "issues": sorted(_issues, key=lambda k: k["swcID"]),

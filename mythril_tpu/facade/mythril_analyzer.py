@@ -46,6 +46,7 @@ class AnalyzerArgs:
     probe_backend: str = "auto"
     frontier: bool = False
     frontier_width: int = 64
+    frontier_force: bool = False
     query_cache: bool = True
     query_cache_dir: Optional[str] = None
     staticpass: bool = True

@@ -94,6 +94,7 @@ def apply_analyzer_args(cmd_args) -> None:
             )
     args.frontier = getattr(cmd_args, "frontier", False)
     args.frontier_width = getattr(cmd_args, "frontier_width", 64)
+    args.frontier_force = getattr(cmd_args, "frontier_force", False)
     args.query_cache = getattr(cmd_args, "query_cache", True)
     args.staticpass = getattr(cmd_args, "staticpass", True)
     args.pipeline = getattr(cmd_args, "pipeline", True)
@@ -193,6 +194,41 @@ class WorkerContext:
             out["killed"] = out.get("killed", 0) + max(
                 reg.counter("prefilter.killed").value - k0, 0
             )
+
+    @contextlib.contextmanager
+    def exploration_delta(self, out: Dict[str, Any]):
+        """Measure this scope's exploration-ledger activity into ``out``:
+        per-class terminated-path deltas (``terminated`` dict +
+        ``terminated_total``), ``pc_overflow``, and the scope-end
+        per-contract ``coverage_pct``.  Like ``prefilter_delta``, the
+        ledger resets per analysis scope, so callers that outlive the
+        batch (the daemon's persistent mirrors, pool-worker done
+        payloads) need the delta."""
+        from mythril_tpu.observability.exploration import (
+            get_exploration_ledger,
+        )
+
+        led = get_exploration_ledger()
+        t0 = led.terminated()
+        o0 = led.pc_overflow
+        try:
+            yield out
+        finally:
+            t1 = led.terminated()
+            term = out.setdefault("terminated", {})
+            for cls, n in t1.items():
+                d = max(n - t0.get(cls, 0), 0)
+                if d:
+                    term[cls] = term.get(cls, 0) + d
+            out["terminated_total"] = sum(term.values())
+            out["pc_overflow"] = out.get("pc_overflow", 0) + max(
+                led.pc_overflow - o0, 0
+            )
+            # coverage is a level, not a flow: report the scope-end view
+            # (keyed by codehash so the daemon can attribute per request)
+            out["coverage_pct"] = {
+                h: c["instruction_pct"] for h, c in led.coverage().items()
+            }
 
     def stats(self) -> Dict[str, Any]:
         """Worker-local engine-global sizes (heartbeat payload)."""

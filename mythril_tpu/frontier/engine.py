@@ -163,6 +163,27 @@ def _jumpi_count(code) -> int:
     return got
 
 
+_code_tag_cache: Dict[object, str] = {}
+
+
+def _code_tag(code) -> str:
+    """Short codehash prefix for solver-hotspot program-point labels."""
+    key = _code_key(code)
+    tag = _code_tag_cache.get(key)
+    if tag is None:
+        bytecode = getattr(code, "bytecode", b"") or b""
+        if bytecode:
+            from mythril_tpu.support.support_utils import get_code_hash
+
+            tag = get_code_hash(bytecode.hex())[:10]
+        else:
+            tag = "?"
+        if len(_code_tag_cache) >= 4096:
+            _code_tag_cache.clear()
+        _code_tag_cache[key] = tag
+    return tag
+
+
 def _strategy_chain(laser):
     """The active strategy and every strategy it wraps (extensions nest via
     ``super_strategy``), outermost first."""
@@ -846,7 +867,9 @@ class FrontierEngine:
             *[jax.device_put(a) for a in arena.device_arrays()]
         )
         arena_len = arena.length
-        visited = jax.device_put(np.zeros((code_cap, instr_cap), bool))
+        # [3, C, I] coverage planes: instruction / taken-edge / fall-edge
+        # (see observability/exploration.py for the plane contract)
+        visited = jax.device_put(np.zeros((3, code_cap, instr_cap), bool))
 
         # SPMD over the mesh path axis (SURVEY.md §5.8): with >1 attached
         # device the segment inputs are placed path-sharded (state) /
@@ -981,7 +1004,7 @@ class FrontierEngine:
                 jax.device_put(a)
                 for a in stacked_device_tables(tables, natural_bucket)
             ])
-            nat_visited = jax.device_put(np.zeros((nat_cc, nat_ic), bool))
+            nat_visited = jax.device_put(np.zeros((3, nat_cc, nat_ic), bool))
             cfg0 = cfg._replace(
                 k_limit=np.int32(min(caps.K, 96 << min(stats.segments, 4)))
             )
@@ -1037,7 +1060,7 @@ class FrontierEngine:
             # the floored bitmap (same code order, smaller caps)
             import jax.numpy as jnp
 
-            visited = visited.at[:nat_cc, :nat_ic].set(
+            visited = visited.at[:, :nat_cc, :nat_ic].set(
                 jnp.asarray(nat_visited)
             )
             live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
@@ -1300,7 +1323,7 @@ class FrontierEngine:
 
         visited_host = np.asarray(visited)
         for ci, (laser, code) in enumerate(zip(table_laser, table_code)):
-            self._merge_coverage(visited_host[ci], tables[ci], code, laser)
+            self._merge_coverage(visited_host[:, ci], tables[ci], code, laser)
         for i in bounced:
             seed_lasers[i].work_list.append(seeds[i])
         # seeds still queued when a break path ended the loop (slow-bail,
@@ -1312,15 +1335,27 @@ class FrontierEngine:
 
     @staticmethod
     def _merge_coverage(visited: np.ndarray, tables, code, laser) -> None:
-        """Device-executed instructions into the coverage plugin's bitmap
-        (the walker only replays hook events, so plugin-side coverage alone
-        would underreport frontier runs)."""
-        cov = getattr(laser, "coverage_plugin", None)
+        """Device-executed coverage planes ``[3, I]`` into the coverage
+        plugin's bitmap and the exploration ledger (the walker only
+        replays hook events, so plugin-side coverage alone would
+        underreport frontier runs; edge planes exist only here)."""
         bytecode = getattr(code, "bytecode", None)
-        if cov is None or not bytecode:
+        if not bytecode:
             return
-        cov.record_visited(
-            bytecode.hex(), tables.n, np.nonzero(visited[: tables.n])[0]
+        cov = getattr(laser, "coverage_plugin", None)
+        if cov is not None:
+            cov.record_visited(
+                bytecode.hex(), tables.n,
+                np.nonzero(visited[0, : tables.n])[0],
+            )
+        from mythril_tpu.observability.exploration import (
+            get_exploration_ledger,
+        )
+        from mythril_tpu.support.support_utils import get_code_hash
+
+        get_exploration_ledger().record_device_planes(
+            get_code_hash(bytecode.hex()), tables.n, _jumpi_count(code),
+            visited[:, : tables.n],
         )
 
     # ------------------------------------------------------------------
@@ -1534,6 +1569,10 @@ class FrontierEngine:
                     frozenset(t.tid for t in raws),
                     sid=getattr(pipe, "current_sid", -1),
                     verdict=False if killed else None,
+                    point="%s:%#x" % (
+                        _code_tag(walker.seeds[rec.seed_idx].environment.code),
+                        int(st.pc[slot]),
+                    ),
                 )
             return
         # harvest feasibility is one of the query cache's three entry points
@@ -1542,16 +1581,41 @@ class FrontierEngine:
         # many of this sweep's decisions the cache absorbed
         from mythril_tpu.querycache import get_query_cache
 
+        from mythril_tpu.observability.exploration import (
+            VERDICT_CLASS,
+            get_exploration_ledger,
+        )
+
         qc_hits = get_query_cache().hits_total()
+        statuses: List[str] = []
+        t_solve = time.perf_counter()
         with _otrace.span(
             "frontier.prune_check", cat="frontier", n=len(todo)
         ) as sp:
-            flags = check_satisfiable_batch([raws for _, _, _, raws in todo])
+            flags = check_satisfiable_batch(
+                [raws for _, _, _, raws in todo], statuses_out=statuses
+            )
             sp.set(querycache_hits=get_query_cache().hits_total() - qc_hits)
-        for (slot, rec, n_cons, _), ok in zip(todo, flags):
+        # batched solve: attribute the sweep's wall evenly across the
+        # program points it decided (a documented approximation — the
+        # pipelined pool times each query exactly)
+        share = (time.perf_counter() - t_solve) / len(todo)
+        led = get_exploration_ledger()
+        if len(statuses) < len(todo):  # defensive: fill missing statuses
+            statuses = statuses + ["unsat"] * (len(todo) - len(statuses))
+        for (slot, rec, n_cons, _), ok, status in zip(todo, flags, statuses):
+            led.record_solver_time(
+                "%s:%#x" % (
+                    _code_tag(walker.seeds[rec.seed_idx].environment.code),
+                    int(st.pc[slot]),
+                ),
+                share,
+            )
             if ok:
                 rec._pruned_at = n_cons
             else:
+                rec.term_class = VERDICT_CLASS.get(status, "solver_unsat")
+                led.stamp(rec.term_class)
                 records[slot] = None
                 clear_slot(st, slot)
                 ev_seen[slot] = 0
@@ -1560,6 +1624,21 @@ class FrontierEngine:
                   reason: str = "bulk") -> None:
         """Timeout/overflow: hand every live path back to the host engine."""
         stats = FrontierStatistics()
+        if reason == "timeout":
+            # the execution budget is gone: the host work list these paths
+            # land on will never be drained, so they stop exploring HERE —
+            # other park reasons (slow/narrow-bail, drain) genuinely
+            # continue host-side and are stamped at their real end
+            from mythril_tpu.observability.exploration import (
+                get_exploration_ledger,
+            )
+
+            led = get_exploration_ledger()
+            for slot in range(self.caps.B):
+                rec = records[slot]
+                if rec is not None and rec.term_class is None:
+                    rec.term_class = "budget_exhausted"
+                    led.stamp("budget_exhausted")
         for slot in range(self.caps.B):
             rec = records[slot]
             if rec is None:

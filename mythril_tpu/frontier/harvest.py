@@ -55,8 +55,38 @@ from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import FrontierState, clear_slot
 from mythril_tpu.frontier.stats import FrontierStatistics
 from mythril_tpu.observability import tracer as _otrace
+from mythril_tpu.observability.exploration import get_exploration_ledger
 from mythril_tpu.observability.metrics import get_registry as _get_metrics
 from mythril_tpu.support.support_args import args
+
+# Termination attribution (observability/exploration.py): halt kind ->
+# ledger class for paths retiring through the commit loop.  Parks
+# (H_PARK / H_PENDING_FORK spills) are absent on purpose — those paths
+# continue host-side and must not be stamped as terminated.
+_TERMINAL_CLASS = {
+    O.H_STOP: "completed",
+    O.H_RETURN: "completed",
+    O.H_REVERT: "completed",
+    O.H_SELFDESTRUCT: "completed",
+    O.H_INVALID: "completed",
+    O.H_DEPTH: "budget_exhausted",
+    O.H_LOOP: "loop_bound",
+}
+
+
+def classify_termination(rec: PathRecord) -> Optional[str]:
+    """Exploration-ledger class for a retiring record, or ``None`` when
+    the path parks (continues host-side)."""
+    if rec.term_class is not None:
+        return rec.term_class
+    if rec.dead:
+        # walker kill without an explicit class (dead branch detected
+        # during replay, empty hook result, ...) counts as a normally
+        # completed path; plugin prunes set term_class before dying
+        return "completed"
+    if rec._replay_err is not None or rec.final is None:
+        return "completed"
+    return _TERMINAL_CLASS.get(int(rec.final["halt"]))
 
 log = logging.getLogger(__name__)
 
@@ -338,6 +368,7 @@ class HarvestExecutor:
 
         # commit: main thread, slot order — park routing, slot recycling,
         # ledger touches
+        led = get_exploration_ledger()
         with _otrace.span("frontier.harvest.commit", cat="frontier",
                           segment=sid, paths=len(finishing)):
             for slot in finishing:
@@ -355,6 +386,11 @@ class HarvestExecutor:
                             "frontier walker failed on a path: %s", e,
                             exc_info=True,
                         )
+                if rec.term_class is None:
+                    cls = classify_termination(rec)
+                    if cls is not None:
+                        rec.term_class = cls
+                        led.stamp(cls)
                 records[slot] = None
                 clear_slot(st, slot)
                 ev_seen[slot] = 0

@@ -225,12 +225,19 @@ class FeasibilityPool:
         self._done: list = []
 
     def submit(self, slot: int, rec, n_cons: int, raws, key: frozenset,
-               sid: int = -1, verdict: Optional[bool] = None) -> None:
+               sid: int = -1, verdict: Optional[bool] = None,
+               point: str = "") -> None:
         """Queue a feasibility check.  ``verdict=False`` means the abstract
         pre-filter already PROVED the query UNSAT: no worker runs, and the
         verdict is published to EVERY waiter deduplicated under ``key`` —
         including ones already in flight, so concurrent identical lineages
-        never fall through to an exact solve the pre-filter refuted."""
+        never fall through to an exact solve the pre-filter refuted.
+
+        ``point`` is the program-point label ("codehash:0xPC") of the JUMPI
+        being checked: solver wall time accrues to it in the exploration
+        ledger's solver_hotspot histogram, and a kill verdict's *why*
+        ("prefilter" / "unsat" / "unknown") rides the done-queue so
+        apply_verdicts can stamp the killed paths' termination class."""
         if verdict is False:
             with self._lock:
                 waiters = self._inflight.get(key)
@@ -239,9 +246,9 @@ class FeasibilityPool:
                     _pc("pool_inflight_dedup").inc()
                 else:
                     self._inflight[key] = [(slot, rec, n_cons)]
-                # drain() tolerates a second (key, ok) entry for a query a
-                # worker also finishes: the later pop finds nothing
-                self._done.append((key, False))
+                # drain() tolerates a second (key, ok, why) entry for a
+                # query a worker also finishes: the later pop finds nothing
+                self._done.append((key, False, "prefilter"))
             _pc("pool_prefilter_kills").inc()
             return
         with self._lock:
@@ -261,34 +268,44 @@ class FeasibilityPool:
             # flow arrow: harvest slice (caller's thread) -> worker span
             fid = tracer.new_flow_id()
             tracer.flow("s", fid, "flow.feasibility", cat="solver")
-        self._executor.submit(self._work, key, raws, sid, fid)
+        self._executor.submit(self._work, key, raws, sid, fid, point)
 
     def _work(self, key: frozenset, raws, sid: int = -1,
-              fid: Optional[int] = None) -> None:
+              fid: Optional[int] = None, point: str = "") -> None:
+        from mythril_tpu.observability.exploration import (
+            get_exploration_ledger,
+        )
         from mythril_tpu.smt.solver import check_satisfiable_batch
 
         with _otrace.span("pipeline.feasibility", cat="solver", segment=sid):
             if fid is not None:
                 _otrace.get_tracer().flow("f", fid, "flow.feasibility",
                                           cat="solver")
+            statuses: list = []
+            t0 = time.perf_counter()
             try:
                 with self._solver_lock:
-                    ok = bool(check_satisfiable_batch([raws])[0])
+                    ok = bool(check_satisfiable_batch(
+                        [raws], statuses_out=statuses)[0])
             except Exception as e:  # pragma: no cover - defensive
                 log.debug("background feasibility check failed: %s", e)
                 ok = True  # sound: the path just keeps running
+            if point:
+                get_exploration_ledger().record_solver_time(
+                    point, time.perf_counter() - t0)
+        why = statuses[0] if statuses else ("sat" if ok else "unsat")
         with self._lock:
-            self._done.append((key, ok))
+            self._done.append((key, ok, why))
 
     def drain(self) -> list:
         """Verdicts that landed since the last drain as
-        (slot, rec, n_cons, ok) tuples."""
+        (slot, rec, n_cons, ok, why) tuples."""
         out = []
         with self._lock:
             done, self._done = self._done, []
-            for key, ok in done:
+            for key, ok, why in done:
                 for item in self._inflight.pop(key, ()):
-                    out.append((*item, ok))
+                    out.append((*item, ok, why))
         return out
 
     def pending(self) -> int:
@@ -446,8 +463,14 @@ class PipelinedRunner:
     # -- speculative verdicts ------------------------------------------
 
     def apply_verdicts(self) -> None:
+        from mythril_tpu.observability.exploration import (
+            VERDICT_CLASS,
+            get_exploration_ledger,
+        )
+
         st, records = self.st, self.records
-        for slot, rec, n_cons, ok in self.pool.drain():
+        led = get_exploration_ledger()
+        for slot, rec, n_cons, ok, why in self.pool.drain():
             if ok:
                 if records[slot] is rec:
                     rec._pruned_at = max(rec._pruned_at, n_cons)
@@ -457,6 +480,7 @@ class PipelinedRunner:
             # that already finished replayed its events, but its issues
             # (if any) fail their own confirmation query — soundness does
             # not depend on this rollback, only slot recycling does.
+            cls = VERDICT_CLASS.get(why, "solver_unsat")
             for s in range(self.caps.B):
                 r = records[s]
                 node = r
@@ -468,6 +492,9 @@ class PipelinedRunner:
                     self.ev_seen[s] = 0
                     self.ledger.touch(s)
                     _pc("pool_unsat_rollbacks").inc()
+                    if r.term_class is None:
+                        r.term_class = cls
+                        led.stamp(cls)
 
     def clear_orphans(self) -> None:
         """Device-occupied slots with no host record are descendants of

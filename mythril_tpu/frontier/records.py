@@ -30,6 +30,7 @@ class PathRecord:
         "_submitted_at",
         "steps_seen",
         "_replay_err",
+        "term_class",
     )
 
     def __init__(self, seed_idx: int, parent: Optional["PathRecord"] = None,
@@ -47,6 +48,10 @@ class PathRecord:
         self._submitted_at = 0  # constraint count last sent to the pool
         self.steps_seen = 0  # device step count already attributed
         self._replay_err = None  # exception captured by a replay worker
+        # exploration-ledger termination class, stamped exactly once when
+        # the path stops exploring (observability/exploration.TERM_CLASSES);
+        # None while the path lives or when it continues host-side
+        self.term_class: Optional[str] = None
 
 
 def snapshot_slot(st, slot: int) -> dict:

@@ -953,11 +953,14 @@ def build_segment(caps: Caps):
         # the (empty) harvest — the engine's narrow-memo uses this
         max_live = jnp.maximum(max_live, n_live)
         state = state._replace(steps=state.steps + running.astype(I32))
-        # coverage: mark every live path's (code, pc) (idle slots drop)
-        cid_live = jnp.clip(state.code_id, 0, visited.shape[0] - 1)
-        cid_or_oob = jnp.where(running, cid_live, visited.shape[0])
-        pc_or_oob = jnp.clip(state.pc, 0, visited.shape[1] - 1)
-        visited = visited.at[cid_or_oob, pc_or_oob].set(True, mode="drop")
+        # coverage: mark every live path's (code, pc) on the instruction
+        # plane (idle slots drop).  ``visited`` is [3, C, I]: plane 0 =
+        # instruction executed, planes 1/2 = JUMPI taken / fall-through
+        # edges (marked below once a branch actually resolves)
+        cid_live = jnp.clip(state.code_id, 0, visited.shape[1] - 1)
+        cid_or_oob = jnp.where(running, cid_live, visited.shape[1])
+        pc_or_oob = jnp.clip(state.pc, 0, visited.shape[2] - 1)
+        visited = visited.at[0, cid_or_oob, pc_or_oob].set(True, mode="drop")
         # arena rows are reserved for LIVE paths only (prefix-sum block
         # assignment): a wide batch with few live paths must not burn B*R
         # rows per step — that exhausts the arena in ARENA/(B*R) steps.
@@ -970,6 +973,23 @@ def build_segment(caps: Caps):
             caps.ARENA,
         )
         new_state, rows, fork = vstep(state, ids, arena, code, cfg)
+
+        # edge coverage, inline-resolved JUMPIs: a concrete condition (or
+        # fall-only branch) decided inside vstep without wanting a fork.
+        # Compare the successor pc against pc+1 to pick the plane; paths
+        # that halted at the JUMPI (invalid dest) mark no edge, and
+        # fork-wanting paths mark theirs at the grant below.
+        fam_here = code.fam[cid_live, jnp.clip(state.pc, 0,
+                                               code.fam.shape[1] - 1)]
+        inline_jumpi = (
+            running & (fam_here == O.F_JUMPI) & ~fork.want
+            & (new_state.halt == O.H_RUNNING)
+        )
+        nf_plane = jnp.where(new_state.pc == state.pc + 1, 2, 1)
+        nf_cid = jnp.where(inline_jumpi, cid_live, visited.shape[1])
+        visited = visited.at[nf_plane, nf_cid, pc_or_oob].set(
+            True, mode="drop"
+        )
 
         # arena scatter (rows are disjoint fresh slots; dead slots drop)
         flat_ids = ids.reshape(-1)
@@ -999,8 +1019,8 @@ def build_segment(caps: Caps):
         # strategies; only matters when forks outnumber free slots): rank
         # wanters by descending score — argsort is stable, so SEL_NONE
         # (score 0) degenerates to the legacy slot order
-        target_pc = jnp.clip(fork.target, 0, visited.shape[1] - 1)
-        uncovered = ~visited[cid_live, target_pc]
+        target_pc = jnp.clip(fork.target, 0, visited.shape[2] - 1)
+        uncovered = ~visited[0, cid_live, target_pc]
         sel = cfg.sel_mode
         score = jnp.where(
             sel == SEL_DEEP, state.depth,
@@ -1051,6 +1071,14 @@ def build_segment(caps: Caps):
         cid2 = jnp.clip(state2.code_id, 0, code.fam.shape[0] - 1)
         branch_pc = jnp.where(forked_into, taken_pc, jumpi_pc + 1)
         branch_row = jnp.where(forked_into, cond_of_child, ncond_of_parent)
+        # edge coverage, granted forks: the child resolves the taken edge,
+        # the granting parent the fall-through edge, both at the JUMPI's
+        # pc.  Denied/pending forks re-run pristine and mark nothing.
+        edge_plane = jnp.where(forked_into, 1, 2)
+        edge_cid = jnp.where(touched, cid2, visited.shape[1])
+        visited = visited.at[edge_plane, edge_cid, jumpi_pc].set(
+            True, mode="drop"
+        )
         cl = jnp.clip(state2.cons_len, 0, CON - 1)
         state2 = state2._replace(
             pc=jnp.where(touched, branch_pc, state2.pc),

@@ -209,8 +209,12 @@ class Walker:
             if parent.dead:
                 # a hook killed the parent before the fork replayed: the
                 # whole subtree dies with it (host parity: the state was
-                # dropped before the JUMPI executed)
+                # dropped before the JUMPI executed); the child inherits
+                # the parent's termination class (a hook prune that kills
+                # the subtree counts each descendant under the same class)
                 rec.dead = True
+                if rec.term_class is None:
+                    rec.term_class = parent.term_class
                 return
             # parent advance should have installed it via the fork event
             raise RuntimeError("fork event did not produce the child carrier")
@@ -332,6 +336,10 @@ class Walker:
                     hook(carrier)
             except PluginSkipState:
                 rec.dead = True
+                # termination attribution: a detector/static-pass hook
+                # pruned the path (harvest stamps the class at commit)
+                if rec.term_class is None:
+                    rec.term_class = "staticpass_pruned"
                 rec.carrier = None
                 return
 

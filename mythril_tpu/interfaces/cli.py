@@ -140,6 +140,14 @@ def _add_analysis_options(parser) -> None:
         help="device frontier batch width (paths held on device)",
     )
     group.add_argument(
+        "--frontier-force",
+        action="store_true",
+        help="bypass the a-priori narrow-width gate and put even tiny "
+        "seed sets on the device frontier (differential testing / CI "
+        "smoke; normally the gate keeps small contracts on the faster "
+        "host path)",
+    )
+    group.add_argument(
         "--query-cache-dir",
         metavar="DIR",
         help="persist solver verdicts in DIR and reuse them across runs "
@@ -242,6 +250,13 @@ def _add_analysis_options(parser) -> None:
         metavar="FILE",
         help="write the full metrics-registry snapshot (frontier/solver/"
         "profiler counters and per-stage histograms) to FILE as JSON",
+    )
+    group.add_argument(
+        "--coverage-out",
+        metavar="FILE",
+        help="write the exploration ledger (per-contract instruction and "
+        "JUMPI branch-edge coverage bitmaps, termination-class breakdown, "
+        "solver hotspots by program point) to FILE as JSON after the run",
     )
     group.add_argument(
         "--heartbeat-out",
@@ -601,6 +616,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         probe_backend=getattr(parsed, "probe_backend", "auto"),
         frontier=getattr(parsed, "frontier", False),
         frontier_width=getattr(parsed, "frontier_width", 64),
+        frontier_force=getattr(parsed, "frontier_force", False),
         query_cache=not getattr(parsed, "no_query_cache", False),
         query_cache_dir=getattr(parsed, "query_cache_dir", None),
         staticpass=not getattr(parsed, "no_staticpass", False),
@@ -675,6 +691,18 @@ def _export_observability(parsed) -> None:
         with open(metrics_out, "w") as f:
             json.dump(observability_meta(), f, indent=2, sort_keys=True)
         log.info("wrote metrics snapshot to %s", metrics_out)
+    coverage_out = getattr(parsed, "coverage_out", None)
+    if coverage_out:
+        from mythril_tpu.observability import get_exploration_ledger
+
+        snap = get_exploration_ledger().snapshot()
+        with open(coverage_out, "w") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+        log.info(
+            "wrote exploration ledger (%d contracts, %.1f%% coverage) to %s",
+            len(snap.get("coverage", {})),
+            snap.get("coverage_pct", 0.0), coverage_out,
+        )
     staticpass_report = getattr(parsed, "staticpass_report", None)
     if staticpass_report:
         from mythril_tpu.staticpass import export_report

@@ -22,6 +22,12 @@ The convenience re-exports below are the recommended import surface::
         ...
 """
 
+from mythril_tpu.observability.exploration import (  # noqa: F401
+    TERM_CLASSES,
+    ExplorationLedger,
+    exploration_meta,
+    get_exploration_ledger,
+)
 from mythril_tpu.observability.fleet import (  # noqa: F401
     WIRE_VERSION,
     FleetAggregator,
@@ -87,6 +93,9 @@ def reset_analysis_metrics() -> None:
     Metrics registered with ``persistent=True`` — e.g. the frontier's
     per-code slow/narrow-segment verdicts, which engine.py deliberately
     keeps across runs so a code that degenerated once is not re-probed —
-    survive the sweep.
+    survive the sweep.  The exploration ledger's coverage bitmaps are
+    swept with the same scope (its counters live in the registry and
+    reset with everything else).
     """
     get_registry().reset()
+    get_exploration_ledger().reset_scope()

@@ -46,6 +46,7 @@ starve the interactive tier.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import itertools
 import json
@@ -199,6 +200,23 @@ class AnalysisService:
         )
         self._c_pf_kill = reg.counter(
             "service.prefilter_killed", persistent=True
+        )
+        # exploration-ledger mirrors: termination classes and pc-overflow
+        # deltas accumulate here across batches (the scoped exploration.*
+        # counters reset per analysis); per-contract coverage keeps the
+        # most recent batch's view, bounded
+        self._c_term = reg.labeled_counter(
+            "service.exploration_terminated", persistent=True,
+            label_name="class",
+        )
+        self._c_term_total = reg.counter(
+            "service.exploration_terminated_total", persistent=True
+        )
+        self._c_pc_overflow = reg.counter(
+            "service.exploration_pc_overflow", persistent=True
+        )
+        self._coverage_by_hash: "collections.OrderedDict[str, float]" = (
+            collections.OrderedDict()
         )
         self.telemetry = RequestTelemetry(request_log=self.config.request_log)
         # cross-process telemetry fold: worker delta payloads land here
@@ -373,6 +391,12 @@ class AnalysisService:
             stream, deduped = self.admission.submit(request)
         except AdmissionRejected:
             self.telemetry.request_finished(request, "rejected")
+            # termination attribution: a shed request is a path-set that
+            # never got to explore — mirror-only (the scoped ledger
+            # belongs to the engine's analysis scope, which a rejected
+            # request never enters)
+            self._c_term.inc("shed")
+            self._c_term_total.inc()
             raise
         key = (request.codehash, request.options.key())
         self._register_rid(request.request_id, key)
@@ -485,6 +509,20 @@ class AnalysisService:
             "kill_rate": round(
                 (out["service.prefilter_killed"] or 0) / pf_eval, 4
             ) if pf_eval else 0.0,
+        }
+        from mythril_tpu.observability.exploration import TERM_CLASSES
+
+        term_snap = self._c_term.snapshot()
+        terminated = {c: int(term_snap.get(c, 0)) for c in TERM_CLASSES}
+        term_total = int(self._c_term_total.snapshot() or 0)
+        out["exploration"] = {
+            "terminated": terminated,
+            "terminated_total": term_total,
+            "partition_ok": sum(terminated.values()) == term_total,
+            "pc_overflow": int(self._c_pc_overflow.snapshot() or 0),
+            "coverage_pct": {
+                h[:10]: pct for h, pct in self._coverage_by_hash.items()
+            },
         }
         requests = out["service.requests"] or 0
         out["cache"] = {
@@ -615,6 +653,38 @@ class AnalysisService:
             if delta.get("killed"):
                 self._c_pf_kill.inc(delta["killed"])
 
+    @contextlib.contextmanager
+    def _account_exploration(self):
+        """Fold this scope's exploration-ledger activity (termination
+        classes, pc-overflow, per-contract coverage) into the persistent
+        service mirrors — same pattern as ``_account_prefilter``."""
+        delta: Dict[str, Any] = {}
+        try:
+            with self._ctx.exploration_delta(delta):
+                yield
+        finally:
+            self._fold_exploration(delta)
+
+    def _fold_exploration(self, delta: Dict[str, Any]) -> None:
+        """Merge one batch's exploration delta (inline scope or a pool
+        worker's done payload) into the persistent mirrors."""
+        if not delta:
+            return
+        for cls, n in (delta.get("terminated") or {}).items():
+            if n:
+                self._c_term.inc(cls, n)
+                self._c_term_total.inc(n)
+        if delta.get("pc_overflow"):
+            self._c_pc_overflow.inc(delta["pc_overflow"])
+        for codehash, pct in (delta.get("coverage_pct") or {}).items():
+            self._coverage_by_hash[codehash] = pct
+            self._coverage_by_hash.move_to_end(codehash)
+        while len(self._coverage_by_hash) > _RID_REGISTRY_CAP:
+            self._coverage_by_hash.popitem(last=False)
+
+    def _coverage_of(self, codehash: str) -> Optional[float]:
+        return self._coverage_by_hash.get(codehash)
+
     def _run_batch(self, batch: List[Flight]) -> None:
         from mythril_tpu.analysis.cooperative import run_cooperative_batch
 
@@ -648,7 +718,8 @@ class AnalysisService:
                 self._scope_reset()
 
             self._stamp_batch(batch, "execute0", "execute")
-            with self._account_prefilter(), self._ctx.sink_scope(
+            with self._account_prefilter(), self._account_exploration(), \
+                    self._ctx.sink_scope(
                 self._make_sink(by_hash, streamed, "device", sink_lock)
             ):
                 issues_by_name, errors_by_name, _states = run_cooperative_batch(
@@ -759,12 +830,14 @@ class AnalysisService:
                          batch_width: Optional[int] = None,
                          compute_share: float = 0.0) -> None:
         primary = flight.requests[0]
+        coverage_pct = self._coverage_of(flight.codehash)
         for req in requests:
             self.telemetry.request_finished(
                 req, event,
                 n_issues=n_issues, digests=digests,
                 batch_width=batch_width, compute_share=compute_share,
                 deduped=req is not primary,
+                coverage_pct=coverage_pct,
             )
 
     def _probe(
@@ -928,6 +1001,7 @@ class AnalysisService:
             self._c_pf_eval.inc(pf["evaluated"])
         if pf.get("killed"):
             self._c_pf_kill.inc(pf["killed"])
+        self._fold_exploration(payload.get("exploration") or {})
         for wall in payload.get("probe_s") or []:
             self._c_probe_runs.inc()
             self._h_probe.observe(wall)

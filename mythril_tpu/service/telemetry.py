@@ -155,8 +155,13 @@ class RequestTelemetry:
         compute_share: float = 0.0,
         deduped: bool = False,
         replayed: bool = False,
+        coverage_pct: Optional[float] = None,
     ) -> None:
-        """Finalize one request at its terminal event (idempotent)."""
+        """Finalize one request at its terminal event (idempotent).
+
+        ``coverage_pct`` is the exploration ledger's instruction-coverage
+        percentage for the request's contract (None when the engine never
+        produced one — rejected/replayed requests)."""
         with self._lock:
             entry = self._active.pop(request.request_id, None)
         if entry is None:
@@ -185,7 +190,7 @@ class RequestTelemetry:
         self._log_line(request, entry, phases, event,
                        n_issues=n_issues, digests=digests,
                        batch_width=batch_width, deduped=deduped,
-                       replayed=replayed)
+                       replayed=replayed, coverage_pct=coverage_pct)
         # pool mode allocates flows per request (adopt_worker_flow), not
         # per batch, so retire the binding here to keep the table bounded
         with self._lock:
@@ -281,7 +286,8 @@ class RequestTelemetry:
     # -- request log ---------------------------------------------------
 
     def _log_line(self, request, entry, phases, event, *, n_issues,
-                  digests, batch_width, deduped, replayed) -> None:
+                  digests, batch_width, deduped, replayed,
+                  coverage_pct=None) -> None:
         if self._log_file is None:
             return
         rec = {
@@ -298,6 +304,7 @@ class RequestTelemetry:
             "n_issues": n_issues,
             "digests": [list(d) for d in digests] if digests else [],
             "phases_s": {p: round(v, 6) for p, v in phases.items()},
+            "coverage_pct": coverage_pct,
         }
         line = json.dumps(rec, default=repr) + "\n"
         with self._log_lock:

@@ -103,6 +103,29 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
             )
         )
 
+    exploration = stats.get("exploration") or {}
+    if exploration.get("terminated_total"):
+        terminated = exploration.get("terminated") or {}
+        cov = exploration.get("coverage_pct") or {}
+        # compact class breakdown: only nonzero classes, largest first
+        classes = "  ".join(
+            f"{cls}={n}" for cls, n in
+            sorted(terminated.items(), key=lambda kv: -kv[1]) if n
+        )
+        cov_txt = ""
+        if cov:
+            vals = list(cov.values())
+            cov_txt = "  cov(avg) {:.1f}% over {} contracts".format(
+                sum(vals) / len(vals), len(vals)
+            )
+        lines.append(
+            "exploration: {t} paths terminated{c}".format(
+                t=exploration.get("terminated_total", 0), c=cov_txt
+            )
+        )
+        if classes:
+            lines.append("  " + classes)
+
     phases = stats.get("phases") or {}
     if any((phases.get(p) or {}).get("count") for p in _PHASE_ORDER):
         lines.append("")

@@ -45,6 +45,7 @@ describes — the fabric needs no second channel and no clock games
 ``done.payload`` is the authoritative end-of-batch result:
 ``issues`` (codehash -> wire list), ``errors`` (codehash -> one-line
 reason), ``elapsed_s``, ``prefilter`` (evaluated/killed deltas),
+``exploration`` (termination-class deltas + per-contract coverage),
 ``probe_s`` (per-probe walls) and ``first_source`` (codehash ->
 probe|device).  A worker never sends a partial ``done``: a batch-level
 crash inside the engine is converted to per-codehash errors, and a hard
@@ -165,6 +166,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
     first_source: Dict[str, str] = {}
     probe_walls: List[float] = []
     prefilter: Dict[str, int] = {}
+    exploration: Dict[str, Any] = {}
 
     def _note_first(source):
         base = _make_sink(event_q, worker_id, job_id, streamed, source)
@@ -178,6 +180,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
 
     ctx.reset_scope()
     with ctx.prefilter_delta(prefilter), \
+            ctx.exploration_delta(exploration), \
             tracer.span("service.worker_batch", cat="service",
                         job=job_id, width=len(flights)):
         # flow.request arrows across the process seam: emit the "f"
@@ -258,6 +261,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
         "errors": dict(errors_by_name),
         "elapsed_s": round(elapsed, 6),
         "prefilter": dict(prefilter),
+        "exploration": dict(exploration),
         "probe_s": probe_walls,
         "first_source": first_source,
     }))
@@ -415,6 +419,7 @@ def worker_main(worker_id: int, config: Dict[str, Any],
                 },
                 "elapsed_s": 0.0,
                 "prefilter": {},
+                "exploration": {},
                 "probe_s": [],
                 "first_source": {},
             }))

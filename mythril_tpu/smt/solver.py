@@ -1233,6 +1233,7 @@ def _fast_path(
 def check_satisfiable_batch(
     constraint_sets: Sequence[Sequence[Term]],
     config: Optional["ProbeConfig"] = None,
+    statuses_out: Optional[List[str]] = None,
 ) -> List[bool]:
     """Frontier-batched pruning: decide many path conditions in one sweep.
 
@@ -1245,12 +1246,20 @@ def check_satisfiable_batch(
     Anything still undecided falls back to the full per-set probe stack.
 
     Returns one bool per input set (True = keep the state).
+
+    When ``statuses_out`` is given, one status string per set is appended
+    to it: ``"sat"`` / ``"unsat"`` / ``"unknown"`` (a timeout decided
+    unknown-as-unsat) / ``"prefilter"`` (the abstract pre-filter proved
+    UNSAT).  The exploration ledger maps these onto termination classes
+    (observability/exploration.VERDICT_CLASS) so a pruned path records
+    WHY it stopped, not just that it did.
     """
     config = config or ProbeConfig(
         max_rounds=2, candidates_per_round=24, timeout_ms=2000,
         prune_critical=True, sat_biased=True,
     )
     results: List[Optional[bool]] = [None] * len(constraint_sets)
+    statuses: List[Optional[str]] = [None] * len(constraint_sets)
     pending: List[Tuple[int, List[Term], frozenset]] = []
 
     for i, cs in enumerate(constraint_sets):
@@ -1266,6 +1275,7 @@ def check_satisfiable_batch(
                 # is the same unknown-as-unsat call the cold path would have
                 # made, and it must show in the same recall-risk counter
                 SolverStatistics().inc("unknown_as_unsat")
+                statuses[i] = "unknown"
             results[i] = resolved[0] == SAT
         else:
             pending.append((i, conj, key))
@@ -1319,6 +1329,7 @@ def check_satisfiable_batch(
         for (i, conj, key), dead in zip(pending, killed):
             if dead:
                 results[i] = False
+                statuses[i] = "prefilter"
                 _model_cache.remember(key, UNSAT, None)
             else:
                 still.append((i, conj, key))
@@ -1348,7 +1359,13 @@ def check_satisfiable_batch(
             status, _ = solve_conjunction(conj, config, replay=False)
             if status == UNKNOWN:
                 SolverStatistics().inc("unknown_as_unsat")
+                statuses[i] = "unknown"
             results[i] = status == SAT
+    if statuses_out is not None:
+        statuses_out.extend(
+            s if s is not None else ("sat" if r else "unsat")
+            for s, r in zip(statuses, results)
+        )
     return [bool(r) for r in results]
 
 

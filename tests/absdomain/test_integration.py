@@ -35,7 +35,7 @@ def test_pool_prefilter_kill_skips_worker():
     key = frozenset(t.tid for t in raws)
     pool.submit(0, "rec", 1, raws, key, verdict=False)
     # no worker ran: the verdict is already drainable
-    assert [(s, ok) for s, _, _, ok in pool.drain()] == [(0, False)]
+    assert [(s, ok) for s, _, _, ok, _ in pool.drain()] == [(0, False)]
     assert pool.pending() == 0
     reg = get_registry()
     assert reg.counter("pipeline.pool_prefilter_kills").value == 1
@@ -56,7 +56,7 @@ def test_pool_prefilter_kill_publishes_to_inflight_waiters():
         pool.submit(0, "recA", 1, raws, key)            # exact, in flight
         pool.submit(1, "recB", 2, raws, key)            # dedup waiter
         pool.submit(2, "recC", 3, raws, key, verdict=False)  # abstract kill
-        verdicts = sorted((s, ok) for s, _, _, ok in pool.drain())
+        verdicts = sorted((s, ok) for s, _, _, ok, _ in pool.drain())
         # all three waiters already resolved, before the worker finished
         assert verdicts == [(0, False), (1, False), (2, False)]
     pool._executor.shutdown(wait=True)
@@ -73,7 +73,7 @@ def test_pool_duplicate_done_keys_tolerated():
     key = frozenset(t.tid for t in raws)
     pool.submit(0, "recA", 1, raws, key, verdict=False)
     pool.submit(1, "recB", 1, raws, key, verdict=False)
-    verdicts = sorted((s, ok) for s, _, _, ok in pool.drain())
+    verdicts = sorted((s, ok) for s, _, _, ok, _ in pool.drain())
     assert verdicts == [(0, False), (1, False)]
     assert pool.drain() == []
     pool.shutdown()

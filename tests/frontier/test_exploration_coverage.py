@@ -1,0 +1,90 @@
+"""Device-harvested coverage planes vs the host engine's walk (PR-14).
+
+``engine._merge_coverage`` folds the device frontier's ``[3, C, I]``
+visited planes into the exploration ledger; the host engine with the
+coverage plugin enabled is the oracle bitmap.  On a branching contract
+the device run must cover every instruction the host run covers (device
+coverage is speculative, so it may mark more — never less), and both
+JUMPI edges of the explored dispatcher gate must be present in the edge
+planes.
+"""
+
+import pytest
+
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.observability.exploration import get_exploration_ledger
+from mythril_tpu.observability.metrics import get_registry
+from mythril_tpu.support.support_args import args as global_args
+from mythril_tpu.support.support_utils import get_code_hash
+
+# dispatcher prelude: selector(kill()=0x41c0e1b5) -> JUMPDEST at 0x14=20,
+# then an unprotected SELFDESTRUCT — two reachable branches of one JUMPI
+DISPATCH = "60003560e01c6341c0e1b5146014576000" + "6000fd" + "5b"
+CODE_HEX = DISPATCH + "33ff"
+
+
+def _run(frontier: bool):
+    """One symbolic execution; returns the ledger's bitmap snapshot for
+    the contract (reset before the run so the snapshot is this run's)."""
+    get_registry().reset()
+    led = get_exploration_ledger()
+    led.reset_scope()
+    saved = (global_args.frontier, global_args.frontier_force)
+    global_args.frontier = frontier
+    global_args.frontier_force = frontier
+    try:
+        SymExecWrapper(
+            bytes.fromhex(CODE_HEX),
+            address=0x0901D12E,
+            strategy="dfs",
+            transaction_count=1,
+            execution_timeout=60,
+            modules=["AccidentallyKillable"],
+            enable_coverage_strategy=not frontier,
+        )
+    finally:
+        global_args.frontier, global_args.frontier_force = saved
+    snap = led.snapshot()
+    codehash = get_code_hash(CODE_HEX)
+    return snap, snap["bitmaps"].get(codehash)
+
+
+@pytest.mark.slow
+def test_device_planes_agree_with_host_walk():
+    host_snap, host = _run(frontier=False)
+    dev_snap, dev = _run(frontier=True)
+    assert host is not None, "host coverage plugin never fed the ledger"
+    assert dev is not None, "device merge never fed the ledger"
+
+    host_instr = set(host["instr"])
+    dev_instr = set(dev["instr"])
+    assert host_instr, "host run covered nothing"
+    # device coverage is speculative (UNSAT forks mark before rollback):
+    # it may exceed the host bitmap but must never miss what the host
+    # actually executed
+    missing = host_instr - dev_instr
+    assert not missing, (
+        f"device planes missed host-executed instructions {sorted(missing)}"
+    )
+
+    # both branch edges of the dispatcher JUMPI were explored (the
+    # selector match jumps to the JUMPDEST, the mismatch falls through
+    # to the revert) — the edge planes must show both
+    assert dev["edge_taken"], "no taken JUMPI edge recorded"
+    assert dev["edge_fall"], "no fall-through JUMPI edge recorded"
+
+    # the jsonv2 surface for the same run
+    cov = dev_snap["coverage"][get_code_hash(CODE_HEX)]
+    assert cov["instruction_pct"] > 0
+    assert cov["edges_seen"] >= 2
+
+
+def test_frontier_terminations_are_classified():
+    _run(frontier=True)
+    # the run above reset the registry then analyzed on-device: whatever
+    # terminated must partition exactly across the eight classes
+    led = get_exploration_ledger()
+    term = led.terminated()
+    assert sum(term.values()) == led.terminated_total()
+    assert led.terminated_total() > 0, "no path termination was stamped"
+    assert term["completed"] > 0

@@ -71,7 +71,7 @@ def _run_one_step(sel_mode: int, scores=(0, 0, 0)):
         st.score[slot] = scores[slot]
 
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
-    visited = jax.device_put(np.zeros((1, instr_cap), bool))
+    visited = jax.device_put(np.zeros((3, 1, instr_cap), bool))
     out_state, _arena, _alen, n_exec, _ml, _visited = segment(
         st, dev_arena, arena.length, visited, code_dev, cfg
     )
@@ -162,8 +162,8 @@ def test_coverage_mode_prefers_uncovered_target():
     st.halt[2] = O.H_RUNNING
     st.pc[2] = 1  # sits at STOP; occupies the slot this step
 
-    visited = np.zeros((1, instr_cap), bool)
-    visited[0, 2] = True  # the covered JUMPDEST
+    visited = np.zeros((3, 1, instr_cap), bool)
+    visited[0, 0, 2] = True  # the covered JUMPDEST (instruction plane)
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
     out_state, _arena, _alen, _n, _ml, _v = segment(
         st, dev_arena, arena.length, visited, code_dev, cfg

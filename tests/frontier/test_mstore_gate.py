@@ -54,7 +54,7 @@ def _run_program(program, gated: bool, seed_ctx: bool = False) -> int:
 
         st.ctx[0] = arena.var_row(T.var("seed_ctx", 256))
     dev_arena = ArenaDev(*[jax.device_put(a) for a in arena.device_arrays()])
-    visited = jax.device_put(np.zeros((1, instr_cap), bool))
+    visited = jax.device_put(np.zeros((3, 1, instr_cap), bool))
     out_state, _a, _l, _n, _m, _v = segment(
         st, dev_arena, arena.length, visited, code_dev, cfg
     )

@@ -115,7 +115,7 @@ def test_pool_sat_and_unsat_verdicts():
     pool.submit(1, "recB", 2, unsat_raws,
                 frozenset(t.tid for t in unsat_raws))
     pool._executor.shutdown(wait=True)
-    verdicts = {slot: ok for slot, rec, n, ok in pool.drain()}
+    verdicts = {slot: ok for slot, rec, n, ok, why in pool.drain()}
     assert verdicts == {0: True, 1: False}
     assert pool.pending() == 0
 
@@ -134,7 +134,7 @@ def test_pool_inflight_dedup_fans_out_one_solve():
         pool.submit(0, "recA", 1, raws, key)
         pool.submit(1, "recB", 1, raws, key)
     pool._executor.shutdown(wait=True)
-    out = sorted((slot, ok) for slot, rec, n, ok in pool.drain())
+    out = sorted((slot, ok) for slot, rec, n, ok, why in pool.drain())
     assert out == [(0, True), (1, True)], "both waiters get the verdict"
     reg = get_registry()
     assert reg.counter("pipeline.pool_inflight_dedup").value == 1
