@@ -242,10 +242,12 @@ class Report:
         from mythril_tpu.observability import observability_meta
 
         from mythril_tpu.observability.exploration import exploration_meta
+        from mythril_tpu.observability.watchtower import health_meta
 
         meta["observability"] = observability_meta()
         meta["prefilter"] = _prefilter_meta()
         meta["exploration"] = exploration_meta()
+        meta["health"] = health_meta()
         result = [
             {
                 "issues": sorted(_issues, key=lambda k: k["swcID"]),

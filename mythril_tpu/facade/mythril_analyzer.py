@@ -63,6 +63,9 @@ class AnalyzerArgs:
     heartbeat_interval: float = 0.5
     flight_recorder: Optional[str] = None
     watchdog_deadline: Optional[float] = None
+    #: record the metrics registry into a persistent delta-encoded
+    #: history ring under this directory (``myth history`` reads it)
+    history_dir: Optional[str] = None
 
 
 class MythrilAnalyzer:

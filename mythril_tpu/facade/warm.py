@@ -105,6 +105,7 @@ def apply_analyzer_args(cmd_args) -> None:
     args.heartbeat_out = getattr(cmd_args, "heartbeat_out", None)
     args.heartbeat_interval = getattr(cmd_args, "heartbeat_interval", 0.5)
     args.flight_recorder = getattr(cmd_args, "flight_recorder", None)
+    args.history_dir = getattr(cmd_args, "history_dir", None)
     args.watchdog_deadline = getattr(cmd_args, "watchdog_deadline", None)
     # --cache-root pins both persistent caches under one directory;
     # explicit per-cache flags win over the derived paths

@@ -13,6 +13,7 @@ import json
 import logging
 import os
 import sys
+import time
 from typing import List, Optional
 
 from mythril_tpu import __version__
@@ -289,6 +290,13 @@ def _add_analysis_options(parser) -> None:
         "segment completes within SECONDS while a run is active "
         "(default: watchdog off)",
     )
+    group.add_argument(
+        "--history-dir",
+        metavar="DIR",
+        help="record the metrics registry into a persistent delta-encoded "
+        "history ring under DIR at the heartbeat cadence (readable with "
+        "'myth history query')",
+    )
 
 
 def _add_output_options(parser) -> None:
@@ -466,6 +474,29 @@ def create_parser() -> argparse.ArgumentParser:
         "and with --workers N every live worker contributes a linked "
         "bundle (stacks + metrics + heartbeat tail) alongside it",
     )
+    serve.add_argument(
+        "--slo", metavar="FILE", dest="slo_file",
+        help="declarative SLO objectives (YAML/JSON) for the watchtower; "
+        "default: built-in objectives (TTFE/phase p95 budgets, "
+        "error/shed rates, worker liveness, coverage and prefilter "
+        "floors)",
+    )
+    serve.add_argument(
+        "--no-watchtower", action="store_false", dest="watchtower",
+        default=True,
+        help="disable the watchtower (SLO evaluation, breach "
+        "auto-capture and the persistent metrics history under "
+        "<cache-root>/history)",
+    )
+    serve.add_argument(
+        "--watchtower-interval", type=float, default=5.0, metavar="SECONDS",
+        help="watchtower snapshot/evaluation cadence (default 5s)",
+    )
+    serve.add_argument(
+        "--request-log-max-mb", type=float, default=64.0, metavar="MIB",
+        help="rotate --request-log at this size (FILE -> FILE.1 ...; "
+        "0 disables rotation)",
+    )
     _add_verbosity(serve)
 
     submit = subparsers.add_parser(
@@ -524,6 +555,45 @@ def create_parser() -> argparse.ArgumentParser:
         help="render one snapshot and exit (no screen clearing)",
     )
     _add_verbosity(top)
+
+    health = subparsers.add_parser(
+        "health", help="watchtower SLO state of a running analysis "
+        "service (per-objective burn-rate verdicts, breach captures)",
+    )
+    health.add_argument("--host", default="127.0.0.1", help="service host")
+    health.add_argument("--port", type=int, default=7344, help="service port")
+    health.add_argument(
+        "-o", "--outform", choices=["text", "json"], default="text",
+        help="output format",
+    )
+    _add_verbosity(health)
+
+    history = subparsers.add_parser(
+        "history", help="query the persistent metrics history ring "
+        "written by the watchtower (post-hoc plotting/diagnosis)",
+    )
+    history.add_argument(
+        "action", choices=["query", "segments"],
+        help="query: emit (t, value) samples as JSON lines; "
+        "segments: list on-disk ring segments",
+    )
+    history.add_argument(
+        "--dir", dest="history_dir", metavar="DIR",
+        help="history directory (exclusive with --cache-root)",
+    )
+    history.add_argument(
+        "--cache-root", metavar="DIR",
+        help="daemon cache root; reads DIR/history",
+    )
+    history.add_argument(
+        "--metric", action="append", metavar="NAME",
+        help="metric name(s) to emit (repeatable; default: all)",
+    )
+    history.add_argument(
+        "--since", type=float, default=None, metavar="SECONDS",
+        help="only samples from the last SECONDS",
+    )
+    _add_verbosity(history)
 
     subparsers.add_parser("version", help="print version")
     subparsers.add_parser("help", help="print help")
@@ -631,6 +701,7 @@ def _build_analyzer(parsed, query_signature: bool = False):
         heartbeat_interval=getattr(parsed, "heartbeat_interval", 0.5),
         flight_recorder=getattr(parsed, "flight_recorder", None),
         watchdog_deadline=getattr(parsed, "watchdog_deadline", None),
+        history_dir=getattr(parsed, "history_dir", None),
     )
     analyzer = MythrilAnalyzer(
         disassembler, cmd_args, strategy=parsed.strategy, address=address
@@ -660,6 +731,18 @@ def _arm_observability(parsed) -> None:
             flight_dir,
             watchdog_deadline_s=getattr(parsed, "watchdog_deadline", None),
         )
+    history_dir = getattr(parsed, "history_dir", None)
+    if history_dir:
+        # a recording-only watchtower (no objectives): snapshots the
+        # registry into the history ring at the heartbeat cadence
+        from mythril_tpu.observability import Watchtower, set_watchtower
+
+        wt = Watchtower(
+            history_dir, objectives=[],
+            interval_s=getattr(parsed, "heartbeat_interval", 0.5),
+        )
+        wt.start()
+        set_watchtower(wt)
 
 
 def _export_observability(parsed) -> None:
@@ -675,6 +758,18 @@ def _export_observability(parsed) -> None:
         log.info(
             "wrote %d heartbeat samples to %s", hb.ticks, parsed.heartbeat_out
         )
+    if getattr(parsed, "history_dir", None):
+        from mythril_tpu.observability import get_watchtower, set_watchtower
+
+        wt = get_watchtower()
+        if wt is not None:
+            wt.tick()  # final snapshot so the ring ends at run end
+            wt.stop()
+            set_watchtower(None)
+            log.info(
+                "wrote %d history records to %s",
+                wt.history.records, parsed.history_dir,
+            )
     if trace_out:
         from mythril_tpu.observability import get_tracer
 
@@ -859,6 +954,10 @@ def execute_command(parsed) -> None:
             shed_queue_depth=getattr(parsed, "shed_depth", 0),
             age_priority_s=getattr(parsed, "age_priority", 0.0),
             trace=bool(trace_out),
+            request_log_max_mb=getattr(parsed, "request_log_max_mb", 64.0),
+            watchtower=getattr(parsed, "watchtower", True),
+            watchtower_interval_s=getattr(parsed, "watchtower_interval", 5.0),
+            slo_file=getattr(parsed, "slo_file", None),
         )
         if getattr(parsed, "heartbeat_out", None):
             from mythril_tpu.observability import get_heartbeat
@@ -948,6 +1047,50 @@ def execute_command(parsed) -> None:
             interval=parsed.interval,
             once=parsed.once,
         ))
+
+    if command == "health":
+        from mythril_tpu.service.client import ServiceClient
+        from mythril_tpu.service.top import format_health
+
+        client = ServiceClient(parsed.host, parsed.port, timeout=10.0)
+        try:
+            health = client.health()
+        except OSError as e:
+            raise CriticalError(
+                f"cannot reach analysis service at "
+                f"{parsed.host}:{parsed.port}: {e}"
+            ) from e
+        if parsed.outform == "json":
+            print(json.dumps(health, indent=2, sort_keys=True), flush=True)
+        else:
+            print(format_health(
+                health, address=f"{parsed.host}:{parsed.port}"), flush=True)
+        # exit 1 on an active breach so scripts can gate on health
+        sys.exit(1 if health.get("enabled") and not health.get("ok") else 0)
+
+    if command == "history":
+        from mythril_tpu.observability.history import HistoryReader
+
+        hist_dir = getattr(parsed, "history_dir", None)
+        if not hist_dir:
+            root = getattr(parsed, "cache_root", None)
+            if not root:
+                raise CriticalError("history needs --dir or --cache-root")
+            hist_dir = os.path.join(root, "history")
+        reader = HistoryReader(hist_dir)
+        if parsed.action == "segments":
+            for row in reader.segments():
+                print(json.dumps(row), flush=True)
+            return
+        since = None
+        if parsed.since is not None:
+            since = time.time() - parsed.since
+        names = parsed.metric or None
+        for t, values in reader.samples(since=since, names=names):
+            if names and not values:
+                continue
+            print(json.dumps({"t": t, **values}), flush=True)
+        return
 
     if command == "analyze":
         _arm_observability(parsed)

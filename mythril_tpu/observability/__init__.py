@@ -48,6 +48,10 @@ from mythril_tpu.observability.heartbeat import (  # noqa: F401
     HeartbeatSampler,
     get_heartbeat,
 )
+from mythril_tpu.observability.history import (  # noqa: F401
+    HistoryReader,
+    MetricsHistory,
+)
 from mythril_tpu.observability.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -63,6 +67,15 @@ from mythril_tpu.observability.tracer import (  # noqa: F401
     get_tracer,
     span,
     traced,
+)
+from mythril_tpu.observability.watchtower import (  # noqa: F401
+    Objective,
+    Watchtower,
+    default_objectives,
+    get_watchtower,
+    health_meta,
+    load_slo_file,
+    set_watchtower,
 )
 
 

@@ -28,6 +28,7 @@ skipped for the rest of the run.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from collections import deque
@@ -35,6 +36,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from mythril_tpu.observability.metrics import get_registry
 from mythril_tpu.observability.tracer import get_tracer
+
+log = logging.getLogger(__name__)
 
 __all__ = ["HeartbeatSampler", "get_heartbeat"]
 
@@ -126,14 +129,28 @@ class HeartbeatSampler:
                 if self._errors.get(n, 0) < self.MAX_SOURCE_ERRORS
             ]
         sample: Dict[str, Any] = {}
+        reg = get_registry()
         for name, fn in sources:
             try:
                 vals = fn()
             except Exception:
                 # sources read concurrently-mutated pipeline state, so a
                 # transient race may throw; only repeat offenders drop out
+                reg.labeled_counter(
+                    "heartbeat.source_errors", persistent=True,
+                    label_name="source",
+                ).inc(name)
                 with self._lock:
                     self._errors[name] = self._errors.get(name, 0) + 1
+                    dropped = self._errors[name] == self.MAX_SOURCE_ERRORS
+                if dropped:
+                    reg.counter(
+                        "heartbeat.sources_dropped", persistent=True
+                    ).inc()
+                    log.warning(
+                        "heartbeat source %r dropped after %d consecutive "
+                        "errors", name, self.MAX_SOURCE_ERRORS,
+                    )
                 continue
             with self._lock:
                 self._errors.pop(name, None)
@@ -171,6 +188,19 @@ class HeartbeatSampler:
 
     def recent_samples(self) -> List[Dict[str, Any]]:
         return list(self.recent)
+
+    def dropped_sources(self) -> List[str]:
+        """Names of sources dropped for repeated errors (``myth top``)."""
+        with self._lock:
+            return sorted(
+                n for n, c in self._errors.items()
+                if c >= self.MAX_SOURCE_ERRORS
+            )
+
+    def source_error_counts(self) -> Dict[str, int]:
+        """Current consecutive-error count per misbehaving source."""
+        with self._lock:
+            return dict(self._errors)
 
     def reset(self) -> None:
         """Stop and forget all sources/samples (tests, between analyses)."""

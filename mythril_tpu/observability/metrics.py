@@ -43,6 +43,7 @@ __all__ = [
     "LabeledCounter",
     "MetricsRegistry",
     "get_registry",
+    "percentile_from_buckets",
     "prometheus_text",
 ]
 
@@ -91,13 +92,19 @@ class Counter:
 class Gauge:
     """Last-write-wins value; may hold any JSON-serializable object."""
 
-    __slots__ = ("name", "persistent", "value", "gen", "_default")
+    __slots__ = ("name", "persistent", "value", "gen", "_default",
+                 "label_name")
 
-    def __init__(self, name: str, persistent: bool = False, default: Any = 0):
+    def __init__(self, name: str, persistent: bool = False, default: Any = 0,
+                 label_name: str = "key"):
         self.name = name
         self.persistent = persistent
         self._default = default
         self.value: Any = _copy_default(default)
+        # label key used when a dict-valued gauge is rendered to the
+        # Prometheus text format ({objective="ttfe_p95"} reads better
+        # than {key="ttfe_p95"} for the watchtower's status gauge)
+        self.label_name = label_name
         self.gen = 0
 
     def set(self, v: Any) -> None:
@@ -152,6 +159,50 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
 )
+
+
+def percentile_from_buckets(
+    buckets: Tuple[float, ...],
+    bucket_counts: List[int],
+    q: float,
+    lo_obs: Optional[float] = None,
+    hi_obs: Optional[float] = None,
+) -> Optional[float]:
+    """Estimate the ``q``-quantile (0..1) from a bucket layout.
+
+    ``bucket_counts`` is one count per bucket plus the +Inf overflow slot
+    (``Histogram`` layout).  Linear interpolation inside the covering
+    bucket, clamped to ``[lo_obs, hi_obs]`` when observed extremes are
+    known.  Shared by ``Histogram.percentile`` (live registry) and the
+    watchtower's windowed evaluation over history bucket deltas, where
+    only counts — not extremes — survive delta encoding.  Returns
+    ``None`` when the counts are empty.
+    """
+    count = sum(bucket_counts)
+    if not count:
+        return None
+    target = max(0.0, min(1.0, q)) * count
+    cum = 0
+    for i, c in enumerate(bucket_counts):
+        if not c:
+            continue
+        if cum + c >= target:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            if i < len(buckets):
+                hi = buckets[i]
+            elif hi_obs is not None:
+                hi = hi_obs
+            else:
+                hi = buckets[-1]
+            frac = (target - cum) / c
+            est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+            if lo_obs is not None:
+                est = max(est, lo_obs)
+            if hi_obs is not None:
+                est = min(est, hi_obs)
+            return est
+        cum += c
+    return hi_obs if hi_obs is not None else buckets[-1]
 
 
 class Histogram:
@@ -210,24 +261,12 @@ class Histogram:
         Returns ``None`` when nothing has been observed.
         """
         with _MUTATION_LOCK:
-            count = self.count
-            if not count:
+            if not self.count:
                 return None
             counts = list(self.bucket_counts)
             lo_obs, hi_obs = self.min, self.max
-        target = max(0.0, min(1.0, q)) * count
-        cum = 0
-        for i, c in enumerate(counts):
-            if not c:
-                continue
-            if cum + c >= target:
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i] if i < len(self.buckets) else hi_obs
-                frac = (target - cum) / c
-                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
-                return min(max(est, lo_obs), hi_obs)
-            cum += c
-        return hi_obs
+        return percentile_from_buckets(self.buckets, counts, q,
+                                       lo_obs=lo_obs, hi_obs=hi_obs)
 
     def snapshot(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -276,9 +315,10 @@ class MetricsRegistry:
             name, lambda: Counter(name, persistent, initial), Counter
         )
 
-    def gauge(self, name: str, persistent: bool = False, default: Any = 0) -> Gauge:
+    def gauge(self, name: str, persistent: bool = False, default: Any = 0,
+              label_name: str = "key") -> Gauge:
         return self._get_or_create(
-            name, lambda: Gauge(name, persistent, default), Gauge
+            name, lambda: Gauge(name, persistent, default, label_name), Gauge
         )
 
     def labeled_counter(self, name: str, persistent: bool = False,
@@ -404,10 +444,12 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
                            if isinstance(x, (int, float))}
                 if not numeric:
                     continue
+                lkey = _prom_name(m.label_name or "key")
                 lines.append(f"# TYPE {pname} gauge")
                 for k, x in sorted(numeric.items()):
                     lines.append(
-                        f'{pname}{{key="{_prom_label_value(k)}"}} {_prom_number(x)}'
+                        f'{pname}{{{lkey}="{_prom_label_value(k)}"}}'
+                        f" {_prom_number(x)}"
                     )
             elif isinstance(v, (int, float)) and not isinstance(v, bool):
                 lines.append(f"# TYPE {pname} gauge")

@@ -73,7 +73,10 @@ class Flight:
         self.admitted_at: Optional[float] = None
 
     def subscribe(self, request: AnalysisRequest) -> ResultStream:
-        stream = ResultStream(request.request_id)
+        # TTFE clock starts at submission, not subscription: admission
+        # stalls ahead of dispatch must burn the watchtower's budget
+        stream = ResultStream(request.request_id,
+                              created_at=request.submitted_at)
         with self.lock:
             if request not in self.requests:
                 self.requests.append(request)
@@ -192,7 +195,8 @@ class AdmissionController:
                     self._results.move_to_end(key)
                 self._c_dedup.inc()
                 self._c_replay.inc()
-                stream = ResultStream(request.request_id)
+                stream = ResultStream(request.request_id,
+                                      created_at=request.submitted_at)
                 for kind, payload in cached:
                     stream.push(kind, payload)
                 return stream, True

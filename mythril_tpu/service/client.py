@@ -52,6 +52,14 @@ class ServiceClient:
             return event
         return {}
 
+    def health(self) -> Dict[str, Any]:
+        """Watchtower SLO state: ``ok``, ``breaching``, per-objective
+        evaluations (``{"enabled": False}`` when the daemon runs without
+        the watchtower)."""
+        for event in self._roundtrip({"op": "health"}):
+            return event
+        return {"enabled": False, "ok": None, "objectives": []}
+
     def metrics(self) -> str:
         """The daemon's registry in Prometheus text exposition format.
 

@@ -134,6 +134,15 @@ class ServiceConfig:
     trace: bool = False
     #: worker-side telemetry flush cadence (control-thread idle timeout)
     flush_interval_s: float = 0.5
+    #: size-based request-log rollover threshold in MiB (0 disables)
+    request_log_max_mb: float = 64.0
+    #: run the watchtower: persistent metrics history under
+    #: <cache_root>/history plus SLO evaluation with auto-capture
+    #: (``myth serve`` turns this on; unit tests keep it off)
+    watchtower: bool = False
+    watchtower_interval_s: float = 5.0
+    #: declarative SLO file (YAML/JSON); None = built-in defaults
+    slo_file: Optional[str] = None
 
     def scheduler_policy(self) -> Optional[SchedulerPolicy]:
         if not (self.tenant_quota or self.shed_queue_depth
@@ -218,7 +227,15 @@ class AnalysisService:
         self._coverage_by_hash: "collections.OrderedDict[str, float]" = (
             collections.OrderedDict()
         )
-        self.telemetry = RequestTelemetry(request_log=self.config.request_log)
+        self._g_coverage = reg.gauge(
+            "service.coverage_avg_pct", persistent=True
+        )
+        self.telemetry = RequestTelemetry(
+            request_log=self.config.request_log,
+            request_log_max_bytes=int(
+                self.config.request_log_max_mb * 1024 * 1024
+            ),
+        )
         # cross-process telemetry fold: worker delta payloads land here
         # (kept separate from the daemon registry so daemon-side sweeps
         # can never break the worker-sum == rollup invariant)
@@ -228,6 +245,13 @@ class AnalysisService:
         self._profile_ids = itertools.count(1)
         self._profile_waits: Dict[int, Dict[str, Any]] = {}
         self._profile_lock = threading.Lock()
+        self.watchtower = None  # armed in start() when config.watchtower
+        # fault hook (bench serve-load, CI breach drill): stall every
+        # submission ahead of admission by this many seconds, so the
+        # injected latency lands inside the TTFE/queue-wait budgets
+        self._inject_submit_sleep = float(
+            os.environ.get("BENCH_INJECT_ADMISSION_SLEEP", "0") or 0.0
+        )
 
     @property
     def pooled(self) -> bool:
@@ -273,6 +297,8 @@ class AnalysisService:
             )
         self._started = True
         self._worker.start()
+        if self.config.watchtower:
+            self._start_watchtower()
         return self
 
     def wait_warm(self, timeout: Optional[float] = None) -> bool:
@@ -303,12 +329,111 @@ class AnalysisService:
             self._pool.stop(timeout=30.0)
             self._pool = None
         self._started = False
+        wt = self.watchtower
+        if wt is not None:
+            wt.stop()
+            from mythril_tpu.observability.watchtower import set_watchtower
+            set_watchtower(None)
         get_heartbeat().unregister("service")
         unregister_flight_context("service.requests")
         unregister_flight_context("service.workers")
         unregister_dump_listener("service.fleet")
         self.telemetry.close()
         return drained
+
+    def _start_watchtower(self) -> None:
+        """Arm the SLO engine: history ring + objectives + capture hook."""
+        import tempfile
+
+        from mythril_tpu.observability.watchtower import (
+            Watchtower, default_objectives, load_slo_file, set_watchtower,
+        )
+
+        objectives = default_objectives(self.config.workers)
+        options: Dict[str, Any] = {}
+        if self.config.slo_file:
+            objectives, options = load_slo_file(self.config.slo_file)
+        if self.config.cache_root:
+            history_dir = os.path.join(self.config.cache_root, "history")
+        else:
+            history_dir = tempfile.mkdtemp(prefix="myth-history-")
+        capture_cfg = options.get("capture") or {}
+        self._profile_duration_s = float(
+            capture_cfg.get("profile_duration_s", 2.0)
+        )
+        self._profile_on_breach = bool(capture_cfg.get("profile", True))
+        self.watchtower = Watchtower(
+            history_dir,
+            objectives=objectives,
+            interval_s=float(
+                options.get("interval_s", self.config.watchtower_interval_s)
+            ),
+            capture=self._on_slo_breach,
+            capture_cooldown_s=float(capture_cfg.get("cooldown_s", 120.0)),
+        )
+        set_watchtower(self.watchtower)
+        self.watchtower.start()
+        log.info(
+            "watchtower armed: %d objectives, %.1fs cadence, history at %s",
+            len(objectives), self.watchtower.interval_s, history_dir,
+        )
+
+    def _worst_worker(self) -> int:
+        """Capture target: the pool worker with the slowest execute p95
+        (the one most likely implicated in a latency breach)."""
+        pool = self._pool
+        if pool is None:
+            return 0
+        worst, wid = -1.0, 0
+        for row in pool.stats():
+            summary = self.fleet.worker_summary(row.get("id", 0))
+            p95 = (((summary.get("phase_s") or {}).get("execute") or {})
+                   .get("p95_s") or 0.0)
+            if p95 > worst:
+                worst, wid = p95, row.get("id", 0)
+        return wid
+
+    def _on_slo_breach(self, objective, evaluation) -> Dict[str, Any]:
+        """Auto-capture: flight bundle (fans out linked worker bundles in
+        pool mode) + a short profile window on the worst worker, both
+        stamped with the breaching objective."""
+        info: Dict[str, Any] = {}
+        rec = get_flight_recorder()
+        if rec is not None:
+            try:
+                info["bundle"] = rec.dump(
+                    f"slo.{objective.name}",
+                    extra={"slo": evaluation},
+                )
+            except Exception:
+                log.exception("breach bundle dump failed")
+        if self._profile_on_breach:
+            wid = self._worst_worker()
+            info["profile_worker"] = wid
+
+            def _capture() -> None:
+                try:
+                    self.profile(
+                        worker_id=wid,
+                        duration_s=self._profile_duration_s,
+                        tag=f"slo-{objective.name}",
+                    )
+                except Exception:
+                    log.exception("breach profile capture failed")
+
+            # off-thread: profile() blocks for the capture window and the
+            # watchtower tick loop must not stall behind it
+            threading.Thread(
+                target=_capture, name="slo-capture", daemon=True
+            ).start()
+        return info
+
+    def health(self) -> Dict[str, Any]:
+        """The ``health`` verb: watchtower SLO state (or disabled)."""
+        wt = self.watchtower
+        if wt is None:
+            return {"enabled": False, "ok": None, "objectives": []}
+        return wt.health()
 
     def _sample_depths(self) -> Dict[str, int]:
         """Heartbeat source: admission + worker-slot depths + live
@@ -387,6 +512,8 @@ class AnalysisService:
         # worker may finalize the request at any moment, and finalize of
         # an unregistered request would be dropped
         self.telemetry.request_started(request)
+        if self._inject_submit_sleep > 0:
+            time.sleep(self._inject_submit_sleep)
         try:
             stream, deduped = self.admission.submit(request)
         except AdmissionRejected:
@@ -542,6 +669,15 @@ class AnalysisService:
         out["phases"] = self.telemetry.phase_stats()
         out["tenants"] = self.telemetry.tenant_stats()
         out["inflight_requests"] = self.telemetry.active_requests()
+        if self.watchtower is not None:
+            out["health"] = self.watchtower.health()
+        hb = get_heartbeat()
+        dropped = hb.dropped_sources()
+        if dropped:
+            out["heartbeat"] = {
+                "sources_dropped": dropped,
+                "source_errors": hb.source_error_counts(),
+            }
         # "fleet" = this daemon aggregates worker processes; "daemon" =
         # everything in-process (pre-fabric shape, inline worker)
         out["scope"] = "fleet" if self.pooled else "daemon"
@@ -681,6 +817,11 @@ class AnalysisService:
             self._coverage_by_hash.move_to_end(codehash)
         while len(self._coverage_by_hash) > _RID_REGISTRY_CAP:
             self._coverage_by_hash.popitem(last=False)
+        if self._coverage_by_hash:
+            # registry mirror of the rolling average: the watchtower's
+            # coverage-floor objective reads it from the history
+            vals = self._coverage_by_hash.values()
+            self._g_coverage.set(round(sum(vals) / len(vals), 3))
 
     def _coverage_of(self, codehash: str) -> Optional[float]:
         return self._coverage_by_hash.get(codehash)
@@ -1098,22 +1239,26 @@ class AnalysisService:
         except Exception:
             log.exception("failed to write worker %s bundle", wid)
 
-    def profile(self, worker_id: int = 0,
-                duration_s: float = 1.0) -> Dict[str, Any]:
+    def profile(self, worker_id: int = 0, duration_s: float = 1.0,
+                tag: Optional[str] = None) -> Dict[str, Any]:
         """Open a windowed ``jax.profiler`` capture inside one worker.
 
         The capture directory lands under ``--cache-root`` (or the
-        system tempdir).  Pool mode round-trips through the worker's
-        control thread; inline mode profiles this process — the inline
-        worker thread's device work is visible to the process-wide
-        profiler.  Blocks for the window plus transport slack.
+        system tempdir); ``tag`` prefixes its name — the watchtower
+        stamps breach captures with the breaching objective so a 3 a.m.
+        profile is attributable without cross-referencing logs.  Pool
+        mode round-trips through the worker's control thread; inline
+        mode profiles this process — the inline worker thread's device
+        work is visible to the process-wide profiler.  Blocks for the
+        window plus transport slack.
         """
         duration_s = min(max(float(duration_s), 0.05), 60.0)
         root = self.config.cache_root or tempfile.gettempdir()
         profile_id = next(self._profile_ids)
-        out_dir = os.path.join(
-            root, "profiles", f"w{worker_id}-{profile_id}"
-        )
+        stem = f"w{worker_id}-{profile_id}"
+        if tag:
+            stem = f"{_safe_tag(tag)}-{stem}"
+        out_dir = os.path.join(root, "profiles", stem)
         pool = self._pool
         if pool is None:
             from mythril_tpu.service.worker import _run_profile
@@ -1139,6 +1284,13 @@ class AnalysisService:
         result = dict(waiter["result"] or {})
         result["worker"] = worker_id
         return result
+
+
+def _safe_tag(tag: str) -> str:
+    """Reduce a capture tag to a filesystem-safe token."""
+    return "".join(
+        c if (c.isalnum() or c in "._-") else "-" for c in tag
+    ) or "tagged"
 
 
 # Backwards-compatible alias: the wire conversion moved to request.py so

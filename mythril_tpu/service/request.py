@@ -132,16 +132,19 @@ class ResultStream:
     Producer side (flight, under its lock): ``push``.  Consumer side
     (client handler thread): ``events()`` / ``issues()`` — both block
     until the terminal event.  The stream also owns the service-level
-    TTFE sample: the clock starts at subscription, so a dedup subscriber
-    replayed a finished flight legitimately records a near-zero TTFE —
-    that IS the time-to-first-evidence the service delivered.
+    TTFE sample: the clock starts at ``created_at`` — the admission
+    paths pass the request's ``submitted_at`` so any stall *before*
+    dispatch (admission queueing, fault-injected sleeps) counts against
+    the budget the watchtower holds.  A dedup subscriber replayed a
+    finished flight still legitimately records a near-zero TTFE — that
+    IS the time-to-first-evidence the service delivered.
     """
 
     _DONE_KINDS = ("done", "error")
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str, created_at: Optional[float] = None):
         self.request_id = request_id
-        self.created_at = time.time()
+        self.created_at = time.time() if created_at is None else created_at
         self.first_issue_at: Optional[float] = None
         self._q: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
         self._closed = False  # producer-side; guarded by the flight lock

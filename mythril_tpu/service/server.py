@@ -90,6 +90,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 self._send({"event": "pong"})
             elif op == "stats":
                 self._send({"event": "stats", **service.stats()})
+            elif op == "health":
+                self._send({"event": "health", **service.health()})
             elif op == "metrics":
                 from mythril_tpu.observability.metrics import prometheus_text
 

@@ -44,6 +44,7 @@ digests stay bit-identical to solo runs with all of it enabled.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -75,7 +76,11 @@ def _hist_stats(h: Histogram) -> Dict[str, Any]:
 
 
 class RequestTelemetry:
-    def __init__(self, request_log: Optional[str] = None):
+    # rollover keeps FILE.1 .. FILE.<backups>; oldest falls off the end
+    LOG_BACKUPS = 5
+
+    def __init__(self, request_log: Optional[str] = None,
+                 request_log_max_bytes: int = 0):
         reg = get_registry()
         # persistent=True throughout: the worker sweeps analysis-scoped
         # metrics before every shared batch
@@ -106,6 +111,9 @@ class RequestTelemetry:
         self._flows_emitted: set = set()
         self._log_lock = threading.Lock()
         self._log_path = request_log
+        self._log_max_bytes = max(0, int(request_log_max_bytes))
+        self._c_log_rotations = reg.counter(
+            "service.request_log_rotations", persistent=True)
         self._log_file = open(request_log, "a", encoding="utf-8") \
             if request_log else None
 
@@ -311,6 +319,28 @@ class RequestTelemetry:
             if self._log_file is not None:
                 self._log_file.write(line)
                 self._log_file.flush()
+                if (self._log_max_bytes
+                        and self._log_file.tell() >= self._log_max_bytes):
+                    self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Size-based rollover: FILE -> FILE.1 -> ... (caller holds lock).
+
+        A long-lived daemon otherwise grows the request log without
+        bound; the rotation counter makes rollover rate visible.
+        """
+        base = self._log_path
+        try:
+            self._log_file.close()
+            for i in range(self.LOG_BACKUPS - 1, 0, -1):
+                src = f"{base}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{base}.{i + 1}")
+            os.replace(base, f"{base}.1")
+            self._c_log_rotations.inc()
+        except OSError:
+            pass  # worst case: keep appending to the current file
+        self._log_file = open(base, "a", encoding="utf-8")
 
     # -- introspection -------------------------------------------------
 

@@ -18,7 +18,7 @@ from typing import Any, Dict, Optional, TextIO
 
 from mythril_tpu.service.client import ServiceClient
 
-__all__ = ["format_top", "run_top"]
+__all__ = ["format_health", "format_top", "run_top"]
 
 # ANSI: clear screen + home.  Only emitted between refreshes, never in
 # --once mode, so piped output stays clean.
@@ -44,6 +44,26 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
     if scope:
         title += f"  [{scope}]"
     lines.append(title)
+    health = stats.get("health")
+    if health and health.get("enabled"):
+        breaching = health.get("breaching") or []
+        if breaching:
+            lines.append("!! SLO BREACH: " + ", ".join(breaching)
+                         + f"  (breaches_total {health.get('breaches_total', 0)})")
+        else:
+            n = len(health.get("objectives") or [])
+            line = f"slo: ok ({n} objective{'s' if n != 1 else ''}"
+            warning = health.get("warning") or []
+            if warning:
+                line += f", warn: {', '.join(warning)}"
+            if health.get("breaches_total"):
+                line += f", breaches_total {health['breaches_total']}"
+            lines.append(line + ")")
+    hb = stats.get("heartbeat") or {}
+    if hb.get("sources_dropped"):
+        lines.append("WARN heartbeat: dropped sources "
+                     + ", ".join(hb["sources_dropped"])
+                     + " (repeated sampling errors)")
     cache = stats.get("cache") or {}
     lines.append(
         "queue {q}  inflight {i}  cached {c}  |  requests {r}  "
@@ -165,6 +185,66 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         )
     if len(inflight) > 32:
         lines.append(f"  ... and {len(inflight) - 32} more")
+    return "\n".join(lines)
+
+
+_STATE_MARK = {"ok": "ok    ", "warn": "WARN  ", "breach": "BREACH",
+               "no_data": "-     "}
+
+
+def _fmt_value(v: Any, kind: str) -> str:
+    if v is None:
+        return "-"
+    if kind == "ratio":
+        return f"{v:.1%}"
+    if kind == "quantile":
+        return _ms(v)
+    return f"{v:g}"
+
+
+def format_health(health: Dict[str, Any],
+                  address: Optional[str] = None) -> str:
+    """Render one ``health`` payload as the ``myth health`` report.
+
+    Pure over the payload (tests assert against canned dicts), mirroring
+    ``format_top``.
+    """
+    if not health.get("enabled"):
+        return "watchtower: disabled (daemon runs without --slo/watchtower)"
+    lines = []
+    title = "watchtower"
+    if address:
+        title += f" @ {address}"
+    objectives = health.get("objectives") or []
+    breaching = health.get("breaching") or []
+    verdict = "BREACH" if breaching else "ok"
+    n = len(objectives)
+    title += (f": {verdict}  ({n} objective{'s' if n != 1 else ''}, "
+              f"breaches_total {health.get('breaches_total', 0)}, "
+              f"tick {health.get('interval_s', 0):g}s, "
+              f"overhead {health.get('overhead_pct', 0):g}%)")
+    lines.append(title)
+    for e in objectives:
+        kind = e.get("kind", "")
+        win = ""
+        if kind in ("quantile", "ratio"):
+            win = (f"  [fast {e.get('fast_window_s', 0):g}s"
+                   f"/slow {e.get('slow_window_s', 0):g}s"
+                   f", n={e.get('window_count', 0)}]")
+        lines.append(
+            f"  {_STATE_MARK.get(e.get('state'), '?     ')} "
+            f"{e.get('name', '?'):<22}"
+            f"{_fmt_value(e.get('value'), kind):>10}  "
+            f"{e.get('op', '?')} {_fmt_value(e.get('target'), kind)}"
+            f"{win}"
+        )
+    for cap in health.get("captures") or []:
+        lines.append(
+            f"  capture: {cap.get('objective', '?')}"
+            + (f"  bundle {cap['bundle']}" if cap.get("bundle") else "")
+            + (f"  profile worker {cap['profile_worker']}"
+               if "profile_worker" in cap else "")
+        )
     return "\n".join(lines)
 
 

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Watchtower acceptance smoke: the SLO breach drill, end to end.
+
+Phase 1 (breach drill) — a real ``myth serve --workers 2`` daemon with a
+tight SLO file and an injected 2s admission-side stall
+(``BENCH_INJECT_ADMISSION_SLEEP=2``).  The TTFE objective must breach
+within one fast window, and the breach must leave the full evidence
+trail on disk:
+
+* ``slo.breaches_total`` increments (Prometheus scrape);
+* a flight-recorder bundle stamped with the objective, fanned out to
+  every worker (linked worker bundles);
+* a windowed profiler capture directory stamped ``slo-ttfe_p95-*``;
+* ``myth health`` (the CLI subprocess) reports the breach and exits 1;
+* the persistent history ring under ``--cache-root/history`` survives
+  the daemon and replays through ``HistoryReader``.
+
+Phase 2 (clean run) — the same daemon shape with the injection removed
+and honest targets: health stays ok, zero breaches, ``myth health``
+exits 0.  Guards against a watchtower that cries wolf.
+
+Exit status is nonzero on any violation.  Artifacts land in ``--out``
+(default ``watchtower-smoke/``) for CI to archive.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/watchtower_smoke.py --out DIR
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+BREACH_PORT = 7395
+CLEAN_PORT = 7394
+
+FAILURES: list = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"[watchtower-smoke] {tag}: {what}", flush=True)
+    if not ok:
+        FAILURES.append(what)
+
+
+def _kill_hex() -> str:
+    return (REPO / "tests/testdata/inputs/kill_simple.bin-runtime") \
+        .read_text().strip()
+
+
+def _spawn_daemon(port: int, out: pathlib.Path, slo: pathlib.Path,
+                  env_extra: dict, log_name: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra)
+    log = open(out / log_name, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "mythril_tpu", "serve",
+         "--port", str(port), "--no-frontier",
+         "--workers", "2", "--batch-width", "1", "-t", "1",
+         "--cache-root", str(out / "cache"),
+         "--flight-recorder", str(out / "flight"),
+         "--slo", str(slo)],
+        cwd=str(REPO), env=env, stdout=log, stderr=log,
+    )
+
+
+def _stop_daemon(proc: subprocess.Popen, what: str,
+                 expect_clean: bool = True) -> None:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30)
+        check(False, f"{what}: daemon drained on SIGTERM (hung, killed)")
+        return
+    if expect_clean:
+        check(rc == 0, f"{what}: daemon drained cleanly on SIGTERM (rc={rc})")
+
+
+def _myth_health(port: int) -> tuple:
+    """Run the `myth health` CLI as a subprocess -> (rc, stdout)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "mythril_tpu", "health",
+         "--port", str(port)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    return r.returncode, r.stdout + r.stderr
+
+
+def breach_drill(out: pathlib.Path) -> None:
+    from mythril_tpu.service.client import ServiceClient
+    from mythril_tpu.service.server import wait_for_server
+
+    out.mkdir(parents=True, exist_ok=True)
+    slo = out / "slo.json"
+    # tight TTFE budget + short windows: the injected 2s stall must trip
+    # the fast window on the first evaluation that sees a sample
+    slo.write_text(json.dumps({
+        "interval_s": 1.0,
+        "capture": {"profile": True, "profile_duration_s": 0.3,
+                    "cooldown_s": 5},
+        "objectives": [
+            {"name": "ttfe_p95", "kind": "quantile",
+             "metric": "service.ttfe_s", "q": 0.95, "target": 0.5,
+             "fast_window_s": 10, "slow_window_s": 30, "min_count": 1},
+        ],
+    }))
+    proc = _spawn_daemon(BREACH_PORT, out, slo,
+                         {"BENCH_INJECT_ADMISSION_SLEEP": "2"},
+                         "serve.log")
+    try:
+        check(wait_for_server("127.0.0.1", BREACH_PORT, timeout=120),
+              "breach daemon came up")
+        client = ServiceClient("127.0.0.1", BREACH_PORT, timeout=300.0)
+        # interactive tier: TTFE is the stalled submit + first finding
+        rid = client.submit_detached(
+            _kill_hex(), name="kill", tier="interactive"
+        )["request_id"]
+        client.wait(rid, timeout=300)
+
+        health = {}
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            health = client.health()
+            if health.get("enabled") and not health.get("ok"):
+                break
+            time.sleep(0.5)
+        check(health.get("enabled") is True, "health verb: watchtower on")
+        check(health.get("ok") is False,
+              f"TTFE breached within the drill window ({health.get('breaching')})")
+        check("ttfe_p95" in (health.get("breaching") or []),
+              "the breaching objective is ttfe_p95")
+        check(int(health.get("breaches_total") or 0) >= 1,
+              "breaches_total incremented")
+
+        # scrape: the breach counters and the per-objective status gauge
+        text = client.metrics()
+        check("slo_breaches_total" in text
+              and any(l.startswith("slo_breaches_total")
+                      and float(l.rsplit(" ", 1)[1]) >= 1
+                      for l in text.splitlines()),
+              "prometheus slo_breaches_total >= 1")
+        check('slo_status{objective="ttfe_p95"} 2' in text,
+              "prometheus slo_status gauge reports breach (2)")
+
+        # the capture trail: give the fan-out + profile window a moment
+        flight = out / "flight"
+        profiles = out / "cache" / "profiles"
+        daemon_b, worker_b, prof_dirs = [], [], []
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            names = (sorted(os.listdir(flight))
+                     if flight.is_dir() else [])
+            worker_b = [n for n in names if "-w0-" in n or "-w1-" in n]
+            daemon_b = [n for n in names
+                        if n not in worker_b and "slo.ttfe_p95" in n]
+            prof_dirs = (sorted(p for p in os.listdir(profiles)
+                                if p.startswith("slo-ttfe_p95-"))
+                         if profiles.is_dir() else [])
+            if daemon_b and len(worker_b) >= 2 and prof_dirs:
+                break
+            time.sleep(0.5)
+        check(bool(daemon_b),
+              f"flight bundle stamped with the objective ({daemon_b[:2]})")
+        check(len(worker_b) >= 2,
+              f"linked bundles fanned out to both workers ({worker_b[:4]})")
+        if daemon_b:
+            bundle = json.load(open(flight / daemon_b[0]))
+            slo_block = bundle.get("slo") or {}
+            check(slo_block.get("name") == "ttfe_p95",
+                  "bundle carries the SLO evaluation")
+        check(bool(prof_dirs),
+              f"profiler capture stamped slo-ttfe_p95-* ({prof_dirs[:2]})")
+
+        rc, text = _myth_health(BREACH_PORT)
+        check(rc == 1, f"`myth health` exits 1 on breach (rc={rc})")
+        check("ttfe_p95" in text, "`myth health` names the objective")
+    finally:
+        _stop_daemon(proc, "breach drill")
+        sys.stdout.write((out / "serve.log").read_text()[-4000:])
+
+    # the history ring outlives the daemon
+    from mythril_tpu.observability.history import HistoryReader
+
+    hist = out / "cache" / "history"
+    check(hist.is_dir(), "history ring exists under --cache-root")
+    if hist.is_dir():
+        reader = HistoryReader(str(hist))
+        segs = reader.segments()
+        check(bool(segs), f"history has segments ({segs})")
+        series = list(reader.series("service.requests"))
+        check(bool(series), "service.requests replays from history")
+
+
+def clean_run(out: pathlib.Path) -> None:
+    from mythril_tpu.service.client import ServiceClient
+    from mythril_tpu.service.server import wait_for_server
+
+    out.mkdir(parents=True, exist_ok=True)
+    slo = out / "slo.json"
+    # honest CPU-CI targets: a clean daemon must hold these
+    slo.write_text(json.dumps({
+        "interval_s": 1.0,
+        "capture": {"profile": False},
+        "objectives": [
+            {"name": "ttfe_p95", "kind": "quantile",
+             "metric": "service.ttfe_s", "q": 0.95, "target": 30.0,
+             "fast_window_s": 10, "slow_window_s": 30},
+            {"name": "error_rate", "kind": "ratio",
+             "metric": "service.request_errors",
+             "denominator": "service.requests", "target": 0.05,
+             "min_count": 2},
+        ],
+    }))
+    proc = _spawn_daemon(CLEAN_PORT, out, slo, {}, "serve.log")
+    try:
+        check(wait_for_server("127.0.0.1", CLEAN_PORT, timeout=120),
+              "clean daemon came up")
+        client = ServiceClient("127.0.0.1", CLEAN_PORT, timeout=300.0)
+        for i in range(3):
+            rid = client.submit_detached(
+                _kill_hex(), name=f"kill{i}", tier="interactive"
+            )["request_id"]
+            client.wait(rid, timeout=300)
+        time.sleep(2.5)  # at least two evaluation ticks past the traffic
+        health = client.health()
+        check(health.get("enabled") is True, "clean: watchtower on")
+        check(health.get("ok") is True,
+              f"clean: no breach (breaching={health.get('breaching')})")
+        check(int(health.get("breaches_total") or 0) == 0,
+              "clean: zero breaches_total")
+        overhead = float(health.get("overhead_pct") or 0.0)
+        check(overhead < 2.0,
+              f"clean: watchtower overhead {overhead:.3f}% < 2% budget")
+        rc, _text = _myth_health(CLEAN_PORT)
+        check(rc == 0, f"clean: `myth health` exits 0 (rc={rc})")
+    finally:
+        _stop_daemon(proc, "clean run")
+        sys.stdout.write((out / "serve.log").read_text()[-4000:])
+
+
+def main() -> int:
+    out = pathlib.Path(
+        sys.argv[sys.argv.index("--out") + 1]
+        if "--out" in sys.argv else "watchtower-smoke"
+    )
+    out.mkdir(parents=True, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    breach_drill(out / "breach")
+    clean_run(out / "clean")
+
+    if FAILURES:
+        print(f"[watchtower-smoke] {len(FAILURES)} FAILURES:",
+              file=sys.stderr)
+        for f in FAILURES:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("[watchtower-smoke] all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,3 +127,31 @@ def test_unregister_and_reset(hb):
 
 def test_singleton_accessor():
     assert get_heartbeat() is get_heartbeat()
+
+
+def test_source_failures_are_counted_and_visible(hb):
+    reg = get_registry()
+    reg.reset(include_persistent=True, prefix="heartbeat.")
+
+    def broken():
+        raise RuntimeError("permanently broken")
+
+    hb.register("broken", broken)
+    for _ in range(HeartbeatSampler.MAX_SOURCE_ERRORS + 2):
+        hb.sample_now()
+    errors = dict(reg.labeled_counter(
+        "heartbeat.source_errors", persistent=True))
+    # every failed sample counted, attributed to the source by name
+    assert errors["broken"] == HeartbeatSampler.MAX_SOURCE_ERRORS
+    # the drop itself counted exactly once
+    assert reg.counter(
+        "heartbeat.sources_dropped", persistent=True).value == 1
+    assert hb.dropped_sources() == ["broken"]
+    assert hb.source_error_counts()["broken"] >= \
+        HeartbeatSampler.MAX_SOURCE_ERRORS
+    # re-registering clears the dropped state
+    hb.register("broken", lambda: {"test.hb.ok": 1})
+    hb.sample_now()
+    assert hb.dropped_sources() == []
+    reg.reset(include_persistent=True, prefix="heartbeat.")
+    reg.reset(prefix="test.hb.")

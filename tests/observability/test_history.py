@@ -1,0 +1,221 @@
+"""Metrics history ring: delta encoding, rotation, restart seams, windows."""
+
+import json
+import os
+
+import pytest
+
+from mythril_tpu.observability.history import (
+    HistoryReader,
+    MetricsHistory,
+    counter_window,
+    encode_registry,
+    histogram_window,
+    window_percentile,
+)
+from mythril_tpu.observability.metrics import MetricsRegistry
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry()
+
+
+def _hist(tmp_path, reg, **kw):
+    return MetricsHistory(str(tmp_path), registry=reg, **kw)
+
+
+def _lines(path):
+    return [json.loads(l) for l in open(path) if l.strip()]
+
+
+def test_roundtrip_counter_gauge_histogram(tmp_path, reg):
+    reg.counter("service.requests").inc(3)
+    reg.gauge("service.workers").set(2)
+    h = reg.histogram("service.ttfe_s", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    hist = _hist(tmp_path, reg)
+    t, values = hist.record(t=100.0)
+    hist.close()
+    assert values["service.requests"] == 3
+    assert values["service.workers"] == 2
+    assert values["service.ttfe_s"]["c"] == 2
+
+    reader = HistoryReader(str(tmp_path))
+    samples = list(reader.samples())
+    assert len(samples) == 1
+    rt, rvals = samples[0]
+    assert rt == 100.0
+    assert rvals == values
+    # bucket boundaries replay from the full line's hb map
+    assert reader.bucket_bounds["service.ttfe_s"] == (0.1, 1.0, 10.0)
+
+
+def test_delta_lines_carry_only_changes(tmp_path, reg):
+    c = reg.counter("service.requests")
+    reg.gauge("service.workers").set(1)
+    c.inc()
+    hist = _hist(tmp_path, reg)
+    hist.record(t=1.0)
+    hist.record(t=2.0)  # nothing changed: no line at all
+    c.inc()
+    hist.record(t=3.0)  # only the counter changed
+    hist.close()
+
+    (path,) = [p for _, p in
+               [(0, os.path.join(str(tmp_path), "seg-00000000.jsonl"))]]
+    lines = _lines(path)
+    assert len(lines) == 2  # full + one delta; the quiet tick wrote nothing
+    assert lines[0]["full"] == 1
+    assert lines[1]["m"] == {"service.requests": 2}
+
+    # the reader still reconstructs the unchanged gauge at every tick
+    reader = HistoryReader(str(tmp_path))
+    series = reader.series("service.workers")
+    assert [v for _, v in series] == [1, 1]
+
+
+def test_zero_counters_omitted_gauge_zero_kept(reg):
+    reg.counter("service.nothing")  # zero: absent means zero
+    reg.gauge("service.workers").set(0)  # zero gauge is a statement
+    values, _bounds = encode_registry(reg)
+    assert "service.nothing" not in values
+    assert values["service.workers"] == 0
+
+
+def test_prefix_filter(reg):
+    reg.counter("service.requests").inc()
+    reg.counter("frontier.segments").inc()
+    values, _ = encode_registry(reg)
+    assert "service.requests" in values
+    assert "frontier.segments" not in values
+
+
+def test_rotation_and_ring_prune(tmp_path, reg):
+    c = reg.counter("service.requests")
+    hist = _hist(tmp_path, reg, max_segment_bytes=1, max_segments=3)
+    for i in range(8):
+        c.inc()
+        hist.record(t=float(i))
+    hist.close()
+    names = sorted(n for n in os.listdir(str(tmp_path))
+                   if n.startswith("seg-"))
+    # every tick rotated (1-byte budget); only the newest 3 survive
+    assert len(names) <= 3
+    assert names[-1] > names[0]
+    # each surviving segment leads with a full snapshot: independently
+    # readable, so the pruned prefix costs nothing
+    for n in names:
+        assert _lines(os.path.join(str(tmp_path), n))[0].get("full") == 1
+
+
+def test_restart_continues_sequence(tmp_path, reg):
+    c = reg.counter("service.requests")
+    c.inc()
+    h1 = _hist(tmp_path, reg)
+    h1.record(t=1.0)
+    h1.close()
+
+    c.inc(5)
+    h2 = _hist(tmp_path, reg)
+    h2.record(t=2.0)
+    h2.close()
+    names = sorted(n for n in os.listdir(str(tmp_path))
+                   if n.startswith("seg-"))
+    assert names == ["seg-00000000.jsonl", "seg-00000001.jsonl"]
+
+    reader = HistoryReader(str(tmp_path))
+    series = reader.series("service.requests")
+    assert [v for _, v in series] == [1, 6]
+
+
+def test_reader_tolerates_torn_tail_line(tmp_path, reg):
+    reg.counter("service.requests").inc()
+    hist = _hist(tmp_path, reg)
+    hist.record(t=1.0)
+    hist.close()
+    path = os.path.join(str(tmp_path), "seg-00000000.jsonl")
+    with open(path, "a") as f:
+        f.write('{"t": 2.0, "m": {"service.requ')  # crashed writer
+    reader = HistoryReader(str(tmp_path))
+    assert len(list(reader.samples())) == 1
+
+
+def test_since_until_filters(tmp_path, reg):
+    c = reg.counter("service.requests")
+    hist = _hist(tmp_path, reg)
+    for i in range(5):
+        c.inc()
+        hist.record(t=float(i))
+    hist.close()
+    reader = HistoryReader(str(tmp_path))
+    ts = [t for t, _ in reader.samples(since=1.0, until=3.0)]
+    assert ts == [1.0, 2.0, 3.0]
+    assert reader.latest()[0] == 4.0
+    segs = reader.segments()
+    assert segs[0]["lines"] == 5
+    assert segs[0]["t_first"] == 0.0 and segs[0]["t_last"] == 4.0
+
+
+# -- windowed evaluation --------------------------------------------------
+
+
+def _hist_sample(c, bc, s=0.0, mn=None, mx=None):
+    return {"service.lat_s": {"c": c, "s": s, "mn": mn, "mx": mx,
+                              "bc": list(bc)}}
+
+
+def test_counter_window_delta_and_seam(tmp_path):
+    samples = [
+        (0.0, {"service.requests": 10}),
+        (5.0, {"service.requests": 14}),
+        (10.0, {"service.requests": 3}),  # restart seam: counter fell
+    ]
+    assert counter_window(samples, "service.requests", 0.0, 5.0) == 4.0
+    # a negative delta means a restart crossed the window: the end value
+    # ("everything since the restart") is the conservative reading
+    assert counter_window(samples, "service.requests", 0.0, 10.0) == 3.0
+    assert counter_window(samples, "service.missing", 0.0, 10.0) == 0.0
+
+
+def test_histogram_window_delta_and_percentile():
+    bounds = {"service.lat_s": (0.1, 1.0, 10.0)}
+    samples = [
+        (0.0, _hist_sample(2, [2, 0, 0, 0], mn=0.01, mx=0.05)),
+        (60.0, _hist_sample(6, [2, 0, 4, 0], mn=0.01, mx=8.0)),
+    ]
+    win = histogram_window(samples, "service.lat_s", 0.0, 60.0)
+    # the two old sub-0.1s observations are outside the window
+    assert win["bc"] == [0, 0, 4, 0] and win["count"] == 4
+    est, n = window_percentile(
+        samples, "service.lat_s", 0.95, 0.0, 60.0, bounds)
+    assert n == 4
+    # all windowed mass in the (1.0, 10.0] bucket, clamped by mx=8.0
+    assert 1.0 <= est <= 8.0
+
+
+def test_window_percentile_respects_min_count():
+    bounds = {"service.lat_s": (0.1, 1.0)}
+    samples = [(0.0, _hist_sample(1, [1, 0, 0]))]
+    est, n = window_percentile(
+        samples, "service.lat_s", 0.95, -60.0, 0.0, bounds, min_count=5)
+    assert est is None and n == 1
+
+
+def test_window_percentile_over_reader_replay(tmp_path, reg):
+    """The on-disk delta replay feeds the same window math as the tail."""
+    h = reg.histogram("service.lat_s", buckets=(0.1, 1.0, 10.0))
+    hist = _hist(tmp_path, reg)
+    h.observe(0.05)
+    hist.record(t=0.0)
+    for _ in range(4):
+        h.observe(5.0)
+    hist.record(t=60.0)
+    hist.close()
+    reader = HistoryReader(str(tmp_path))
+    samples = list(reader.samples())
+    est, n = window_percentile(
+        samples, "service.lat_s", 0.95, 0.0, 60.0, reader.bucket_bounds)
+    assert n == 4
+    assert 1.0 <= est <= 10.0

@@ -196,3 +196,37 @@ def test_prometheus_label_names_sanitized(reg):
     )
     text = prometheus_text(reg)
     assert 'svc_by_kind{kind_of_thing="x"} 2' in text
+
+
+def test_percentile_edge_cases(reg):
+    from mythril_tpu.observability.metrics import percentile_from_buckets
+
+    h = reg.histogram("t.edge", buckets=(1.0, 2.0, 4.0))
+    # empty histogram has no quantiles at all
+    assert h.percentile(0.5) is None
+    assert h.percentile(0.0) is None
+
+    # single observation: every quantile is that observation
+    h.observe(1.5)
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert h.percentile(q) == pytest.approx(1.5)
+
+    # q outside [0, 1] clamps instead of extrapolating
+    assert h.percentile(-3.0) == h.percentile(0.0)
+    assert h.percentile(7.0) == h.percentile(1.0)
+
+    # all mass in one bucket: estimates stay inside that bucket and
+    # clamp to the observed extremes
+    h2 = reg.histogram("t.edge2", buckets=(1.0, 2.0, 4.0))
+    for v in (1.2, 1.4, 1.8):
+        h2.observe(v)
+    for q in (0.1, 0.5, 0.9):
+        assert 1.2 <= h2.percentile(q) <= 1.8
+
+    # the module function mirrors Histogram.percentile exactly (the
+    # history window estimator depends on this)
+    assert percentile_from_buckets(
+        (1.0, 2.0, 4.0), [0, 3, 0, 0], 0.5, lo_obs=1.2, hi_obs=1.8
+    ) == pytest.approx(h2.percentile(0.5))
+    # and tolerates an empty window
+    assert percentile_from_buckets((1.0, 2.0), [0, 0, 0], 0.5) is None

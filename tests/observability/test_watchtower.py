@@ -1,0 +1,284 @@
+"""Watchtower SLO engine: evaluation states, breach edges, captures, config."""
+
+import json
+
+import pytest
+
+from mythril_tpu.observability.metrics import get_registry, prometheus_text
+from mythril_tpu.observability.watchtower import (
+    STATUS_BREACH,
+    STATUS_OK,
+    Objective,
+    Watchtower,
+    default_objectives,
+    load_slo_file,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo_metrics():
+    reg = get_registry()
+    yield
+    reg.reset(include_persistent=True, prefix="slo.")
+
+
+def _hist_value(bc, mn=None, mx=None):
+    return {"c": sum(bc), "s": 0.0, "mn": mn, "mx": mx, "bc": list(bc)}
+
+
+class FakeSource:
+    """Scripted (values, bounds) snapshots for deterministic ticks."""
+
+    def __init__(self, bounds=None):
+        self.values = {}
+        self.bounds = bounds or {}
+
+    def __call__(self):
+        return dict(self.values), dict(self.bounds)
+
+
+def _tower(tmp_path, objectives, source, **kw):
+    return Watchtower(
+        str(tmp_path), objectives=objectives, interval_s=1.0,
+        source=source, **kw)
+
+
+def test_quantile_objective_ok_then_breach(tmp_path):
+    src = FakeSource(bounds={"service.ttfe_s": (0.1, 1.0, 10.0)})
+    obj = Objective("ttfe_p95", "quantile", "service.ttfe_s", target=1.0,
+                    fast_window_s=10, slow_window_s=30)
+    wt = _tower(tmp_path, [obj], src)
+    try:
+        evals = wt.tick(now=0.0)
+        assert evals["ttfe_p95"]["state"] == "no_data"
+
+        src.values["service.ttfe_s"] = _hist_value([3, 0, 0, 0], mx=0.05)
+        evals = wt.tick(now=1.0)
+        assert evals["ttfe_p95"]["state"] == "ok"
+        assert evals["ttfe_p95"]["status"] == STATUS_OK
+
+        src.values["service.ttfe_s"] = _hist_value([3, 0, 4, 0], mx=8.0)
+        evals = wt.tick(now=2.0)
+        # fast window violates and the slow window agrees (same data):
+        # a breach, not a warn
+        assert evals["ttfe_p95"]["state"] == "breach"
+        assert evals["ttfe_p95"]["status"] == STATUS_BREACH
+        assert evals["ttfe_p95"]["value"] > 1.0
+    finally:
+        wt.stop()
+
+
+def test_ratio_objective_min_count_gate(tmp_path):
+    src = FakeSource()
+    obj = Objective("error_rate", "ratio", "service.request_errors",
+                    denominator="service.requests", target=0.05,
+                    min_count=5, fast_window_s=10, slow_window_s=30)
+    wt = _tower(tmp_path, [obj], src)
+    try:
+        src.values = {"service.requests": 2, "service.request_errors": 2}
+        evals = wt.tick(now=0.0)
+        # denominator below min_count: no data, NOT a 100% error rate
+        assert evals["error_rate"]["state"] == "no_data"
+
+        src.values = {"service.requests": 10, "service.request_errors": 2}
+        evals = wt.tick(now=1.0)
+        assert evals["error_rate"]["state"] == "breach"
+        assert evals["error_rate"]["value"] == pytest.approx(0.2)
+
+        src.values = {"service.requests": 200, "service.request_errors": 2}
+        evals = wt.tick(now=2.0)
+        assert evals["error_rate"]["state"] == "ok"
+    finally:
+        wt.stop()
+
+
+def test_gauge_floor_objective(tmp_path):
+    src = FakeSource()
+    obj = Objective("worker_liveness", "gauge", "service.workers",
+                    target=2.0, op=">=")
+    wt = _tower(tmp_path, [obj], src)
+    try:
+        src.values = {"service.workers": 2}
+        assert wt.tick(now=0.0)["worker_liveness"]["state"] == "ok"
+        src.values = {"service.workers": 1}
+        assert wt.tick(now=1.0)["worker_liveness"]["state"] == "breach"
+    finally:
+        wt.stop()
+
+
+def test_breach_edge_counts_once_and_recovers(tmp_path):
+    reg = get_registry()
+    src = FakeSource()
+    obj = Objective("liveness", "gauge", "service.workers",
+                    target=2.0, op=">=")
+    wt = _tower(tmp_path, [obj], src)
+    try:
+        base = reg.counter("slo.breaches_total", persistent=True).value
+        src.values = {"service.workers": 1}
+        wt.tick(now=0.0)
+        wt.tick(now=1.0)
+        wt.tick(now=2.0)
+        # three breaching ticks = ONE breach edge
+        assert reg.counter("slo.breaches_total",
+                           persistent=True).value == base + 1
+        src.values = {"service.workers": 2}
+        wt.tick(now=3.0)
+        assert wt.health()["ok"] is True
+        src.values = {"service.workers": 0}
+        wt.tick(now=4.0)
+        # a fresh ok->breach edge counts again
+        assert reg.counter("slo.breaches_total",
+                           persistent=True).value == base + 2
+        assert dict(reg.labeled_counter(
+            "slo.breaches", persistent=True))["liveness"] == 2
+    finally:
+        wt.stop()
+
+
+def test_capture_fires_on_breach_with_cooldown(tmp_path):
+    src = FakeSource()
+    fired = []
+
+    def hook(objective, evaluation):
+        fired.append(objective.name)
+        return {"bundle": f"/tmp/{objective.name}.json"}
+
+    obj = Objective("liveness", "gauge", "service.workers",
+                    target=2.0, op=">=")
+    wt = _tower(tmp_path, [obj], src, capture=hook,
+                capture_cooldown_s=10.0)
+    try:
+        src.values = {"service.workers": 1}
+        wt.tick(now=1000.0)
+        wt.tick(now=1005.0)  # inside cooldown: no second capture
+        wt.tick(now=1011.0)  # past cooldown while still breaching: fires
+        assert fired == ["liveness", "liveness"]
+        caps = list(wt.captures)
+        assert caps[0]["objective"] == "liveness"
+        assert caps[0]["bundle"].endswith("liveness.json")
+    finally:
+        wt.stop()
+
+
+def test_capture_exception_does_not_kill_tick(tmp_path):
+    src = FakeSource()
+
+    def hook(objective, evaluation):
+        raise RuntimeError("capture backend down")
+
+    obj = Objective("liveness", "gauge", "service.workers",
+                    target=2.0, op=">=")
+    wt = _tower(tmp_path, [obj], src, capture=hook)
+    try:
+        src.values = {"service.workers": 0}
+        evals = wt.tick(now=0.0)
+        assert evals["liveness"]["state"] == "breach"
+        assert wt.health()["breaches_total"] >= 1
+    finally:
+        wt.stop()
+
+
+def test_health_and_status_line(tmp_path):
+    src = FakeSource()
+    obj = Objective("liveness", "gauge", "service.workers",
+                    target=2.0, op=">=")
+    wt = _tower(tmp_path, [obj], src)
+    try:
+        src.values = {"service.workers": 2}
+        wt.tick(now=0.0)
+        h = wt.health()
+        assert h["enabled"] and h["ok"] and h["breaching"] == []
+        assert "slo: ok (1 objective" in wt.status_line()
+        src.values = {"service.workers": 0}
+        wt.tick(now=1.0)
+        assert wt.status_line().startswith("SLO BREACH: liveness")
+        # prometheus rendering: per-objective label from the dict gauge
+        text = prometheus_text()
+        assert 'slo_status{objective="liveness"} 2' in text
+    finally:
+        wt.stop()
+
+
+def test_background_thread_ticks(tmp_path):
+    import time
+
+    src = FakeSource()
+    src.values = {"service.workers": 1}
+    wt = Watchtower(str(tmp_path), objectives=[], interval_s=0.05,
+                    source=src)
+    wt.start()
+    try:
+        deadline = time.time() + 5.0
+        while wt.ticks < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert wt.ticks >= 2
+        assert wt.overhead_pct() >= 0.0
+    finally:
+        wt.stop()
+    assert not wt.running
+
+
+def test_default_objectives_worker_liveness_gated():
+    names = {o.name for o in default_objectives(workers=1)}
+    assert "ttfe_p95" in names and "error_rate" in names
+    assert "worker_liveness" not in names
+    pool = {o.name for o in default_objectives(workers=4)}
+    assert "worker_liveness" in pool
+    liveness = next(o for o in default_objectives(workers=4)
+                    if o.name == "worker_liveness")
+    assert liveness.target == 4.0 and liveness.op == ">="
+
+
+# -- --slo FILE parsing ---------------------------------------------------
+
+
+def test_load_slo_file_json_and_options(tmp_path):
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({
+        "interval_s": 2.5,
+        "capture": {"profile": False},
+        "objectives": [
+            {"name": "ttfe_p95", "kind": "quantile",
+             "metric": "service.ttfe_s", "target": 2.0, "q": 0.95},
+        ],
+    }))
+    objectives, options = load_slo_file(str(path))
+    assert len(objectives) == 1
+    assert objectives[0].name == "ttfe_p95"
+    assert objectives[0].q == 0.95
+    assert options["interval_s"] == 2.5
+    assert options["capture"] == {"profile": False}
+
+
+def test_load_slo_file_yaml(tmp_path):
+    pytest.importorskip("yaml")
+    path = tmp_path / "slo.yaml"
+    path.write_text(
+        "interval_s: 1.0\n"
+        "objectives:\n"
+        "  - name: error_rate\n"
+        "    kind: ratio\n"
+        "    metric: service.request_errors\n"
+        "    denominator: service.requests\n"
+        "    target: 0.05\n"
+    )
+    objectives, options = load_slo_file(str(path))
+    assert objectives[0].kind == "ratio"
+    assert objectives[0].denominator == "service.requests"
+
+
+@pytest.mark.parametrize("doc,msg", [
+    ([], "mapping"),
+    ({"objectives": []}, "required"),
+    ({"objectives": [{"name": "x", "kind": "quantile",
+                      "metric": "m", "target": 1, "bogus": 2}]},
+     "unknown keys"),
+    ({"objectives": [{"name": "x", "kind": "quantile"}]}, "missing"),
+    ({"objectives": [{"name": "x", "kind": "nope",
+                      "metric": "m", "target": 1}]}, "bad kind"),
+])
+def test_load_slo_file_rejects_bad_config(tmp_path, doc, msg):
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match=msg):
+        load_slo_file(str(path))

@@ -311,3 +311,65 @@ def test_flight_recorder_bundle_lists_active_requests(
     finally:
         release.set()
         service.stop(drain=True, timeout=60)
+
+
+def test_request_log_rotates_at_size_cap(tmp_path):
+    """The request log rolls FILE -> FILE.1 -> ... at the byte budget,
+    keeps every line across the seam, and counts the rollovers."""
+    from mythril_tpu.observability.metrics import get_registry
+    from mythril_tpu.service.request import AnalysisRequest
+    from mythril_tpu.service.telemetry import RequestTelemetry
+
+    reg = get_registry()
+    reg.reset(include_persistent=True, prefix="service.request_log")
+    log_path = tmp_path / "requests.jsonl"
+    # a few hundred bytes: every couple of lines trips the cap
+    tel = RequestTelemetry(request_log=str(log_path),
+                           request_log_max_bytes=600)
+    n = 12
+    try:
+        for i in range(n):
+            req = AnalysisRequest(
+                request_id=f"r-{i:02d}", name="t", code=b"\x00",
+                codehash="h", options=OPTS,
+            )
+            tel.request_started(req)
+            tel.request_finished(req, "done")
+    finally:
+        tel.close()
+
+    rotations = reg.counter(
+        "service.request_log_rotations", persistent=True).value
+    assert rotations >= 2
+    backups = sorted(tmp_path.glob("requests.jsonl.*"))
+    assert backups, "no rotated backup files"
+    assert len(backups) <= RequestTelemetry.LOG_BACKUPS
+    # no line lost across rotation seams (ring-capped at LOG_BACKUPS)
+    ids = []
+    for path in [log_path, *backups]:
+        for line in path.read_text().splitlines():
+            ids.append(json.loads(line)["request_id"])
+    assert len(ids) == len(set(ids))
+    assert set(ids) <= {f"r-{i:02d}" for i in range(n)}
+    assert f"r-{n - 1:02d}" in ids  # the newest line survived
+    reg.reset(include_persistent=True, prefix="service.request_log")
+
+
+def test_request_log_unrotated_without_cap(tmp_path):
+    from mythril_tpu.service.request import AnalysisRequest
+    from mythril_tpu.service.telemetry import RequestTelemetry
+
+    log_path = tmp_path / "requests.jsonl"
+    tel = RequestTelemetry(request_log=str(log_path))  # cap disabled
+    try:
+        for i in range(5):
+            req = AnalysisRequest(
+                request_id=f"r-{i}", name="t", code=b"\x00",
+                codehash="h", options=OPTS,
+            )
+            tel.request_started(req)
+            tel.request_finished(req, "done")
+    finally:
+        tel.close()
+    assert len(log_path.read_text().splitlines()) == 5
+    assert not list(tmp_path.glob("requests.jsonl.*"))

@@ -1,0 +1,174 @@
+"""Watchtower wired into the daemon: breach drill over the real service.
+
+One inline daemon (frontier off, warmup off) with a tight TTFE budget
+and the admission fault hook armed — the injected stall must flow
+through the service TTFE clock into a breach, the health surfaces
+(``health()``, ``stats()``, the ``health`` protocol verb, Prometheus,
+``format_health``) must all report it, and a clean daemon with honest
+targets must stay green."""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.service import (
+    AnalysisOptions,
+    AnalysisService,
+    ServiceConfig,
+)
+
+REPO = Path(__file__).resolve().parents[2]
+KILL_SIMPLE_HEX = (
+    REPO / "tests" / "testdata" / "inputs" / "kill_simple.bin-runtime"
+).read_text().strip()
+
+OPTS = AnalysisOptions(transaction_count=1, execution_timeout=30)
+
+
+def _slo_file(tmp_path, target_s: float) -> str:
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({
+        "capture": {"profile": False},
+        "objectives": [
+            {"name": "ttfe_p95", "kind": "quantile",
+             "metric": "service.ttfe_s", "q": 0.95, "target": target_s,
+             "fast_window_s": 10, "slow_window_s": 30, "min_count": 1},
+        ],
+    }))
+    return str(path)
+
+
+def _config(tmp_path, slo, **overrides):
+    base = dict(
+        default_options=OPTS,
+        max_batch_width=2,
+        batch_window_s=0.1,
+        frontier=False,
+        probe=False,
+        warmup=False,
+        cache_root=str(tmp_path / "cache"),
+        watchtower=True,
+        watchtower_interval_s=0.2,
+        slo_file=slo,
+    )
+    base.update(overrides)
+    return ServiceConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo_metrics():
+    # Reset service.* too: a young daemon's fast window starts before its
+    # first history sample, so the window delta falls back to the lifetime
+    # histogram — which in a full-suite run carries every prior service
+    # test's TTFE observations and would drown the injected stall.
+    from mythril_tpu.observability.metrics import get_registry
+
+    get_registry().reset(include_persistent=True, prefix="slo.")
+    get_registry().reset(include_persistent=True, prefix="service.")
+    yield
+    get_registry().reset(include_persistent=True, prefix="slo.")
+
+
+def test_injected_stall_breaches_ttfe(scoped_args, tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_INJECT_ADMISSION_SLEEP", "0.6")
+    service = AnalysisService(
+        _config(tmp_path, _slo_file(tmp_path, target_s=0.05))
+    ).start()
+    try:
+        _req, stream, _ = service.submit(
+            KILL_SIMPLE_HEX, name="kill", tier="interactive"
+        )
+        assert list(stream.events(timeout=120))[-1][0] == "done"
+
+        health = {}
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            health = service.health()
+            if not health.get("ok"):
+                break
+            time.sleep(0.1)
+        assert health["enabled"] is True
+        assert health["ok"] is False
+        assert "ttfe_p95" in health["breaching"]
+        assert health["breaches_total"] >= 1
+        (ev,) = [e for e in health["objectives"]
+                 if e["name"] == "ttfe_p95"]
+        # the stall happened BEFORE dispatch: it must land in TTFE
+        assert ev["value"] >= 0.6
+        assert ev["state"] == "breach"
+
+        # every surface reports the same verdict
+        assert service.stats()["health"]["ok"] is False
+        from mythril_tpu.observability.metrics import prometheus_text
+
+        text = prometheus_text()
+        assert 'slo_status{objective="ttfe_p95"} 2' in text
+        assert any(
+            line.startswith("slo_breaches_total")
+            and float(line.rsplit(" ", 1)[1]) >= 1
+            for line in text.splitlines()
+        )
+        from mythril_tpu.service.top import format_health, format_top
+
+        rendered = format_health(health, address="test:0")
+        assert "BREACH" in rendered and "ttfe_p95" in rendered
+        assert "!! SLO BREACH: ttfe_p95" in format_top(
+            service.stats(), address="test:0")
+    finally:
+        service.stop(drain=True, timeout=60)
+
+    # the watchtower was torn down with the daemon...
+    from mythril_tpu.observability.watchtower import get_watchtower
+
+    assert get_watchtower() is None
+    # ...but the history ring survives under --cache-root
+    from mythril_tpu.observability.history import HistoryReader
+
+    reader = HistoryReader(str(tmp_path / "cache" / "history"))
+    assert reader.segments()
+    assert reader.series("service.requests")
+
+
+def test_clean_daemon_stays_green(scoped_args, tmp_path):
+    service = AnalysisService(
+        _config(tmp_path, _slo_file(tmp_path, target_s=60.0))
+    ).start()
+    try:
+        _req, stream, _ = service.submit(
+            KILL_SIMPLE_HEX, name="kill", tier="interactive"
+        )
+        assert list(stream.events(timeout=120))[-1][0] == "done"
+        time.sleep(0.5)  # at least two evaluation ticks
+        health = service.health()
+        assert health["enabled"] is True
+        assert health["ok"] is True
+        assert health["breaches_total"] == 0
+        from mythril_tpu.service.top import format_top
+
+        top = format_top(service.stats(), address="test:0")
+        assert "slo: ok (1 objective" in top
+        assert "BREACH" not in top
+        # jsonv2 meta.health rides the same evaluation
+        from mythril_tpu.observability.watchtower import health_meta
+
+        meta = health_meta()
+        assert meta["enabled"] and meta["ok"]
+    finally:
+        service.stop(drain=True, timeout=60)
+
+
+def test_watchtower_disabled_health_shape(scoped_args, tmp_path):
+    service = AnalysisService(ServiceConfig(
+        default_options=OPTS, frontier=False, probe=False, warmup=False,
+    )).start()
+    try:
+        health = service.health()
+        assert health == {"enabled": False, "ok": None, "objectives": []}
+        assert "health" not in service.stats()
+        from mythril_tpu.service.top import format_health
+
+        assert "disabled" in format_health(health)
+    finally:
+        service.stop(drain=True, timeout=60)
