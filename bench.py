@@ -685,6 +685,14 @@ def mesh_compare() -> dict:
 
 _HARVEST_PHASES = ("ingest", "solver", "replay", "commit")
 
+# device-plane counters delta'd around every bench rep (persistent=True:
+# they survive the per-analysis registry reset between runs)
+_DEVICE_PLANE_COUNTERS = (
+    "device.compile_wall_s_total",
+    "device.recompiles_total",
+    "device.shape_churn_total",
+)
+
 
 def harvest_compare() -> dict:
     """Sharded vs serial harvest on a multi-tx and a fork-heavy workload.
@@ -1827,6 +1835,13 @@ def _new_row_data():
         # accumulated per-tag [hits, misses] deltas of the persistent XLA
         # compile cache — did this workload's programs come off disk?
         "compilecache": {"baseline": [0, 0], "production": [0, 0]},
+        # first-rep XLA compile wall per tag (device plane), split OUT of
+        # the rep-0 timed window so steady-state speedups stop absorbing
+        # warmup noise; None until the device plane observes a compile
+        "compile_s": {"baseline": None, "production": None},
+        # accumulated production-run device-plane deltas:
+        # [compile_wall_s, recompiles, shape_churn]
+        "device": [0.0, 0, 0],
         "completed_reps": 0,
         "trimmed_reps": [],  # rep numbers the budget clock dropped
     }
@@ -1937,6 +1952,40 @@ def _row_summary(unit: str, d: dict) -> dict:
             tag: {"hits": int(v[0]), "misses": int(v[1])}
             for tag, v in d.get("compilecache", {}).items()
         },
+        # first-rep XLA compile wall (seconds) per tag — already excluded
+        # from that rep's timed window, quoted here so warmup cost stays
+        # visible instead of silently vanishing from the table
+        **(
+            {
+                "compile_s": {
+                    tag: round(v, 3)
+                    for tag, v in d.get("compile_s", {}).items()
+                    if v is not None
+                }
+            }
+            if any(
+                v is not None for v in d.get("compile_s", {}).values()
+            )
+            else {}
+        ),
+        # device-plane deltas over the workload's production runs: total
+        # XLA compile wall + same-bucket recompiles (each one is a lost
+        # compile-cache bet) + distinct-shape churn
+        **(
+            {
+                "device": {
+                    "compile_wall_s": round(d["device"][0], 3),
+                    "recompiles": int(d["device"][1]),
+                    **(
+                        {"shape_churn": int(d["device"][2])}
+                        if d["device"][2]
+                        else {}
+                    ),
+                }
+            }
+            if d.get("device") and (d["device"][0] or d["device"][1])
+            else {}
+        ),
         "harvest_share_pct": (
             round(100 * _median(d["harvest_shares"]), 1)
             if d["harvest_shares"]
@@ -2395,6 +2444,39 @@ def regression_gate(
             f"{fleet_overhead['flush_rate_hz']}Hz)"
         )
 
+    # on failure, run the drift doctor over the same pair so the gate
+    # names the most-moved phase/counter per violating workload instead
+    # of only the breached threshold — the "what moved" next to the
+    # "what broke".  Attribution is advisory: a doctor error never
+    # changes the gate verdict.
+    drift = None
+    if violations:
+        try:
+            from mythril_tpu.observability.drift import (
+                attribute,
+                diff_tables,
+            )
+
+            d_report = diff_tables(
+                prior, current_table,
+                prior_name=against_path, current_name="current",
+            )
+            violators = []
+            for v in violations:
+                w = v.split(":", 1)[0]
+                if w in common and w not in violators:
+                    violators.append(w)
+            drift = {
+                "headline": d_report.get("headline"),
+                "attribution": (
+                    [attribute(d_report, workload=w) for w in violators]
+                    if violators
+                    else [attribute(d_report)]
+                ),
+            }
+        except Exception as exc:  # advisory only — never mask the verdict
+            drift = {"error": f"{type(exc).__name__}: {exc}"}
+
     report = {
         "gate": {
             "against": against_path,
@@ -2406,13 +2488,17 @@ def regression_gate(
             "fleet_export_overhead": fleet_overhead,
             "tracing_overhead_budget_pct": GATE_TRACING_BUDGET_PCT,
             "pass": not violations,
+            **({"drift": drift} if drift else {}),
         }
     }
     print(json.dumps(report), flush=True)
     if violations:
+        lines = list(violations)
+        if drift and drift.get("attribution"):
+            lines += drift["attribution"]
         print(
             "[bench] regression gate FAILED vs %s:\n  %s"
-            % (against_path, "\n  ".join(violations)),
+            % (against_path, "\n  ".join(lines)),
             file=sys.stderr,
         )
         return 1
@@ -2633,6 +2719,10 @@ def main() -> None:
                         "compilecache.misses", persistent=True
                     ).value,
                 )
+                dp_before = tuple(
+                    get_registry().counter(k, persistent=True).value
+                    for k in _DEVICE_PLANE_COUNTERS
+                )
                 try:
                     out = fn(production)
                 except WorkloadSkip as exc:
@@ -2652,6 +2742,25 @@ def main() -> None:
                     ).value - cc_before[1]
                 )
                 work, wall, ttfe = out[:3]
+                # device plane: per-rep XLA compile wall / recompile /
+                # shape-churn deltas attributed to this workload's run
+                dp_compile, dp_rcmp, dp_churn = (
+                    get_registry().counter(k, persistent=True).value - b
+                    for k, b in zip(_DEVICE_PLANE_COUNTERS, dp_before)
+                )
+                if production:
+                    d["device"][0] += dp_compile
+                    d["device"][1] += dp_rcmp
+                    d["device"][2] += dp_churn
+                if rep == 0 and d["compile_s"][tag] is None:
+                    # split the first rep's compile wall out of the timed
+                    # window — steady-state reps never pay it, so leaving
+                    # it in made rep-0 rates read as phantom regressions.
+                    # Guard: background precompiles can overlap the wall,
+                    # so never let the adjustment eat >95% of it.
+                    d["compile_s"][tag] = dp_compile
+                    if dp_compile > 0 and wall - dp_compile > 0.05 * wall:
+                        wall -= dp_compile
                 d["samples"][tag].append(work / wall if wall > 0 else 0.0)
                 if ttfe == ttfe:  # not NaN
                     d["ttfes"][tag].append(ttfe)

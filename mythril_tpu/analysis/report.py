@@ -241,6 +241,7 @@ class Report:
         # execution-info rollups
         from mythril_tpu.observability import observability_meta
 
+        from mythril_tpu.observability.deviceplane import device_meta
         from mythril_tpu.observability.exploration import exploration_meta
         from mythril_tpu.observability.watchtower import health_meta
 
@@ -248,6 +249,7 @@ class Report:
         meta["prefilter"] = _prefilter_meta()
         meta["exploration"] = exploration_meta()
         meta["health"] = health_meta()
+        meta["device"] = device_meta()
         result = [
             {
                 "issues": sorted(_issues, key=lambda k: k["swcID"]),

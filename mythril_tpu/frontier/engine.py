@@ -62,6 +62,7 @@ from mythril_tpu.frontier.harvest import HarvestExecutor
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
 from mythril_tpu.frontier.state import Caps, FrontierState, clear_slot, empty_state
 from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability import deviceplane as _devplane
 from mythril_tpu.observability import flightrecorder as _frec
 from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.observability.metrics import get_registry as _get_metrics
@@ -780,8 +781,10 @@ class FrontierEngine:
         code_cap, instr_cap, addr_cap, loops_cap = bucket
         program_key = (caps, bucket)
         program_warm = program_key in _WARM_PROGRAMS
+        _devplane.install()
         with _otrace.span("frontier.compile", cat="frontier",
-                          warm=program_warm, bucket=list(bucket)):
+                          warm=program_warm, bucket=list(bucket)), \
+                _devplane.dispatch_scope(bucket):
             # builds (or returns) the jitted program; the XLA compile
             # itself is paid inside the first dispatch's segment span
             # (warm=False marks it)
@@ -981,14 +984,17 @@ class FrontierEngine:
             # donates nothing (_SEGMENT_DONATE_ARGNUMS is empty).
             def _precompile_floored():
                 t0 = time.perf_counter()
-                try:
-                    out = segment(
-                        push_state(empty_state(caps, loops_cap)), dev_arena,
-                        arena_len, visited, code_dev, cfg,
-                    )
-                    np.asarray(out[3])  # force completion
-                except Exception as e:  # pragma: no cover - diagnostics
-                    log.debug("floored-bucket precompile failed: %s", e)
+                # the compile happens on THIS daemon thread: scope it so
+                # the device plane attributes it to the floored bucket
+                with _devplane.dispatch_scope(bucket):
+                    try:
+                        out = segment(
+                            push_state(empty_state(caps, loops_cap)),
+                            dev_arena, arena_len, visited, code_dev, cfg,
+                        )
+                        np.asarray(out[3])  # force completion
+                    except Exception as e:  # pragma: no cover - diagnostics
+                        log.debug("floored-bucket precompile failed: %s", e)
                 _get_metrics().observe(
                     "frontier.bucket_compile_s", time.perf_counter() - t0
                 )
@@ -1019,7 +1025,8 @@ class FrontierEngine:
                     {"requests": ",".join(self.request_tags)}
                     if self.request_tags else {}
                 ),
-            ), _otrace.device_annotation("frontier.segment"):
+            ), _otrace.device_annotation("frontier.segment"), \
+                    _devplane.dispatch_scope(natural_bucket):
                 if _fid0 is not None:
                     _otrace.get_tracer().flow("s", _fid0, "flow.segment",
                                               cat="device")
@@ -1040,8 +1047,21 @@ class FrontierEngine:
             seg_only = time.perf_counter() - t_seg
             stats.segment_s += seg_only
             _get_metrics().observe("frontier.segment_wall_s", seg_only)
+            _devplane.observe_segment(
+                seg_only, _devplane.bucket_tag(natural_bucket)
+            )
             _get_metrics().counter("frontier.opening_dispatches").inc()
             _WARM_PROGRAMS.add((caps, natural_bucket))
+            _devplane.harvest_analysis(
+                nat_segment,
+                lambda st_nat=st_nat, dev_arena=dev_arena,
+                arena_len=arena_len, nat_visited=nat_visited,
+                nat_code_dev=nat_code_dev, cfg0=cfg0: (
+                    push_state(st_nat), dev_arena, arena_len, nat_visited,
+                    nat_code_dev, cfg0,
+                ),
+                _devplane.bucket_tag(natural_bucket),
+            )
             st = st_p._replace(loops=np.ascontiguousarray(np.pad(
                 st_p.loops, ((0, 0), (0, loops_cap - nat_lc))
             )))
@@ -1142,7 +1162,8 @@ class FrontierEngine:
                     {"requests": ",".join(self.request_tags)}
                     if self.request_tags else {}
                 ),
-            ), _otrace.device_annotation("frontier.segment"):
+            ), _otrace.device_annotation("frontier.segment"), \
+                    _devplane.dispatch_scope(bucket):
                 if _fid is not None:
                     _otrace.get_tracer().flow("s", _fid, "flow.segment",
                                               cat="device")
@@ -1174,7 +1195,19 @@ class FrontierEngine:
                 deadline += time.perf_counter() - t_mb
             stats.segment_s += seg_only
             _get_metrics().observe("frontier.segment_wall_s", seg_only)
+            _devplane.observe_segment(seg_only, _devplane.bucket_tag(bucket))
             _WARM_PROGRAMS.add(program_key)  # a segment really dispatched
+            # compiled + persistently cached by the dispatch above: harvest
+            # cost/memory analysis once per executable, off-thread
+            _devplane.harvest_analysis(
+                segment,
+                lambda st_dev=st_dev, dev_arena=dev_arena,
+                arena_len=arena_len, visited=visited, code_dev=code_dev,
+                cfg=cfg: (
+                    st_dev, dev_arena, arena_len, visited, code_dev, cfg
+                ),
+                _devplane.bucket_tag(bucket),
+            )
 
             t_har = time.perf_counter()
             with _otrace.span("frontier.harvest", cat="frontier",

@@ -64,6 +64,7 @@ from mythril_tpu.frontier import ops as O
 from mythril_tpu.frontier.records import PathRecord
 from mythril_tpu.frontier.state import FrontierState, clear_slot
 from mythril_tpu.frontier.stats import FrontierStatistics
+from mythril_tpu.observability import deviceplane as _devplane
 from mythril_tpu.observability import flightrecorder as _frec
 from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.observability.heartbeat import get_heartbeat
@@ -737,6 +738,14 @@ class PipelinedRunner:
                      out_path=getattr(args, "heartbeat_out", None))
             hb_started = True
 
+        # device plane: tag this thread's dispatches/pulls (and any XLA
+        # compile they trigger) with the program's bucket shape for the
+        # duration of the run; restored in the finally below
+        _devplane.install()
+        _bucket_tag = _devplane.bucket_tag(self.program_key[1])
+        _dscope = _devplane.dispatch_scope(_bucket_tag)
+        _dscope.__enter__()
+
         t0 = time.perf_counter()
         inflight, full_args = self._dispatch_full()
         inflight_sid = self._last_dispatch_sid
@@ -826,10 +835,16 @@ class PipelinedRunner:
                 seg_equiv = dispatch_wall + bubble
                 stats.segment_s += seg_equiv
                 reg.observe("frontier.segment_wall_s", seg_equiv)
+                _devplane.observe_segment(seg_equiv, _bucket_tag)
                 reg.counter("pipeline.bubble_s").inc(bubble)
                 if nxt is not None:
                     reg.counter("pipeline.overlap_segments").inc()
                 _eng._WARM_PROGRAMS.add(self.program_key)
+                # the executable is compiled and persistently cached now:
+                # harvest its cost/memory analysis once, off-thread
+                _devplane.harvest_analysis(
+                    self.segment, lambda: full_args, _bucket_tag
+                )
 
                 if micro_pending and n_exec_host > 0:
                     t_mb = time.perf_counter()
@@ -969,6 +984,7 @@ class PipelinedRunner:
                 dispatch_wall = time.perf_counter() - t0
                 self.arena.freeze()
         finally:
+            _dscope.__exit__(None, None, None)
             watch.__exit__(None, None, None)
             self.arena.thaw()
             self.walker.park_sink = None

@@ -21,6 +21,7 @@ and XLA fuses the lot into one kernel per step.
 
 from __future__ import annotations
 
+import time
 from functools import lru_cache, partial
 from typing import NamedTuple
 
@@ -37,6 +38,7 @@ from mythril_tpu.frontier.code import (
     CodeTables,
 )
 from mythril_tpu.frontier.state import Caps, FrontierState
+from mythril_tpu.observability import deviceplane as _devplane
 from mythril_tpu.observability import tracer as _otrace
 from mythril_tpu.ops import bitvec as bv
 
@@ -1307,6 +1309,20 @@ def _bucketed(n: int, full: int) -> int:
 
 def pull_harvest(state: FrontierState, arena_len, n_exec, max_live,
                  prev: FrontierState = None, shards: int = 1):
+    """Timed wrapper over :func:`_pull_harvest_impl` — this is the
+    frontier's blocking device->host point, so its wall is stamped into
+    the device plane's ``frontier.pull_device_s`` series (attributed to
+    the dispatching bucket via the caller's dispatch scope)."""
+    t0 = time.perf_counter()
+    try:
+        return _pull_harvest_impl(state, arena_len, n_exec, max_live,
+                                  prev=prev, shards=shards)
+    finally:
+        _devplane.observe_pull(time.perf_counter() - t0)
+
+
+def _pull_harvest_impl(state: FrontierState, arena_len, n_exec, max_live,
+                       prev: FrontierState = None, shards: int = 1):
     """Device->host harvest transfer.
 
     ``prev=None`` (synchronous loop, sync points, mesh): ONE packed pull of

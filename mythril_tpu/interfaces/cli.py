@@ -595,6 +595,35 @@ def create_parser() -> argparse.ArgumentParser:
     )
     _add_verbosity(history)
 
+    drift = subparsers.add_parser(
+        "drift", help="rank perf movement between two bench artifacts "
+        "(or two history-ring windows) and name the most-moved "
+        "phase/counter",
+    )
+    drift.add_argument(
+        "artifacts", nargs="*", metavar="BENCH.json",
+        help="two bench artifacts: PRIOR CURRENT (any bench.py-readable "
+        "format; omit when using --history)",
+    )
+    drift.add_argument(
+        "--history", dest="drift_history", metavar="DIR",
+        help="compare the last --window seconds of a metrics history "
+        "ring against the window before it",
+    )
+    drift.add_argument(
+        "--window", type=float, default=300.0, metavar="SECONDS",
+        help="history window length in seconds (default: 300)",
+    )
+    drift.add_argument(
+        "--limit", type=int, default=15, metavar="N",
+        help="ranked findings to print (default: 15)",
+    )
+    drift.add_argument(
+        "-o", "--outform", choices=["text", "json"], default="text",
+        help="output format",
+    )
+    _add_verbosity(drift)
+
     subparsers.add_parser("version", help="print version")
     subparsers.add_parser("help", help="print help")
     return parser
@@ -1090,6 +1119,45 @@ def execute_command(parsed) -> None:
             if names and not values:
                 continue
             print(json.dumps({"t": t, **values}), flush=True)
+        return
+
+    if command == "drift":
+        from mythril_tpu.observability.drift import (
+            diff_history_windows,
+            diff_tables,
+            format_drift,
+            load_bench_table,
+        )
+
+        if getattr(parsed, "drift_history", None):
+            from mythril_tpu.observability.history import HistoryReader
+
+            reader = HistoryReader(parsed.drift_history)
+            samples = list(reader.samples())
+            report = diff_history_windows(
+                samples, parsed.window, bounds=reader.bucket_bounds
+            )
+        else:
+            if len(parsed.artifacts) != 2:
+                raise CriticalError(
+                    "drift needs two bench artifacts (PRIOR CURRENT) "
+                    "or --history DIR"
+                )
+            prior_path, current_path = parsed.artifacts
+            prior = load_bench_table(prior_path)
+            current = load_bench_table(current_path)
+            if not prior or not current:
+                raise CriticalError(
+                    "no workload table recoverable from "
+                    + (prior_path if not prior else current_path)
+                )
+            report = diff_tables(prior, current,
+                                 prior_name=prior_path,
+                                 current_name=current_path)
+        if parsed.outform == "json":
+            print(json.dumps(report, indent=2, sort_keys=True), flush=True)
+        else:
+            print(format_drift(report, limit=parsed.limit), flush=True)
         return
 
     if command == "analyze":

@@ -22,6 +22,17 @@ The convenience re-exports below are the recommended import surface::
         ...
 """
 
+from mythril_tpu.observability.deviceplane import (  # noqa: F401
+    bucket_tag,
+    device_meta,
+    dispatch_scope,
+    install_deviceplane,
+)
+from mythril_tpu.observability.drift import (  # noqa: F401
+    diff_history_windows,
+    diff_tables,
+    format_drift,
+)
 from mythril_tpu.observability.exploration import (  # noqa: F401
     TERM_CLASSES,
     ExplorationLedger,

@@ -425,6 +425,22 @@ class FleetAggregator:
                     "killed": killed,
                     "kill_rate": round(killed / evaluated, 4),
                 }
+            # device-plane series flow through the fabric like any other
+            # metric; summarize the worker's XLA-facing totals for top
+            compile_s = ws.counters.get("device.compile_wall_s_total", 0)
+            recompiles = ws.counters.get("device.recompiles_total", 0)
+            hbm = ws.gauges.get("device.hbm_bytes")
+            if compile_s or recompiles or hbm:
+                device: Dict[str, Any] = {
+                    "compile_s": round(float(compile_s), 3),
+                    "recompiles": int(recompiles),
+                }
+                if isinstance(hbm, dict) and hbm:
+                    device["hbm_bytes"] = max(
+                        v for v in hbm.values()
+                        if isinstance(v, (int, float))
+                    )
+                out["device"] = device
             return out
 
     def summary(self) -> Dict[str, Any]:

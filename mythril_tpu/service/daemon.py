@@ -601,7 +601,7 @@ class AnalysisService:
             for row in rows:
                 row["active_rids"] = active.get(row["id"], [])
                 fleet = self.fleet.worker_summary(row["id"])
-                for key in ("phase_s", "prefilter", "flushes",
+                for key in ("phase_s", "prefilter", "device", "flushes",
                             "flush_age_s"):
                     if key in fleet:
                         row[key] = fleet[key]
@@ -659,6 +659,7 @@ class AnalysisService:
             if requests else 0.0,
         }
         out["workers"] = self.worker_stats()
+        out["device"] = self._device_stats()
         policy = self.config.scheduler_policy()
         if policy is not None:
             out["scheduler"] = {
@@ -684,6 +685,48 @@ class AnalysisService:
         if self.pooled:
             out["fleet"] = self.fleet.summary()
         return out
+
+    def _device_stats(self) -> Dict[str, Any]:
+        """The stats payload's ``device`` block.
+
+        Inline mode reads the local registry (the engine runs in this
+        process); pooled mode folds the fleet rollup, where the workers'
+        ``device.*`` series land via the fabric.
+        """
+        from mythril_tpu.observability.deviceplane import device_meta
+
+        if not self.pooled:
+            return device_meta()
+        with self.fleet._lock:
+            roll = self.fleet._rollup
+            out: Dict[str, Any] = {
+                "enabled": True,
+                "scope": "fleet",
+                "compile_wall_s": round(float(
+                    roll.counters.get("device.compile_wall_s_total", 0)), 3),
+                "recompiles": int(
+                    roll.counters.get("device.recompiles_total", 0)),
+                "shape_churn": int(
+                    roll.counters.get("device.shape_churn_total", 0)),
+                "cache": {
+                    "hits": int(roll.counters.get("device.cache_hits", 0)),
+                    "misses": int(
+                        roll.counters.get("device.cache_misses", 0)),
+                },
+            }
+            by_bucket = roll.labeled.get("device.compile_wall_s_by_bucket")
+            if by_bucket:
+                out["compile_wall_s_by_bucket"] = {
+                    k: round(float(v), 3)
+                    for k, v in sorted(by_bucket.items())
+                }
+            hbm = roll.gauges.get("device.hbm_bytes")
+            if isinstance(hbm, dict) and hbm:
+                out["hbm_bytes"] = dict(hbm)
+            flops = roll.gauges.get("device.flops_per_segment")
+            if isinstance(flops, dict) and flops:
+                out["flops_per_segment"] = dict(flops)
+            return out
 
     def fleet_prometheus_text(self) -> str:
         """Worker-labeled ``fleet_*`` exposition ("" when not pooled)."""

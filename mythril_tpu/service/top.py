@@ -79,6 +79,23 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         )
     )
 
+    device = stats.get("device") or {}
+    if device.get("compile_wall_s") or device.get("recompiles"):
+        cache_d = device.get("cache") or {}
+        line = "device: compile {c:.1f}s  cache {h}h/{m}m  recompiles {r}".format(
+            c=device.get("compile_wall_s", 0.0),
+            h=cache_d.get("hits", 0), m=cache_d.get("misses", 0),
+            r=device.get("recompiles", 0),
+        )
+        if device.get("shape_churn"):
+            line += f"  shape-churn {device['shape_churn']}"
+        hbm = device.get("hbm_bytes") or {}
+        if hbm:
+            line += "  hbm {:.1f}MB".format(
+                max(hbm.values()) / 1e6 if isinstance(hbm, dict) else 0.0
+            )
+        lines.append(line)
+
     workers = stats.get("workers") or []
     if workers:
         states = [w.get("state", "?") for w in workers]
@@ -97,20 +114,27 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         if not (len(workers) == 1 and workers[0].get("state") == "inline"):
             lines.append(f"{'worker':<8}{'pid':>8} {'state':<10}"
                          f"{'batches':>9}{'restarts':>10}{'age':>9}"
-                         f"{'exec p50':>10}{'kill%':>7}  rids")
+                         f"{'exec p50':>10}{'kill%':>7}"
+                         f"{'compile':>9}{'rcmp':>6}  rids")
             for w in workers:
                 exec_p50 = ((w.get("phase_s") or {}).get("execute")
                             or {}).get("p50_s")
                 pf = w.get("prefilter") or {}
                 kill = (f"{pf['kill_rate'] * 100:.0f}%"
                         if pf.get("evaluated") else "-")
+                dev = w.get("device") or {}
+                compile_s = (_ms(dev["compile_s"])
+                             if dev.get("compile_s") else "-")
+                rcmp = (str(dev.get("recompiles", 0))
+                        if dev else "-")
                 rids = ",".join(w.get("active_rids") or []) or "-"
                 lines.append(
                     f"w{w.get('id', '?'):<7}{str(w.get('pid', '-')):>8} "
                     f"{w.get('state', '?'):<10}{w.get('batches', 0):>9}"
                     f"{w.get('restarts', 0):>10}"
                     f"{_ms(w.get('age_s')) if w.get('age_s') else '-':>9}"
-                    f"{_ms(exec_p50):>10}{kill:>7}  {rids}"
+                    f"{_ms(exec_p50):>10}{kill:>7}"
+                    f"{compile_s:>9}{rcmp:>6}  {rids}"
                 )
 
     prefilter = stats.get("prefilter") or {}
