@@ -534,6 +534,147 @@ def prefilter_compare() -> dict:
     return {"metric": "prefilter_compare", "workloads": results}
 
 
+def devsolver_compare() -> dict:
+    """Device SAT tier on-vs-off parity on the exploit workloads.
+
+    Runs each workload twice with the pipelined device frontier forced on
+    — once with the devsolver tier enabled, once with ``--no-devsolver``
+    semantics — and asserts the soundness-by-construction contract: the
+    issue sets are IDENTICAL while the gated run *decided* (exact UNSAT
+    or concrete_eval-validated SAT) a nonzero number of queries that the
+    ungated run sent to the exact tiers, with zero model-validation
+    failures surviving as verdicts and no harvest-solver regression.
+    Mirrors ``prefilter_compare``; one JSON-able dict per run.
+    """
+    from mythril_tpu import absdomain, devsolver
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.frontier import engine as _eng
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.support.support_args import args as global_args
+
+    def issue_set(issues):
+        return sorted((i.swc_id, i.address) for i in issues)
+
+    suicide = bytes.fromhex("60003560e01c6341c0e1b51460145760006000fd5b33ff")
+    gated = bytes.fromhex(
+        "60003580600a9010600c57005b80600514601c5780601414601c57005b33ff"
+    )
+    # x=cld(0); y=cld(32); require x<16, y<16, x==y; the selfdestruct is
+    # guarded by ((x^y)&15)==15 — a RELATIONAL contradiction the interval
+    # and known-bits prefilter cannot see (neither var is pinned to a
+    # point) but bit-level search refutes after one decision; without the
+    # device tier it rides all the way to native CDCL
+    relational = bytes.fromhex(
+        "6000358060109010600c57005b"
+        "6020358060109010601957005b"
+        "80821460215700" "5b"
+        "18600f16600f14602d57005b"
+        "33ff"
+    )
+    workloads = [
+        # same exploit workloads as prefilter_compare ("gated" carries
+        # narrow range-pinned branch conditions, killbilly exercises the
+        # wide fallthrough side) plus the devsolver's signature prey: a
+        # relational infeasibility with an EMPTY issue set (swc None)
+        ("suicide", suicide, 1, ["AccidentallyKillable"], "106"),
+        ("gated", gated, 1, ["AccidentallyKillable"], "106"),
+        ("relational", relational, 1, ["AccidentallyKillable"], None),
+        ("killbilly",
+         EVMContract(code=KILLBILLY, creation_code=KILLBILLY_CREATION,
+                     name="KillBilly"),
+         3, None, "106"),
+    ]
+
+    def one_run(target, txs, modules, gated_on: bool):
+        global_args.devsolver = gated_on
+        _clear_caches()
+        absdomain.reset_state()
+        devsolver.reset_state()  # verdict memo must not leak across modes
+        _eng._SLOW_CODES.clear()
+        _eng._NARROW_CODES.clear()
+        _eng._SLOW_SEGMENTS.clear()
+        reg = get_registry()
+        reg.reset(prefix="devsolver.")
+        solver_before = reg.histogram("frontier.harvest.solver_s").sum
+        t0 = time.time()
+        _, issues = _analyze(target, 0x0901D12E, txs, modules=modules,
+                             timeout=300)
+        wall = time.time() - t0
+        solver_s = reg.histogram("frontier.harvest.solver_s").sum - solver_before
+        snap = {
+            k: v
+            for k, v in reg.snapshot().items()
+            if k.startswith("devsolver.")
+        }
+        return issue_set(issues), wall, solver_s, snap
+
+    prev = (global_args.devsolver, global_args.prefilter,
+            global_args.frontier, global_args.frontier_force,
+            global_args.frontier_width, global_args.pipeline)
+    results = {}
+    total_decided = 0
+    try:
+        global_args.probe_backend = "auto"
+        global_args.frontier = True
+        global_args.frontier_force = True  # tiny contracts: bypass gates
+        global_args.frontier_width = 64
+        global_args.pipeline = True
+        # warm the XLA programs outside the timers
+        one_run(suicide, 1, ["AccidentallyKillable"], True)
+        for name, target, txs, modules, swc in workloads:
+            on_issues, on_wall, on_solver, on_snap = one_run(
+                target, txs, modules, True
+            )
+            off_issues, off_wall, off_solver, off_snap = one_run(
+                target, txs, modules, False
+            )
+            if swc is None:
+                assert not on_issues, (
+                    f"{name}: infeasible branch produced issues "
+                    f"(false positive): {on_issues}"
+                )
+            else:
+                assert any(s == swc for s, _ in on_issues), (
+                    f"{name}: devsolver run lost recall: {on_issues}"
+                )
+            assert on_issues == off_issues, (
+                f"{name}: device SAT tier changed the issue set "
+                "(soundness broken): "
+                f"{on_issues} != {off_issues}"
+            )
+            assert off_snap.get("devsolver.admitted", 0) == 0, (
+                f"{name}: --no-devsolver run still admitted: {off_snap}"
+            )
+            decided = (on_snap.get("devsolver.decided_sat", 0)
+                       + on_snap.get("devsolver.decided_unsat", 0))
+            total_decided += decided
+            # parity, not a race: the tier must not ADD solver time
+            # (generous bound absorbs CPU-backend jitter)
+            assert on_solver <= 1.5 * off_solver + 2.0, (
+                f"{name}: devsolver regressed harvest solver_s: "
+                f"{on_solver:.2f}s vs {off_solver:.2f}s ungated"
+            )
+            results[name] = {
+                "gated_wall_s": round(on_wall, 3),
+                "ungated_wall_s": round(off_wall, 3),
+                "gated_solver_s": round(on_solver, 3),
+                "ungated_solver_s": round(off_solver, 3),
+                "decided": decided,
+                "fallthrough": on_snap.get("devsolver.unknown", 0),
+                "issues": on_issues,
+                "devsolver": on_snap,
+            }
+    finally:
+        (global_args.devsolver, global_args.prefilter,
+         global_args.frontier, global_args.frontier_force,
+         global_args.frontier_width, global_args.pipeline) = prev
+    assert total_decided > 0, (
+        "device SAT tier decided zero queries across every exploit "
+        f"workload: {results}"
+    )
+    return {"metric": "devsolver_compare", "workloads": results}
+
+
 def mesh_compare() -> dict:
     """Sharded-pipelined vs single-device parity across every mesh ×
     pipeline combination.
@@ -1579,6 +1720,17 @@ def serve_load(clients: int = 8, workers: int = 1) -> dict:
         "killed": pf_kill,
         "kill_rate": round(pf_kill / pf_eval, 4) if pf_eval else 0.0,
     }
+    ds_adm = int(reg.counter(
+        "service.devsolver_admitted", persistent=True).snapshot() or 0)
+    ds_dec = int(reg.counter(
+        "service.devsolver_decided_sat", persistent=True).snapshot() or 0
+    ) + int(reg.counter(
+        "service.devsolver_decided_unsat", persistent=True).snapshot() or 0)
+    row["devsolver"] = {
+        "admitted": ds_adm,
+        "decided": ds_dec,
+        "decide_rate": round(ds_dec / ds_adm, 4) if ds_adm else 0.0,
+    }
     # SLO verdict for the measured window: the watchtower rode the warm
     # window above, so breaches here ARE service regressions (the counter
     # is persistent — the base snapshot isolates this window's delta)
@@ -1821,6 +1973,10 @@ def _warm_frontier() -> None:
         args.frontier_force = False
 
 
+_DEVSOLVER_KEYS = ("admitted", "decided_sat", "decided_unsat",
+                   "unknown", "model_validation_failures")
+
+
 def _new_row_data():
     return {
         "samples": {"baseline": [], "production": []},
@@ -1830,6 +1986,7 @@ def _new_row_data():
         "harvest_shares": [],
         "harvest_phases": [],  # per-production-rep {phase: seconds} deltas
         "prefilter": [],  # per-production-rep prefilter.* counter deltas
+        "devsolver": [],  # per-production-rep devsolver.* counter deltas
         "exploration": [],  # per-production-rep termination/coverage deltas
         "mids": [],  # per-production-rep (mid_reentered, mid_bounced, semantic_parked)
         # accumulated per-tag [hits, misses] deltas of the persistent XLA
@@ -1861,6 +2018,22 @@ def _prefilter_summary(samples) -> dict:
     }
     out["kill_rate"] = (
         round(out["killed"] / out["evaluated"], 4) if out["evaluated"] else 0.0
+    )
+    return out
+
+
+def _devsolver_summary(samples) -> dict:
+    """Median devsolver.* counter deltas plus the derived decide rate —
+    the per-workload figure for how much exact-solver traffic the device
+    SAT tier absorbed."""
+    out = {
+        k: _median([p[k] for p in samples])
+        for k in ("admitted", "decided_sat", "decided_unsat", "unknown",
+                  "model_validation_failures")
+    }
+    out["decided"] = out["decided_sat"] + out["decided_unsat"]
+    out["decide_rate"] = (
+        round(out["decided"] / out["admitted"], 4) if out["admitted"] else 0.0
     )
     return out
 
@@ -2010,6 +2183,14 @@ def _row_summary(unit: str, d: dict) -> dict:
         **(
             {"prefilter": _prefilter_summary(d["prefilter"])}
             if d.get("prefilter")
+            else {}
+        ),
+        # device SAT tier traffic (production runs): how many narrow
+        # queries the batched bit-blast kernel decided (exact UNSAT or
+        # validated SAT) instead of reaching the exact host tiers
+        **(
+            {"devsolver": _devsolver_summary(d["devsolver"])}
+            if d.get("devsolver")
             else {}
         ),
         # exploration quality (production runs): how many paths stopped,
@@ -2542,6 +2723,11 @@ def main() -> None:
         print(json.dumps(prefilter_compare()), flush=True)
         return
 
+    if "--devsolver-compare" in sys.argv:
+        # standalone device-SAT-tier parity mode: skip the suite, one line
+        print(json.dumps(devsolver_compare()), flush=True)
+        return
+
     if "--harvest-compare" in sys.argv:
         # standalone sharded-vs-serial harvest parity mode: one line
         print(json.dumps(harvest_compare()), flush=True)
@@ -2706,6 +2892,10 @@ def main() -> None:
                     k: get_registry().counter("prefilter.%s" % k).value
                     for k in ("evaluated", "killed", "fallthrough")
                 }
+                ds_before = {
+                    k: get_registry().counter("devsolver.%s" % k).value
+                    for k in _DEVSOLVER_KEYS
+                }
                 from mythril_tpu.observability.exploration import (
                     get_exploration_ledger,
                 )
@@ -2799,6 +2989,11 @@ def main() -> None:
                         k: get_registry().counter("prefilter.%s" % k).value
                         - pf_before[k]
                         for k in ("evaluated", "killed", "fallthrough")
+                    })
+                    d["devsolver"].append({
+                        k: get_registry().counter("devsolver.%s" % k).value
+                        - ds_before[k]
+                        for k in _DEVSOLVER_KEYS
                     })
                     led = get_exploration_ledger()
                     t_after = led.terminated()

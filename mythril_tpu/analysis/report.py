@@ -247,6 +247,7 @@ class Report:
 
         meta["observability"] = observability_meta()
         meta["prefilter"] = _prefilter_meta()
+        meta["devsolver"] = _devsolver_meta()
         meta["exploration"] = exploration_meta()
         meta["health"] = health_meta()
         meta["device"] = device_meta()
@@ -275,6 +276,28 @@ def _prefilter_meta() -> dict:
         "killed": killed,
         "fallthrough": reg.counter("prefilter.fallthrough").value or 0,
         "kill_rate": round(killed / evaluated, 4) if evaluated else 0.0,
+    }
+
+
+def _devsolver_meta() -> dict:
+    """Device SAT tier rollup for report ``meta`` — decide-rate at a
+    glance (decided / admitted; admission denials are not attempts)."""
+    from mythril_tpu.observability import get_registry
+
+    reg = get_registry()
+    admitted = reg.counter("devsolver.admitted").value or 0
+    sat = reg.counter("devsolver.decided_sat").value or 0
+    unsat = reg.counter("devsolver.decided_unsat").value or 0
+    return {
+        "admitted": admitted,
+        "decided_sat": sat,
+        "decided_unsat": unsat,
+        "unknown": reg.counter("devsolver.unknown").value or 0,
+        "model_validation_failures": reg.counter(
+            "devsolver.model_validation_failures").value or 0,
+        "kernel_wall_s": round(
+            float(reg.counter("devsolver.kernel_wall_s").value or 0.0), 4),
+        "decide_rate": round((sat + unsat) / admitted, 4) if admitted else 0.0,
     }
 
 

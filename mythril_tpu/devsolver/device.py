@@ -1,0 +1,166 @@
+"""Device twin of the batched DPLL search kernel.
+
+Runs ``kernel.step`` — the exact same function the host driver loops —
+under ``jax.jit`` + ``lax.while_loop`` with ``xp = jax.numpy``.  The
+step is pure integer arithmetic whose only scatter is an
+order-independent logical-or (``.at[...].max`` on a boolean plane), so
+host and device traces are bit-identical by construction, mirroring the
+``absdomain/domains.py`` / ``absdomain/device.py`` pair.
+
+Compilation follows the ``absdomain/device.py`` warm-up contract: one
+program per (query, clause, variable) bucket triple, the first compile
+claimed by a background thread, and ``should_use_device()`` false until
+it lands — the device tier must never ADD latency to a query that the
+host twin (or the exact tiers) would have answered sooner.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Tuple
+
+import numpy as np
+
+from mythril_tpu.devsolver import kernel
+from mythril_tpu.devsolver.kernel import RUNNING, UNKNOWN_Q, Plane
+
+log = logging.getLogger(__name__)
+
+_warm_lock = threading.Lock()
+_warm_state = "cold"  # cold -> warming -> ready
+
+_jitted = None
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    return jax, jnp, lax
+
+
+def _get_jitted():
+    global _jitted
+    if _jitted is not None:
+        return _jitted
+    jax, jnp, lax = _jax()
+
+    def scatter_or(shape, qi, vi, mask):
+        return jnp.zeros(shape, bool).at[qi, vi].max(mask)
+
+    def _run(lits, dec, n_vars_arr, max_iters):
+        qb = lits.shape[0]
+        vb = n_vars_arr.shape[0]
+        d = dec.shape[1]
+        assign = jnp.zeros((qb, vb), jnp.int8)
+        assign = assign.at[:, 0].set(2).at[:, 1].set(1)
+        level = jnp.zeros((qb, vb), jnp.int16)
+        dval = jnp.zeros((qb, d), jnp.int8)
+        dflip = jnp.zeros((qb, d), jnp.int8)
+        depth = jnp.zeros((qb,), jnp.int32)
+        status = jnp.zeros((qb,), jnp.int8)
+
+        def cond(carry):
+            _a, _l, _dv, _df, _dp, st, it = carry
+            return (st == RUNNING).any() & (it < max_iters)
+
+        def body(carry):
+            a, l, dv, df, dp, st, it = carry
+            a, l, dv, df, dp, st = kernel.step(
+                jnp, scatter_or, lits, dec, a, l, dv, df, dp, st)
+            return a, l, dv, df, dp, st, it + 1
+
+        assign, level, dval, dflip, depth, status, _ = lax.while_loop(
+            cond, body,
+            (assign, level, dval, dflip, depth, status, jnp.int32(0)))
+        status = jnp.where(status == RUNNING, jnp.int8(UNKNOWN_Q), status)
+        return status, assign
+
+    _jitted = jax.jit(_run)
+    return _jitted
+
+
+def run_device(plane: Plane, max_iters: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Jitted twin of ``kernel.run_host``; returns (status[Q], assign)."""
+    _jax()  # import check before touching the cache
+    # n_vars is carried as a shape (dummy array) so each variable bucket
+    # compiles its own program instead of retracing on a python int
+    n_vars_arr = np.zeros((plane.n_vars,), np.int8)
+    status, assign = _get_jitted()(
+        plane.lits, plane.dec, n_vars_arr, np.int32(max_iters))
+    return np.asarray(status), np.asarray(assign)
+
+
+# ---------------------------------------------------------------------------
+# Warm-up contract (absdomain/device.py idiom)
+# ---------------------------------------------------------------------------
+
+
+def _backend() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def _compile_claimed() -> None:
+    global _warm_state
+    try:
+        # smallest buckets: 1 query, 1 real clause, 3 variables
+        plane = kernel.pack_plane([([[4]], [2])], n_vars=3)
+        run_device(plane, 8)
+        with _warm_lock:
+            _warm_state = "ready"
+    except BaseException:
+        with _warm_lock:
+            _warm_state = "cold"  # allow a later retry
+        raise
+
+
+def warmup() -> None:
+    """Compile the smallest bucket synchronously (idempotent)."""
+    global _warm_state
+    with _warm_lock:
+        if _warm_state != "cold":
+            return
+        _warm_state = "warming"
+    _compile_claimed()
+
+
+def ensure_warming() -> None:
+    """Kick the compile on a background thread (claimed under the lock,
+    so back-to-back callers never spawn duplicate compile threads)."""
+    global _warm_state
+    with _warm_lock:
+        if _warm_state != "cold":
+            return
+        _warm_state = "warming"
+
+    def _guarded():
+        try:
+            _compile_claimed()
+        except Exception:
+            log.debug("devsolver device warmup failed; host twin stays",
+                      exc_info=True)
+
+    threading.Thread(target=_guarded, daemon=False,
+                     name="devsolver-warmup").start()
+
+
+def interpreter_ready() -> bool:
+    return _warm_state == "ready"
+
+
+def should_use_device() -> bool:
+    """Offload the search only on a real accelerator, once compiled."""
+    if _backend() == "cpu":
+        return False
+    if not interpreter_ready():
+        ensure_warming()
+        return False
+    return True

@@ -1,0 +1,253 @@
+"""Batched DPLL search kernel over a packed CNF plane — host twin.
+
+One step of the search is a pure, fully-vectorized function over integer
+arrays with a query axis ``[Q, ...]`` (``_step``): a unit-propagation
+sweep over every clause, contradiction detection, a single decision or a
+chronological backtrack per query.  The host driver below runs the step
+in a numpy ``while`` loop; ``devsolver/device.py`` runs the *same* step
+function under ``lax.while_loop`` with ``xp = jax.numpy`` — the two are
+bit-identical by construction (pure integer arithmetic, no floats, and
+the only scatter is an order-independent logical-or), mirroring the
+``absdomain/domains.py`` / ``absdomain/device.py`` pair.
+
+CNF plane layout (built by ``devsolver/blaster.py``):
+
+* every clause has at most 3 literals (the blaster emits only binary
+  Tseitin gates plus unit assertions); a literal is ``2*var`` (positive)
+  or ``2*var + 1`` (negated);
+* variable 0 is the constant-FALSE anchor and variable 1 the
+  constant-TRUE anchor: literal 0 (var 0, positive) pads unused literal
+  slots (always false, never satisfies and never counts as unassigned),
+  and clause ``[2]`` (var 1, positive) pads unused clause slots (always
+  satisfied, never conflicts);
+* decision variables are the *free input bits* of the blasted query in
+  tape order.  Tseitin gate variables are propagation-complete once
+  their gate inputs are assigned, so restricting DPLL splitting to the
+  input bits loses no completeness; a fixed decision order means the
+  decision stack is always a prefix of ``dec`` and backtracking needs no
+  explicit trail.
+
+Status codes per query: 0 = running, 1 = SAT (every clause has a true
+literal; the partial assignment extends to a total model by setting the
+remaining variables arbitrarily), 2 = UNSAT (conflict with no unflipped
+decision below it), 3 = UNKNOWN (iteration budget exhausted, or the
+defensive decisions-exhausted case that propagation completeness rules
+out).  UNKNOWN always falls through to the exact tiers — the kernel can
+never make the pipeline unsound, only undecided.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Plane", "pack_plane", "run_host", "RUNNING", "SAT_Q",
+           "UNSAT_Q", "UNKNOWN_Q"]
+
+RUNNING, SAT_Q, UNSAT_Q, UNKNOWN_Q = 0, 1, 2, 3
+
+# (query, clause, variable) padding buckets; decision depth is fixed by
+# the admission bit budget (devsolver_bit_budget <= MAX_DECISIONS)
+Q_BUCKETS = (4, 16)
+C_BUCKETS = (512, 4096)
+V_BUCKETS = (512, 4096)
+MAX_DECISIONS = 64
+
+
+class Plane:
+    """One padded CNF batch ready for the search kernel."""
+
+    __slots__ = ("lits", "dec", "n_q", "n_vars")
+
+    def __init__(self, lits: np.ndarray, dec: np.ndarray, n_q: int,
+                 n_vars: int):
+        self.lits = lits      # int32 [Q, C, 3]
+        self.dec = dec        # int32 [Q, D], padded with var 1
+        self.n_q = n_q        # real query count (rows beyond are padding)
+        self.n_vars = n_vars  # padded variable count (anchors included)
+
+
+def _bucket(v: int, buckets) -> int:
+    for b in buckets:
+        if v <= b:
+            return b
+    return buckets[-1]
+
+
+def pack_plane(queries: Sequence[Tuple[List[List[int]], List[int]]],
+               n_vars: int) -> Plane:
+    """Pad per-query (clauses, decision_vars) into one plane.
+
+    ``n_vars`` is the maximum variable count across the batch (anchor
+    variables 0/1 included).  Clause/variable counts are padded to the
+    shared buckets so the device twin compiles one program per bucket.
+    """
+    n_q = len(queries)
+    if n_q > Q_BUCKETS[-1]:
+        raise ValueError(
+            "pack_plane: %d queries exceed the largest query bucket %d — "
+            "chunk the batch" % (n_q, Q_BUCKETS[-1]))
+    qb = _bucket(n_q, Q_BUCKETS)
+    cb = _bucket(max((len(c) for c, _d in queries), default=1), C_BUCKETS)
+    vb = _bucket(n_vars, V_BUCKETS)
+    lits = np.zeros((qb, cb, 3), np.int32)
+    lits[:, :, 0] = 2  # var-1-positive pad: every clause satisfied
+    dec = np.ones((qb, MAX_DECISIONS), np.int32)  # var 1: skipped slots
+    for qi, (clauses, dvars) in enumerate(queries):
+        for ci, cl in enumerate(clauses):
+            lits[qi, ci, : len(cl)] = cl
+            lits[qi, ci, len(cl):] = 0  # var-0-positive: inert false
+        for di, v in enumerate(dvars[:MAX_DECISIONS]):
+            dec[qi, di] = v
+    return Plane(lits, dec, n_q, vb)
+
+
+def init_state(plane: Plane, xp=np):
+    """(assign, level, dval, dflip, depth, status) initial arrays."""
+    qb, _cb, _ = plane.lits.shape
+    vb = plane.n_vars
+    d = plane.dec.shape[1]
+    assign = xp.zeros((qb, vb), xp.int8)
+    # anchors: var 0 is constant false (2), var 1 constant true (1), both
+    # at level 0 so no backtrack ever unassigns them
+    assign = _set_col(xp, assign, 0, 2)
+    assign = _set_col(xp, assign, 1, 1)
+    level = xp.zeros((qb, vb), xp.int16)
+    dval = xp.zeros((qb, d), xp.int8)
+    dflip = xp.zeros((qb, d), xp.int8)
+    depth = xp.zeros((qb,), xp.int32)
+    status = xp.zeros((qb,), xp.int8)
+    return assign, level, dval, dflip, depth, status
+
+
+def _set_col(xp, a, col: int, val: int):
+    if xp is np:
+        a[:, col] = val
+        return a
+    return a.at[:, col].set(val)
+
+
+def _scatter_or_np(shape, qi, vi, mask):
+    out = np.zeros(shape, bool)
+    np.logical_or.at(out, (qi, vi), mask)
+    return out
+
+
+def step(xp, scatter_or, lits, dec, assign, level, dval, dflip, depth,
+         status):
+    """One kernel step: propagate OR decide OR backtrack, per query.
+
+    Pure integer function of its inputs — shared verbatim by the host
+    numpy driver and the jitted device twin.
+    """
+    qb, cb, _k = lits.shape
+    vb = assign.shape[1]
+    d = dec.shape[1]
+    running = status == RUNNING
+
+    # --- clause sweep ------------------------------------------------
+    v_idx = (lits >> 1).reshape(qb, cb * 3)
+    neg = (lits & 1).astype(xp.int8)
+    a = xp.take_along_axis(assign, v_idx, axis=1).reshape(qb, cb, 3)
+    # literal truth: 0 unassigned, 1 true, 2 false
+    cv = xp.where(neg == 1, xp.where(a == 0, 0, 3 - a), a)
+    sat_c = (cv == 1).any(axis=2)
+    n_un = (cv == 0).sum(axis=2)
+    conflict_c = (~sat_c) & (n_un == 0)
+    conflict_q = running & conflict_c.any(axis=1)
+
+    # --- unit implications -------------------------------------------
+    is_unit = (~sat_c) & (n_un == 1)
+    unit_lit = xp.where(cv == 0, lits, 0).sum(axis=2)
+    # non-unit clauses sum several literals, which can overflow the var
+    # range: zero the index there (mask is False anyway).  numpy raises
+    # on OOB scatter indices while XLA drops them — clamping keeps the
+    # twins bit-identical AND crash-free.
+    uv = xp.where(is_unit, unit_lit >> 1, 0).astype(xp.int32)
+    qi = xp.broadcast_to(xp.arange(qb, dtype=xp.int32)[:, None], (qb, cb))
+    imp_t = scatter_or((qb, vb), qi, uv, is_unit & ((unit_lit & 1) == 0))
+    imp_f = scatter_or((qb, vb), qi, uv, is_unit & ((unit_lit & 1) == 1))
+    # a variable implied both ways in one sweep is a conflict
+    conflict_q = conflict_q | (running & (imp_t & imp_f).any(axis=1))
+
+    apply_q = (running & ~conflict_q)[:, None]
+    newly = apply_q & (assign == 0) & (imp_t ^ imp_f)
+    assign = xp.where(newly & imp_t, xp.int8(1),
+                      xp.where(newly & imp_f, xp.int8(2), assign))
+    level = xp.where(newly, depth[:, None].astype(xp.int16), level)
+    progressed = newly.any(axis=1)
+
+    # --- fixpoint: SAT check or decide -------------------------------
+    at_fix = running & ~conflict_q & ~progressed
+    all_sat = sat_c.all(axis=1)
+    status = xp.where(at_fix & all_sat, xp.int8(SAT_Q), status)
+
+    need_dec = at_fix & ~all_sat
+    exhausted = depth >= d
+    status = xp.where(need_dec & exhausted, xp.int8(UNKNOWN_Q), status)
+    nd = need_dec & ~exhausted
+    d_clamp = xp.clip(depth, 0, d - 1)
+    dv = xp.take_along_axis(dec, d_clamp[:, None], axis=1)[:, 0]
+    dv_assigned = xp.take_along_axis(assign, dv[:, None], axis=1)[:, 0] != 0
+    slot = xp.arange(d, dtype=xp.int32)[None, :] == d_clamp[:, None]
+    var_hot = xp.arange(vb, dtype=xp.int32)[None, :] == dv[:, None]
+    # skipped slot (variable already forced by propagation): mark it
+    # tried-both so backtracking never flips a non-decision
+    skip = nd & dv_assigned
+    fresh = nd & ~dv_assigned
+    dflip = xp.where(skip[:, None] & slot, xp.int8(1), dflip)
+    # phase: try FALSE first (value 2) — engine conditions are
+    # overwhelmingly "selector/counter equals small constant" shapes
+    # whose models are zero-dominated
+    dval = xp.where(fresh[:, None] & slot, xp.int8(2), dval)
+    assign = xp.where(fresh[:, None] & var_hot, xp.int8(2), assign)
+    level = xp.where(fresh[:, None] & var_hot,
+                     (depth[:, None] + 1).astype(xp.int16), level)
+    depth = xp.where(nd, depth + 1, depth)
+
+    # --- backtrack ---------------------------------------------------
+    cand = (xp.arange(d, dtype=xp.int32)[None, :] < depth[:, None]) & (
+        dflip == 0)
+    has = cand.any(axis=1)
+    status = xp.where(conflict_q & ~has, xp.int8(UNSAT_Q), status)
+    bt = conflict_q & has
+    j = (d - 1) - xp.argmax(cand[:, ::-1].astype(xp.int8), axis=1).astype(
+        xp.int32)
+    keep = level <= j[:, None].astype(xp.int16)
+    assign = xp.where(bt[:, None] & ~keep, xp.int8(0), assign)
+    level = xp.where(bt[:, None] & ~keep, xp.int16(0), level)
+    j_hot = xp.arange(d, dtype=xp.int32)[None, :] == j[:, None]
+    dval = xp.where(bt[:, None] & j_hot, (3 - dval).astype(xp.int8), dval)
+    nv = xp.where(j_hot, dval, xp.int8(0)).sum(axis=1).astype(xp.int8)
+    jv = xp.take_along_axis(dec, xp.clip(j, 0, d - 1)[:, None],
+                            axis=1)[:, 0]
+    jv_hot = xp.arange(vb, dtype=xp.int32)[None, :] == jv[:, None]
+    assign = xp.where(bt[:, None] & jv_hot, nv[:, None], assign)
+    level = xp.where(bt[:, None] & jv_hot,
+                     (j[:, None] + 1).astype(xp.int16), level)
+    dflip = xp.where(bt[:, None] & j_hot, xp.int8(1),
+                     xp.where(bt[:, None] & (
+                         xp.arange(d, dtype=xp.int32)[None, :]
+                         > j[:, None]), xp.int8(0), dflip))
+    depth = xp.where(bt, j + 1, depth)
+
+    return assign, level, dval, dflip, depth, status
+
+
+def run_host(plane: Plane, max_iters: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Drive the shared step with numpy; returns (status[Q], assign[Q,V]).
+
+    Queries still RUNNING when the iteration budget lapses are stamped
+    UNKNOWN — identical to the device twin's post-loop stamping.
+    """
+    assign, level, dval, dflip, depth, status = init_state(plane)
+    it = 0
+    while it < max_iters and bool((status == RUNNING).any()):
+        assign, level, dval, dflip, depth, status = step(
+            np, _scatter_or_np, plane.lits, plane.dec, assign, level,
+            dval, dflip, depth, status)
+        it += 1
+    status = np.where(status == RUNNING, np.int8(UNKNOWN_Q), status)
+    return status, assign

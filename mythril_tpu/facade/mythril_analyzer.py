@@ -52,6 +52,9 @@ class AnalyzerArgs:
     staticpass: bool = True
     pipeline: bool = True
     prefilter: bool = True
+    devsolver: bool = True
+    devsolver_bit_budget: int = 64
+    devsolver_iters: int = 2048
     frontier_mesh: bool = True
     solver_workers: int = 2
     harvest_workers: int = 4

@@ -99,6 +99,13 @@ def apply_analyzer_args(cmd_args) -> None:
     args.staticpass = getattr(cmd_args, "staticpass", True)
     args.pipeline = getattr(cmd_args, "pipeline", True)
     args.prefilter = getattr(cmd_args, "prefilter", True)
+    args.devsolver = getattr(cmd_args, "devsolver", True)
+    args.devsolver_bit_budget = getattr(cmd_args, "devsolver_bit_budget", 64)
+    args.devsolver_iters = getattr(cmd_args, "devsolver_iters", 2048)
+    from mythril_tpu import devsolver as _devsolver
+
+    _devsolver.configure(bit_budget=args.devsolver_bit_budget,
+                         iters=args.devsolver_iters)
     args.frontier_mesh = getattr(cmd_args, "frontier_mesh", True)
     args.solver_workers = getattr(cmd_args, "solver_workers", 2)
     args.harvest_workers = getattr(cmd_args, "harvest_workers", 4)
@@ -195,6 +202,26 @@ class WorkerContext:
             out["killed"] = out.get("killed", 0) + max(
                 reg.counter("prefilter.killed").value - k0, 0
             )
+
+    @contextlib.contextmanager
+    def devsolver_delta(self, out: Dict[str, int]):
+        """Measure this scope's device-SAT-tier activity into ``out``
+        (keys ``admitted``/``decided_sat``/``decided_unsat``/``unknown``/
+        ``model_validation_failures``) — scoped counters reset per batch,
+        same contract as ``prefilter_delta``."""
+        from mythril_tpu.observability.metrics import get_registry
+
+        reg = get_registry()
+        names = ("admitted", "decided_sat", "decided_unsat", "unknown",
+                 "model_validation_failures")
+        base = {n: reg.counter("devsolver." + n).value for n in names}
+        try:
+            yield out
+        finally:
+            for n in names:
+                out[n] = out.get(n, 0) + max(
+                    reg.counter("devsolver." + n).value - base[n], 0
+                )
 
     @contextlib.contextmanager
     def exploration_delta(self, out: Dict[str, Any]):

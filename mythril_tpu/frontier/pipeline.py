@@ -285,7 +285,9 @@ class FeasibilityPool:
             statuses: list = []
             t0 = time.perf_counter()
             try:
-                with self._solver_lock:
+                from mythril_tpu.devsolver.admission import point_context
+
+                with self._solver_lock, point_context(point):
                     ok = bool(check_satisfiable_batch(
                         [raws], statuses_out=statuses)[0])
             except Exception as e:  # pragma: no cover - defensive

@@ -180,6 +180,31 @@ def _add_analysis_options(parser) -> None:
         "issue set is identical either way",
     )
     group.add_argument(
+        "--no-devsolver",
+        action="store_false",
+        dest="devsolver",
+        default=True,
+        help="disable the device-resident SAT tier (batched bit-blast "
+        "decision procedure between the pre-filter and the exact "
+        "tiers); the issue set is identical either way",
+    )
+    group.add_argument(
+        "--devsolver-bit-budget",
+        type=int,
+        default=64,
+        metavar="BITS",
+        help="maximum free decision bits (after known-bits/interval "
+        "narrowing) for a query to enter the device SAT tier",
+    )
+    group.add_argument(
+        "--devsolver-iters",
+        type=int,
+        default=2048,
+        metavar="N",
+        help="device SAT tier search-kernel iteration budget per batch "
+        "(budget lapse falls through as UNKNOWN)",
+    )
+    group.add_argument(
         "--no-mesh",
         action="store_false",
         dest="frontier_mesh",
@@ -721,6 +746,9 @@ def _build_analyzer(parsed, query_signature: bool = False):
         staticpass=not getattr(parsed, "no_staticpass", False),
         pipeline=getattr(parsed, "pipeline", True),
         prefilter=getattr(parsed, "prefilter", True),
+        devsolver=getattr(parsed, "devsolver", True),
+        devsolver_bit_budget=getattr(parsed, "devsolver_bit_budget", 64),
+        devsolver_iters=getattr(parsed, "devsolver_iters", 2048),
         frontier_mesh=getattr(parsed, "frontier_mesh", True),
         solver_workers=getattr(parsed, "solver_workers", 2),
         harvest_workers=getattr(parsed, "harvest_workers", 4),

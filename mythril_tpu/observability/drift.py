@@ -107,6 +107,10 @@ _SPECS: List[Tuple[str, Callable[[Dict[str, Any]], Optional[float]],
      False, 1.0),
     ("prefilter.kill_rate",
      lambda r: _get(r, ("prefilter", "kill_rate")), True, 1.5),
+    ("devsolver.decide_rate",
+     lambda r: _get(r, ("devsolver", "decide_rate")), True, 1.5),
+    ("devsolver.decided",
+     lambda r: _get(r, ("devsolver", "decided")), True, 1.0),
     ("exploration.coverage_pct",
      lambda r: _get(r, ("exploration", "coverage_pct")), True, 1.5),
     ("device_residency_pct", lambda r: _get(r, ("device_residency_pct",)),

@@ -71,10 +71,17 @@ TERM_CLASSES = (
 
 #: Solver batch statuses (check_satisfiable_batch ``statuses_out``) to
 #: termination classes, for kill attribution at the prune/verdict points.
+#: Every status a tier can emit MUST be mapped here explicitly — the
+#: lookup sites default to "solver_unsat", so a missing entry silently
+#: misattributes terminations (tests/devsolver/test_integration.py keeps
+#: this table in sync with the statuses solver.py can emit).
 VERDICT_CLASS = {
     "unsat": "solver_unsat",
     "unknown": "solver_timeout_unknown",
     "prefilter": "prefilter_killed",
+    # the device SAT tier's UNSAT is an exact solver verdict — it differs
+    # from "prefilter" (abstraction) in mechanism, not in exactness
+    "devsolver": "solver_unsat",
 }
 
 # visited-array plane indices (frontier/step.py writes these on device)

@@ -425,6 +425,17 @@ class FleetAggregator:
                     "killed": killed,
                     "kill_rate": round(killed / evaluated, 4),
                 }
+            ds_adm = ws.counters.get("devsolver.admitted", 0)
+            if ds_adm:
+                ds_sat = ws.counters.get("devsolver.decided_sat", 0)
+                ds_uns = ws.counters.get("devsolver.decided_unsat", 0)
+                out["devsolver"] = {
+                    "admitted": ds_adm,
+                    "decided_sat": ds_sat,
+                    "decided_unsat": ds_uns,
+                    "unknown": ws.counters.get("devsolver.unknown", 0),
+                    "decide_rate": round((ds_sat + ds_uns) / ds_adm, 4),
+                }
             # device-plane series flow through the fabric like any other
             # metric; summarize the worker's XLA-facing totals for top
             compile_s = ws.counters.get("device.compile_wall_s_total", 0)

@@ -64,7 +64,7 @@ __all__ = [
 # fast to snapshot wholesale and are better served by the tracer.
 DEFAULT_PREFIXES: Tuple[str, ...] = (
     "service.", "slo.", "heartbeat.", "exploration.", "prefilter.",
-    "device.",
+    "devsolver.", "device.",
 )
 
 _SEGMENT_RE = re.compile(r"^seg-(\d{8})\.jsonl$")

@@ -210,6 +210,13 @@ class AnalysisService:
         self._c_pf_kill = reg.counter(
             "service.prefilter_killed", persistent=True
         )
+        # device SAT tier mirrors, same scope-reset/persistent-delta
+        # contract as the prefilter pair
+        self._c_ds = {
+            name: reg.counter("service.devsolver_" + name, persistent=True)
+            for name in ("admitted", "decided_sat", "decided_unsat",
+                         "unknown", "model_validation_failures")
+        }
         # exploration-ledger mirrors: termination classes and pc-overflow
         # deltas accumulate here across batches (the scoped exploration.*
         # counters reset per analysis); per-contract coverage keeps the
@@ -625,6 +632,9 @@ class AnalysisService:
             "service.request_errors", "service.probe_wins",
             "service.device_wins", "service.probe_runs",
             "service.prefilter_evaluated", "service.prefilter_killed",
+            "service.devsolver_admitted", "service.devsolver_decided_sat",
+            "service.devsolver_decided_unsat", "service.devsolver_unknown",
+            "service.devsolver_model_validation_failures",
             "service.worker_restarts", "service.shed_total",
             "service.quota_rejections", "service.result_store_hits",
         ):
@@ -636,6 +646,18 @@ class AnalysisService:
             "kill_rate": round(
                 (out["service.prefilter_killed"] or 0) / pf_eval, 4
             ) if pf_eval else 0.0,
+        }
+        ds_adm = out["service.devsolver_admitted"] or 0
+        ds_dec = (out["service.devsolver_decided_sat"] or 0) + (
+            out["service.devsolver_decided_unsat"] or 0)
+        out["devsolver"] = {
+            "admitted": ds_adm,
+            "decided_sat": out["service.devsolver_decided_sat"] or 0,
+            "decided_unsat": out["service.devsolver_decided_unsat"] or 0,
+            "unknown": out["service.devsolver_unknown"] or 0,
+            "model_validation_failures": out[
+                "service.devsolver_model_validation_failures"] or 0,
+            "decide_rate": round(ds_dec / ds_adm, 4) if ds_adm else 0.0,
         }
         from mythril_tpu.observability.exploration import TERM_CLASSES
 
@@ -833,6 +855,22 @@ class AnalysisService:
                 self._c_pf_kill.inc(delta["killed"])
 
     @contextlib.contextmanager
+    def _account_devsolver(self):
+        """Fold this scope's device-SAT-tier activity into the persistent
+        service mirrors — same pattern as ``_account_prefilter``."""
+        delta: Dict[str, int] = {}
+        try:
+            with self._ctx.devsolver_delta(delta):
+                yield
+        finally:
+            self._fold_devsolver(delta)
+
+    def _fold_devsolver(self, delta: Dict[str, int]) -> None:
+        for name, counter in self._c_ds.items():
+            if delta.get(name):
+                counter.inc(delta[name])
+
+    @contextlib.contextmanager
     def _account_exploration(self):
         """Fold this scope's exploration-ledger activity (termination
         classes, pc-overflow, per-contract coverage) into the persistent
@@ -902,7 +940,8 @@ class AnalysisService:
                 self._scope_reset()
 
             self._stamp_batch(batch, "execute0", "execute")
-            with self._account_prefilter(), self._account_exploration(), \
+            with self._account_prefilter(), self._account_devsolver(), \
+                    self._account_exploration(), \
                     self._ctx.sink_scope(
                 self._make_sink(by_hash, streamed, "device", sink_lock)
             ):
@@ -1048,7 +1087,8 @@ class AnalysisService:
             with _otrace.span(
                 "service.probe", cat="service",
                 request=flight.requests[0].request_id,
-            ), self._account_prefilter(), self._ctx.probe_scope(), \
+            ), self._account_prefilter(), self._account_devsolver(), \
+                    self._ctx.probe_scope(), \
                     self._ctx.sink_scope(
                         self._make_sink(by_hash, streamed, "probe", sink_lock)
                     ):
@@ -1185,6 +1225,7 @@ class AnalysisService:
             self._c_pf_eval.inc(pf["evaluated"])
         if pf.get("killed"):
             self._c_pf_kill.inc(pf["killed"])
+        self._fold_devsolver(payload.get("devsolver") or {})
         self._fold_exploration(payload.get("exploration") or {})
         for wall in payload.get("probe_s") or []:
             self._c_probe_runs.inc()

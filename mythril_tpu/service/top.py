@@ -147,6 +147,23 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
             )
         )
 
+    devsolver = stats.get("devsolver") or {}
+    if devsolver.get("admitted"):
+        line = (
+            "devsolver: {a} admitted  {s} sat  {u} unsat  {n} unknown  "
+            "({r:.0%} decide rate)".format(
+                a=devsolver.get("admitted", 0),
+                s=devsolver.get("decided_sat", 0),
+                u=devsolver.get("decided_unsat", 0),
+                n=devsolver.get("unknown", 0),
+                r=devsolver.get("decide_rate", 0.0),
+            )
+        )
+        bad = devsolver.get("model_validation_failures", 0)
+        if bad:
+            line += f"  bad-models {bad}"
+        lines.append(line)
+
     exploration = stats.get("exploration") or {}
     if exploration.get("terminated_total"):
         terminated = exploration.get("terminated") or {}

@@ -45,6 +45,7 @@ describes — the fabric needs no second channel and no clock games
 ``done.payload`` is the authoritative end-of-batch result:
 ``issues`` (codehash -> wire list), ``errors`` (codehash -> one-line
 reason), ``elapsed_s``, ``prefilter`` (evaluated/killed deltas),
+``devsolver`` (device-SAT-tier decide/fallthrough deltas),
 ``exploration`` (termination-class deltas + per-contract coverage),
 ``probe_s`` (per-probe walls) and ``first_source`` (codehash ->
 probe|device).  A worker never sends a partial ``done``: a batch-level
@@ -166,6 +167,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
     first_source: Dict[str, str] = {}
     probe_walls: List[float] = []
     prefilter: Dict[str, int] = {}
+    devsolver: Dict[str, int] = {}
     exploration: Dict[str, Any] = {}
 
     def _note_first(source):
@@ -180,6 +182,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
 
     ctx.reset_scope()
     with ctx.prefilter_delta(prefilter), \
+            ctx.devsolver_delta(devsolver), \
             ctx.exploration_delta(exploration), \
             tracer.span("service.worker_batch", cat="service",
                         job=job_id, width=len(flights)):
@@ -261,6 +264,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
         "errors": dict(errors_by_name),
         "elapsed_s": round(elapsed, 6),
         "prefilter": dict(prefilter),
+        "devsolver": dict(devsolver),
         "exploration": dict(exploration),
         "probe_s": probe_walls,
         "first_source": first_source,
@@ -419,6 +423,7 @@ def worker_main(worker_id: int, config: Dict[str, Any],
                 },
                 "elapsed_s": 0.0,
                 "prefilter": {},
+                "devsolver": {},
                 "exploration": {},
                 "probe_s": [],
                 "first_source": {},

@@ -1250,7 +1250,8 @@ def check_satisfiable_batch(
     When ``statuses_out`` is given, one status string per set is appended
     to it: ``"sat"`` / ``"unsat"`` / ``"unknown"`` (a timeout decided
     unknown-as-unsat) / ``"prefilter"`` (the abstract pre-filter proved
-    UNSAT).  The exploration ledger maps these onto termination classes
+    UNSAT) / ``"devsolver"`` (the device bit-blast tier proved UNSAT).
+    The exploration ledger maps these onto termination classes
     (observability/exploration.VERDICT_CLASS) so a pruned path records
     WHY it stopped, not just that it did.
     """
@@ -1331,6 +1332,28 @@ def check_satisfiable_batch(
                 results[i] = False
                 statuses[i] = "prefilter"
                 _model_cache.remember(key, UNSAT, None)
+            else:
+                still.append((i, conj, key))
+        pending = still
+
+    # Device SAT tier over what survived the pre-filter: narrow sets are
+    # bit-blasted and *decided* batched on device (tier 0.65).  UNSAT is
+    # exact (remembered like any exact UNSAT and attributed "devsolver"
+    # for termination accounting); SAT models arrive concrete_eval-
+    # validated and seed the replay cache; UNKNOWN falls through.
+    if pending and getattr(global_args, "devsolver", True):
+        from mythril_tpu import devsolver
+
+        verdicts = devsolver.decide_batch([conj for _i, conj, _k in pending])
+        still = []
+        for (i, conj, key), (dstat, asg) in zip(pending, verdicts):
+            if dstat == "unsat":
+                results[i] = False
+                statuses[i] = "devsolver"
+                _model_cache.remember(key, UNSAT, None)
+            elif dstat == "sat":
+                results[i] = True
+                _model_cache.remember(key, SAT, asg)
             else:
                 still.append((i, conj, key))
         pending = still
@@ -1615,6 +1638,26 @@ def _solve_conjunction_impl(
             _model_cache.remember(cache_key, UNSAT, None)
         stats.inc("solver_time", time.perf_counter() - t0)
         return UNSAT, None
+
+    # tier 0.65: device SAT tier — narrow queries (free support within the
+    # devsolver bit budget after narrowing) are bit-blasted and *decided*:
+    # exact UNSAT, or SAT with a concrete_eval-validated model that seeds
+    # the replay cache.  UNKNOWN (wide support, budget lapse, failed
+    # validation) falls through to the split/probe/CDCL tiers unchanged.
+    if getattr(global_args, "devsolver", True):
+        from mythril_tpu import devsolver
+
+        dstat, dasg = devsolver.decide(conjuncts)
+        if dstat == "unsat":
+            if use_cache:
+                _model_cache.remember(cache_key, UNSAT, None)
+            stats.inc("solver_time", time.perf_counter() - t0)
+            return UNSAT, None
+        if dstat == "sat":
+            if use_cache:
+                _model_cache.remember(cache_key, SAT, dasg)
+            stats.inc("solver_time", time.perf_counter() - t0)
+            return SAT, dasg
 
     # tier 0.75: independence split (reference independence_solver.py:86-152)
     # — disjoint-variable buckets solve separately and merge their models

@@ -92,6 +92,17 @@ class Args:
     # solver fast path.  Sound (UNSAT verdicts only), issue-set-identical;
     # --no-prefilter is the escape hatch (and the parity baseline)
     prefilter: bool = True
+    # device-resident SAT tier (mythril_tpu/devsolver): batched bit-blast
+    # decision procedure between the pre-filter and the exact tiers.
+    # UNSAT is exact, SAT models are concrete_eval-validated before trust,
+    # UNKNOWN falls through; --no-devsolver is the escape hatch (and the
+    # parity baseline for bench.py --devsolver-compare)
+    devsolver: bool = True
+    # admission: maximum free decision bits after known-bits/interval
+    # narrowing for a query to enter the device tier
+    devsolver_bit_budget: int = 64
+    # search-kernel iteration budget per batch (budget lapse -> UNKNOWN)
+    devsolver_iters: int = 2048
     # feasibility-pool worker threads (solves share one lock — the win is
     # moving solve latency off the harvest critical path, not parallelism)
     solver_workers: int = 2

@@ -1,0 +1,135 @@
+"""Solver-stack integration for the device SAT tier.
+
+Covers the tier -> termination-class audit (every status a tier can emit
+must appear in VERDICT_CLASS), the ``statuses_out`` plumbing through
+``check_satisfiable_batch``, and the bad-model drill: a corrupted kernel
+model must be rejected by host validation and fall through to the exact
+tiers instead of being trusted.
+"""
+
+import pytest
+
+from mythril_tpu import devsolver
+from mythril_tpu.devsolver import blaster
+from mythril_tpu.observability.exploration import VERDICT_CLASS
+from mythril_tpu.observability.metrics import get_registry
+from mythril_tpu.smt import solver, terms
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    devsolver.reset_state()
+    yield
+    devsolver.reset_state()
+
+
+# ---------------------------------------------------------------------------
+# tier -> class mapping audit
+# ---------------------------------------------------------------------------
+
+def test_every_batch_status_is_class_mapped():
+    """check_satisfiable_batch's statuses_out vocabulary must be covered
+    by VERDICT_CLASS — a tier added without a mapping silently lands in
+    the .get() default and mis-attributes terminations."""
+    emittable = {"unsat", "unknown", "prefilter", "devsolver"}
+    missing = emittable - set(VERDICT_CLASS)
+    assert not missing, f"statuses with no termination class: {missing}"
+
+
+def test_devsolver_status_classifies_as_solver_unsat():
+    # the device tier is an EXACT refutation, not a may-analysis kill:
+    # it must share solver_unsat with the native tiers, not the
+    # prefilter's prefilter_killed class
+    assert VERDICT_CLASS["devsolver"] == "solver_unsat"
+    assert VERDICT_CLASS["prefilter"] == "prefilter_killed"
+
+
+# ---------------------------------------------------------------------------
+# batch path
+# ---------------------------------------------------------------------------
+
+def _xor_contradiction(tag):
+    """eq(x, y) AND x^y == 255: invisible to intervals and known-bits
+    (neither var is pinned), trivially refuted by bit-level search —
+    only the devsolver tier can kill it short of native CDCL."""
+    x = terms.var(f"dvi_{tag}_x", 8)
+    y = terms.var(f"dvi_{tag}_y", 8)
+    return [terms.eq(x, y),
+            terms.eq(terms.bxor(x, y), terms.const(255, 8))]
+
+
+def test_batch_unsat_is_stamped_devsolver():
+    statuses = []
+    res = solver.check_satisfiable_batch(
+        [_xor_contradiction("bu")], statuses_out=statuses)
+    assert res == [False]
+    assert statuses == ["devsolver"]
+
+
+def test_batch_sat_returns_true_with_validated_model():
+    x = terms.var("dvi_bs_x", 8)
+    row = [terms.eq(terms.add(x, terms.const(1, 8)), terms.const(6, 8))]
+    reg = get_registry()
+    bad_before = reg.counter("devsolver.model_validation_failures").value
+    res = solver.check_satisfiable_batch([row])
+    assert res == [True]
+    # whatever tier decided it, no unvalidated device model leaked
+    assert reg.counter(
+        "devsolver.model_validation_failures").value == bad_before
+
+
+def test_single_query_tier_refutes():
+    status, model = solver.solve_conjunction(_xor_contradiction("sq"))
+    assert status == solver.UNSAT
+    assert model is None
+
+
+def test_disabled_flag_bypasses_tier(monkeypatch):
+    from mythril_tpu.support import support_args
+
+    monkeypatch.setattr(support_args.args, "devsolver", False)
+    adm_before = get_registry().counter("devsolver.admitted").value
+    statuses = []
+    res = solver.check_satisfiable_batch(
+        [_xor_contradiction("off")], statuses_out=statuses)
+    # still refuted (native tiers are the backstop), never stamped ours
+    assert res == [False]
+    assert statuses[0] != "devsolver"
+    assert get_registry().counter("devsolver.admitted").value == adm_before
+
+
+# ---------------------------------------------------------------------------
+# bad-model drill: corrupted kernel models must NOT be trusted
+# ---------------------------------------------------------------------------
+
+def test_corrupted_model_falls_through(monkeypatch):
+    real = blaster.model_bytes
+
+    def corrupt(blasted, assign_row):
+        return bytes(b ^ 0xFF for b in real(blasted, assign_row))
+
+    monkeypatch.setattr(blaster, "model_bytes", corrupt)
+
+    x = terms.var("dvi_bad_x", 8)
+    row = [terms.eq(x, terms.const(5, 8))]
+    reg = get_registry()
+    before = reg.counter("devsolver.model_validation_failures").value
+
+    status, model = devsolver.decide(row)
+    assert status == "unknown", "corrupted model must not surface as SAT"
+    assert model is None
+    assert reg.counter(
+        "devsolver.model_validation_failures").value == before + 1
+
+    # the solver stack still answers correctly via fallthrough
+    devsolver.reset_state()
+    assert solver.check_satisfiable_batch([row]) == [True]
+
+
+def test_corrupted_model_does_not_flip_unsat(monkeypatch):
+    # validation failure on the SAT side must not leak into UNSAT
+    # verdicts: refutations are clause-level, model-free
+    monkeypatch.setattr(
+        blaster, "model_bytes", lambda b, r: b"\x00" * 64)
+    status, _ = devsolver.decide(_xor_contradiction("bd2"))
+    assert status == "unsat"

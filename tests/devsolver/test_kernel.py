@@ -1,0 +1,124 @@
+"""Unit tests for the batched DPLL search kernel (host twin).
+
+Hand-built CNF planes with known answers: unit propagation, conflict
+detection, chronological backtracking, batch independence, and budget
+lapse.  Literal encoding: ``2*v`` positive / ``2*v + 1`` negated; var 0
+is the constant-FALSE anchor, var 1 constant-TRUE.
+"""
+
+import numpy as np
+
+from mythril_tpu.devsolver import kernel
+from mythril_tpu.devsolver.kernel import SAT_Q, UNKNOWN_Q, UNSAT_Q
+
+
+def _run(queries, n_vars, iters=512):
+    plane = kernel.pack_plane(queries, n_vars)
+    status, assign = kernel.run_host(plane, iters)
+    return status, assign, plane
+
+
+def test_unit_clause_sat():
+    # single clause: v2 must be true
+    status, assign, _ = _run([([[4]], [2])], 3)
+    assert status[0] == SAT_Q
+    assert assign[0, 2] == 1
+
+
+def test_contradiction_unsat():
+    # v2 AND NOT v2
+    status, _, _ = _run([([[4], [5]], [2])], 3)
+    assert status[0] == UNSAT_Q
+
+
+def test_unit_propagation_chain():
+    # v2; v2 -> v3; v3 -> v4  (implications as binary clauses)
+    clauses = [[4], [5, 6], [7, 8]]
+    status, assign, _ = _run([(clauses, [2, 3, 4])], 5)
+    assert status[0] == SAT_Q
+    assert list(assign[0, 2:5]) == [1, 1, 1]
+
+
+def test_propagation_conflict():
+    # v2; v2 -> v3; v2 -> NOT v3
+    status, _, _ = _run([([[4], [5, 6], [5, 7]], [2, 3])], 4)
+    assert status[0] == UNSAT_Q
+
+
+def test_backtracking_finds_second_phase():
+    # (v2 | v3) & (NOT v2 | v3): false-first on v2 needs v3; exercise
+    # decide + propagate across both variables
+    status, assign, _ = _run([([[4, 6], [5, 6]], [2, 3])], 4)
+    assert status[0] == SAT_Q
+    assert assign[0, 3] == 1  # v3 true in every model
+
+
+def test_exhaustive_backtrack_unsat():
+    # all four assignments of (v2, v3) contradicted
+    clauses = [[4, 6], [4, 7], [5, 6], [5, 7]]
+    status, _, _ = _run([(clauses, [2, 3])], 4)
+    assert status[0] == UNSAT_Q
+
+
+def test_batch_rows_are_independent():
+    sat_q = ([[4]], [2])
+    unsat_q = ([[4], [5]], [2])
+    status, _, _ = _run([sat_q, unsat_q, sat_q, unsat_q], 3)
+    assert list(status[:4]) == [SAT_Q, UNSAT_Q, SAT_Q, UNSAT_Q]
+
+
+def test_budget_lapse_is_unknown():
+    status, _, _ = _run([([[4, 6], [5, 6]], [2, 3])], 4, iters=1)
+    assert status[0] == UNKNOWN_Q
+
+
+def test_pad_rows_do_not_disturb_real_rows():
+    # bucket pads rows up to 4; padding rows are all-satisfied clauses
+    status, _, plane = _run([([[4], [5]], [2])], 3)
+    assert plane.lits.shape[0] == 4
+    assert status[0] == UNSAT_Q
+    # pad rows converge (to SAT) instead of spinning the while loop
+    assert all(s != 0 for s in status)
+
+
+def test_model_is_partial_but_sufficient():
+    # (v2 | v3): false-first decides v2=false, then v3 must be true;
+    # any extension of the returned partial assignment is a model
+    status, assign, _ = _run([([[4, 6]], [2, 3])], 4)
+    assert status[0] == SAT_Q
+    lits_true = (assign[0, 2] == 1) or (assign[0, 3] == 1)
+    assert lits_true
+
+
+def test_pack_plane_rejects_oversize_batch():
+    # more queries than the largest query bucket must fail loudly, not
+    # silently truncate (decide_batch chunks at this cap)
+    import pytest
+
+    q = ([[4]], [2])
+    with pytest.raises(ValueError):
+        kernel.pack_plane([q] * (kernel.Q_BUCKETS[-1] + 1), 3)
+
+
+def test_decide_batch_chunks_past_query_bucket():
+    # a frontier batch wider than one plane (Q_BUCKETS[-1]) must be
+    # answered row-for-row via chunking, not truncated or crashed
+    from mythril_tpu import devsolver
+    from mythril_tpu.smt import terms
+
+    devsolver.reset_state()
+    rows, want = [], []
+    for i in range(kernel.Q_BUCKETS[-1] + 5):
+        x = terms.var("kchunk_%d_x" % i, 8)
+        y = terms.var("kchunk_%d_y" % i, 8)
+        if i % 2:
+            rows.append([terms.eq(x, y),
+                         terms.eq(terms.bxor(x, y), terms.const(255, 8))])
+            want.append("unsat")
+        else:
+            rows.append([terms.eq(terms.add(x, terms.const(1, 8)),
+                                  terms.const(i + 1, 8))])
+            want.append("sat")
+    out = devsolver.decide_batch(rows)
+    assert [s for s, _ in out] == want
+    devsolver.reset_state()

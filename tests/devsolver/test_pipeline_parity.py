@@ -1,0 +1,85 @@
+"""End-to-end parity: the device SAT tier must not change WHAT the
+pipelined engine reports, only WHERE path conditions get decided.
+
+Differential on the gated-branch contract (an infeasible selfdestruct
+guarded by a range pin plus a feasible one): devsolver on vs off through
+the full pipelined analysis must yield identical issue sets, and the on
+run must actually route queries through the tier.
+"""
+
+import pytest
+
+from mythril_tpu import devsolver
+from mythril_tpu.observability.metrics import get_registry
+from mythril_tpu.support.support_args import args as global_args
+
+# x = calldataload(0); require(x < 10); x == 5 -> selfdestruct (feasible),
+# x == 20 -> selfdestruct (infeasible) — the bench gated workload
+GATED = bytes.fromhex(
+    "60003580600a9010600c57005b80600514601c5780601414601c57005b33ff"
+)
+
+
+def _analyze(code: bytes, dev: bool):
+    from mythril_tpu import absdomain
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.analysis.security import (
+        fire_lasers, reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.querycache import reset_query_cache
+    from mythril_tpu.smt.solver import clear_model_cache
+
+    reset_callback_modules()
+    for m in ModuleLoader().get_detection_modules():
+        if hasattr(m, "cache"):
+            m.cache.clear()
+    clear_model_cache()
+    reset_query_cache()
+    devsolver.reset_state()
+    prev = (global_args.frontier, global_args.frontier_force,
+            global_args.frontier_mesh, global_args.pipeline,
+            global_args.devsolver)
+    global_args.frontier = True
+    global_args.frontier_force = True
+    global_args.frontier_mesh = False
+    global_args.pipeline = True
+    global_args.devsolver = dev
+    try:
+        sym = SymExecWrapper(
+            code,
+            address=0x0901D12E,
+            strategy="dfs",
+            transaction_count=1,
+            execution_timeout=120,
+            modules=["AccidentallyKillable"],
+        )
+        return fire_lasers(sym, white_list=["AccidentallyKillable"])
+    finally:
+        (global_args.frontier, global_args.frontier_force,
+         global_args.frontier_mesh, global_args.pipeline,
+         global_args.devsolver) = prev
+
+
+def _issue_keys(issues):
+    return sorted((i.swc_id, i.address, i.function) for i in issues)
+
+
+@pytest.mark.slow
+def test_pipelined_gated_branch_parity_on_vs_off():
+    reg = get_registry()
+    reg.reset(prefix="devsolver.")
+    on = _analyze(GATED, dev=True)
+    attempted = reg.counter("devsolver.admitted").value
+    bad = reg.counter("devsolver.model_validation_failures").value
+
+    reg.reset(prefix="devsolver.")
+    off = _analyze(GATED, dev=False)
+    off_attempted = reg.counter("devsolver.admitted").value
+
+    assert _issue_keys(on) == _issue_keys(off), (
+        "device SAT tier changed the issue set"
+    )
+    assert len(on) == 1, f"expected exactly the feasible kill, got {on}"
+    assert attempted > 0, "devsolver-on run never admitted a query"
+    assert off_attempted == 0, "devsolver-off run touched the tier"
+    assert bad == 0, "validated-model contract violated during e2e run"
