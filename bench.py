@@ -231,6 +231,94 @@ def query_cache_compare(cache_dir=None) -> dict:
             tmp.cleanup()
 
 
+def _interproc_parity() -> dict:
+    """Interprocedural-layer on/off bit-identity across the bench corpus.
+
+    For every corpus member (reference corpus when mounted, plus killbilly
+    and the assembled real shapes) the full analysis runs twice — interproc
+    refinement on, then off — and the issue sets must be IDENTICAL: the
+    refinement may only remove edges and work, never findings.  On top,
+    the corrected-denominator contract is asserted over every coverage
+    entry the runs produced: ``coverage_pct_reachable >= coverage_pct_raw``
+    everywhere, strictly higher somewhere (dead code exists in at least
+    one analyzed code object — e.g. the unreachable runtime body inside a
+    creation frame).
+    """
+    from mythril_tpu.frontend.evmcontract import EVMContract
+    from mythril_tpu.observability.exploration import get_exploration_ledger
+    from mythril_tpu.staticpass import clear_cache, reset_views
+    from mythril_tpu.support.support_args import args as global_args
+    from mythril_tpu.support.support_utils import get_code_hash
+
+    members = [(
+        "killbilly",
+        EVMContract(code=KILLBILLY, creation_code=KILLBILLY_CREATION,
+                    name="KillBilly"),
+        KILLBILLY,
+    )]
+    for path in sorted(p for g in CORPUS_GLOBS for p in _corpus_dir().glob(g)):
+        code = _read_runtime(path)
+        members.append((path.name, code, code.hex()))
+    for name, code in _assembled_corpus():
+        hex_code = code.hex() if isinstance(code, (bytes, bytearray)) else code
+        members.append((name, code, hex_code))
+
+    prev = (global_args.staticpass, global_args.staticpass_interproc)
+    rows = []
+    try:
+        for name, contract, hex_code in members:
+            def one(interproc: bool):
+                global_args.staticpass = True
+                global_args.staticpass_interproc = interproc
+                _clear_caches()
+                clear_cache()
+                reset_views()
+                _, issues = _analyze(contract, 0x0901D12E, 2, timeout=60)
+                return sorted((i.swc_id, i.address) for i in issues)
+
+            on_issues = one(True)
+            cov = get_exploration_ledger().coverage().get(
+                get_code_hash(hex_code)
+            ) or {}
+            off_issues = one(False)
+            assert on_issues == off_issues, (
+                f"{name}: interprocedural pruning changed the issue set "
+                f"(over-approximation broken): {on_issues} != {off_issues}"
+            )
+            rows.append({
+                "workload": name,
+                "issues": on_issues,
+                "coverage_pct_raw": cov.get("instruction_pct_raw"),
+                "coverage_pct_reachable": cov.get("instruction_pct_reachable"),
+            })
+        # denominator contract over EVERY code object the runs touched
+        # (creation frames included — that's where dead code is common)
+        strictly_higher = 0
+        for h, cov in get_exploration_ledger().coverage().items():
+            raw = cov.get("instruction_pct_raw")
+            reach = cov.get("instruction_pct_reachable")
+            if raw is None or reach is None:
+                continue
+            assert reach >= raw, (
+                f"{h}: coverage_pct_reachable {reach} < raw {raw} — the "
+                "reachable denominator undercounted executed instructions"
+            )
+            if reach > raw:
+                strictly_higher += 1
+        assert strictly_higher >= 1, (
+            "no analyzed code object had strictly higher reachable "
+            "coverage — the corrected denominator changed nothing anywhere"
+        )
+    finally:
+        global_args.staticpass, global_args.staticpass_interproc = prev
+    return {
+        "contracts": len(rows),
+        "identical_issue_sets": True,
+        "strictly_higher_reachable": strictly_higher,
+        "rows": rows,
+    }
+
+
 def staticpass_compare() -> dict:
     """Static-pass on-vs-off comparison on the killbilly workload.
 
@@ -238,9 +326,12 @@ def staticpass_compare() -> dict:
     gate enabled, once with ``--no-staticpass`` semantics — and asserts the
     over-approximation contract: the issue sets are IDENTICAL while the
     gated run skipped a nonzero number of modules and elided a nonzero
-    number of hooks.  Returns (and ``main`` prints) one JSON-able dict with
-    both walls, both issue sets and the ``staticpass.*`` registry snapshot
-    of the gated run.
+    number of hooks.  A second sweep (``_interproc_parity``) toggles ONLY
+    the interprocedural layer across the whole bench corpus and asserts
+    bit-identical issue sets plus the reachable-coverage denominator
+    contract.  Returns (and ``main`` prints) one JSON-able dict with both
+    walls, both issue sets, the ``staticpass.*`` registry snapshot of the
+    gated run and the per-member interproc parity rows.
     """
     from mythril_tpu.frontend.evmcontract import EVMContract
     from mythril_tpu.observability import get_registry
@@ -299,6 +390,7 @@ def staticpass_compare() -> dict:
         "hooks_elided": on_snap.get("staticpass.hooks_elided", 0),
         "issues": on_issues,
         "staticpass": on_snap,
+        "interproc": _interproc_parity(),
     }
 
 
@@ -1988,6 +2080,10 @@ def _new_row_data():
         "prefilter": [],  # per-production-rep prefilter.* counter deltas
         "devsolver": [],  # per-production-rep devsolver.* counter deltas
         "exploration": [],  # per-production-rep termination/coverage deltas
+        # per-production-rep staticpass.reachable_edge_pct gauge reads
+        # (static property of the workload's code; drift across bench
+        # artifacts means the corpus or the oracle changed)
+        "staticpass_edge_pct": [],
         "mids": [],  # per-production-rep (mid_reentered, mid_bounced, semantic_parked)
         # accumulated per-tag [hits, misses] deltas of the persistent XLA
         # compile cache — did this workload's programs come off disk?
@@ -2051,12 +2147,19 @@ def _exploration_summary(samples) -> dict:
         s["coverage_pct"] for s in samples
         if s.get("coverage_pct") is not None
     ]
+    covs_reach = [
+        s["coverage_pct_reachable"] for s in samples
+        if s.get("coverage_pct_reachable") is not None
+    ]
     return {
         "terminated": {cls: n for cls, n in term.items() if n},
         "terminated_total": _median(
             [s["terminated_total"] for s in samples]
         ),
         "coverage_pct": round(_median(covs), 2) if covs else None,
+        "coverage_pct_reachable": (
+            round(_median(covs_reach), 2) if covs_reach else None
+        ),
     }
 
 
@@ -2199,6 +2302,14 @@ def _row_summary(unit: str, d: dict) -> dict:
         **(
             {"exploration": _exploration_summary(d["exploration"])}
             if d.get("exploration")
+            else {}
+        ),
+        # reachable-edge oracle: what share of static JUMPI edges the
+        # interprocedural pass proved live for this workload's code set
+        **(
+            {"staticpass": {"reachable_edge_pct": round(
+                _median(d["staticpass_edge_pct"]), 2)}}
+            if d.get("staticpass_edge_pct")
             else {}
         ),
         # mid-frame residency (production runs): how many parked/resumed
@@ -2553,15 +2664,24 @@ def regression_gate(
                 )
         # exploration quality: instruction coverage must not collapse —
         # a run can be fast because it silently stopped exploring, and the
-        # rate checks alone would call that an improvement
-        pcov = (p.get("exploration") or {}).get("coverage_pct")
-        ccov = (c.get("exploration") or {}).get("coverage_pct")
+        # rate checks alone would call that an improvement.  The gate
+        # compares the REACHABLE-denominator coverage (raw coverage moves
+        # whenever dead code in the corpus changes size, which is noise);
+        # it falls back to the raw figure when either artifact predates
+        # the reachable key
+        p_expl = p.get("exploration") or {}
+        c_expl = c.get("exploration") or {}
+        cov_key = "coverage_pct_reachable"
+        if (p_expl.get(cov_key) is None or c_expl.get(cov_key) is None):
+            cov_key = "coverage_pct"
+        pcov = p_expl.get(cov_key)
+        ccov = c_expl.get(cov_key)
         if pcov is not None and ccov is not None:
             checks += 1
             floor_cov = pcov - GATE_COVERAGE_SLACK_PCT
             if ccov < floor_cov:
                 violations.append(
-                    f"{name}: exploration coverage_pct {ccov:.1f} < "
+                    f"{name}: exploration {cov_key} {ccov:.1f} < "
                     f"{floor_cov:.1f} (prior {pcov:.1f} - "
                     f"{GATE_COVERAGE_SLACK_PCT:.0f}pt)"
                 )
@@ -3011,7 +3131,14 @@ def main() -> None:
                         "terminated": term_delta,
                         "terminated_total": sum(term_delta.values()),
                         "coverage_pct": led.coverage_pct(),
+                        "coverage_pct_reachable":
+                            led.coverage_pct_reachable(),
                     })
+                    edge_pct = get_registry().gauge(
+                        "staticpass.reachable_edge_pct"
+                    ).snapshot()
+                    if edge_pct:
+                        d["staticpass_edge_pct"].append(float(edge_pct))
                 if production:
                     # a workload with an internal warm-up supplies its own
                     # timed-run delta (out[6]), mirroring out[3]/out[4]

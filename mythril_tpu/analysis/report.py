@@ -249,6 +249,7 @@ class Report:
         meta["prefilter"] = _prefilter_meta()
         meta["devsolver"] = _devsolver_meta()
         meta["exploration"] = exploration_meta()
+        meta["staticpass"] = _staticpass_meta()
         meta["health"] = health_meta()
         meta["device"] = device_meta()
         result = [
@@ -261,6 +262,18 @@ class Report:
             }
         ]
         return json.dumps(result, sort_keys=True)
+
+
+def _staticpass_meta() -> dict:
+    """Static-pass rollup for report ``meta``: gate state (including
+    self-disable reasons), recovered functions, the reachable-edge
+    oracle, and the top ranked interesting points."""
+    try:
+        from mythril_tpu.staticpass import staticpass_meta
+
+        return staticpass_meta()
+    except Exception:  # reporting must never fail the report
+        return {}
 
 
 def _prefilter_meta() -> dict:

@@ -50,6 +50,7 @@ class AnalyzerArgs:
     query_cache: bool = True
     query_cache_dir: Optional[str] = None
     staticpass: bool = True
+    staticpass_interproc: bool = True
     pipeline: bool = True
     prefilter: bool = True
     devsolver: bool = True

@@ -97,6 +97,9 @@ def apply_analyzer_args(cmd_args) -> None:
     args.frontier_force = getattr(cmd_args, "frontier_force", False)
     args.query_cache = getattr(cmd_args, "query_cache", True)
     args.staticpass = getattr(cmd_args, "staticpass", True)
+    args.staticpass_interproc = getattr(
+        cmd_args, "staticpass_interproc", True
+    )
     args.pipeline = getattr(cmd_args, "pipeline", True)
     args.prefilter = getattr(cmd_args, "prefilter", True)
     args.devsolver = getattr(cmd_args, "devsolver", True)
@@ -254,8 +257,12 @@ class WorkerContext:
             )
             # coverage is a level, not a flow: report the scope-end view
             # (keyed by codehash so the daemon can attribute per request)
+            cov = led.coverage()
             out["coverage_pct"] = {
-                h: c["instruction_pct"] for h, c in led.coverage().items()
+                h: c["instruction_pct"] for h, c in cov.items()
+            }
+            out["coverage_pct_reachable"] = {
+                h: c["instruction_pct_reachable"] for h, c in cov.items()
             }
 
     def stats(self) -> Dict[str, Any]:

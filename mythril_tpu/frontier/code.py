@@ -130,6 +130,7 @@ class CodeTables:
             reach = static_summary.instr_reachable
         self.static_target = np.full(n + 1, -1, np.int32)
         events_pruned = 0
+        jumpi_events_pruned = 0
 
         for i, ins in enumerate(instruction_list):
             name = ins.opcode
@@ -144,6 +145,8 @@ class CodeTables:
             self.event[i] = event and reachable
             if event and not reachable:
                 events_pruned += 1
+                if name == "JUMPI":
+                    jumpi_events_pruned += 1
             self.concskip[i] = name in conc_nop
             self.valgate[i] = name in val_gate
             fam, aux = self._classify(ins, arena, code_size)
@@ -162,6 +165,33 @@ class CodeTables:
             get_registry().counter("staticpass.events_pruned").inc(
                 events_pruned
             )
+            if jumpi_events_pruned:
+                get_registry().counter(
+                    "staticpass.jumpi_events_pruned"
+                ).inc(jumpi_events_pruned)
+
+        # reachable-edge oracle accounting: JUMPI edges the interprocedural
+        # layer proved dead (constant-folded condition or invalid/unreachable
+        # destination).  The event bit itself stays at instruction
+        # granularity — a reachable JUMPI with one dead edge still events
+        # for the walker — but the dead-edge count is what the pruning
+        # parity gate and the drift doctor watch.
+        if (
+            reach is not None
+            and getattr(static_summary, "edge_taken_live", None) is not None
+        ):
+            taken_live = static_summary.edge_taken_live
+            fall_live = static_summary.edge_fall_live
+            edges_dead = 0
+            for i, ins in enumerate(instruction_list):
+                if ins.opcode == "JUMPI":
+                    edges_dead += int(not taken_live[i]) + int(not fall_live[i])
+            if edges_dead:
+                from mythril_tpu.observability import get_registry
+
+                get_registry().counter(
+                    "staticpass.jumpi_edges_pruned"
+                ).inc(edges_dead)
 
         # implicit STOP past the end of code (reference svm.py:281-284)
         self.fam[n] = O.F_STOP

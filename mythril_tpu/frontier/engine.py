@@ -754,9 +754,16 @@ class FrontierEngine:
                 # events on statically unreachable instructions and feeds
                 # the per-code hook elision below; None = pass disabled
                 # or failed, packing proceeds exactly as before
-                from mythril_tpu.staticpass import summary_for_code
+                from mythril_tpu.staticpass import (
+                    publish_reachability,
+                    summary_for_code,
+                )
 
                 summary = summary_for_code(code)
+                # register the reachable-edge oracle with the exploration
+                # ledger so coverage is also quoted against the statically
+                # reachable denominator (coverage_pct_reachable)
+                publish_reachability(code, summary)
                 hooked, conc_nop, val_gate = self._hook_info(laser, summary)
                 tables.append(
                     CodeTables(

@@ -258,6 +258,15 @@ def _add_analysis_options(parser) -> None:
         "pruning",
     )
     group.add_argument(
+        "--no-staticpass-interproc",
+        action="store_true",
+        help="keep only the base (intra-procedural) static passes: no "
+        "value-set jump refinement, function recovery, reachable-edge "
+        "oracle or cross-contract call graph; the issue set is identical "
+        "either way (bench.py --staticpass-compare gates exactly this "
+        "toggle)",
+    )
+    group.add_argument(
         "--staticpass-report",
         metavar="FILE",
         help="write the static pre-analysis summary (per-contract CFG "
@@ -361,6 +370,29 @@ def create_parser() -> argparse.ArgumentParser:
     _add_input_options(disassemble)
     _add_rpc_options(disassemble)
     _add_verbosity(disassemble)
+
+    static = subparsers.add_parser(
+        "static",
+        help="static pre-analysis only (no symbolic execution): recovered "
+        "function table, storage read/write summaries, reachable-edge "
+        "oracle, ranked interesting points, cross-contract call graph",
+    )
+    _add_input_options(static)
+    _add_rpc_options(static)
+    static.add_argument(
+        "-o", "--outform", choices=["text", "json"], default="text",
+        help="output format",
+    )
+    static.add_argument(
+        "--top", type=int, default=10, metavar="N",
+        help="interesting points to print in text mode (default 10)",
+    )
+    static.add_argument(
+        "--no-staticpass-interproc", action="store_true",
+        help="base (intra-procedural) passes only: skip value-set jump "
+        "refinement and function recovery",
+    )
+    _add_verbosity(static)
 
     safe = subparsers.add_parser(
         "safe-functions", help="check functions which are completely safe using symbolic execution"
@@ -744,6 +776,9 @@ def _build_analyzer(parsed, query_signature: bool = False):
         query_cache=not getattr(parsed, "no_query_cache", False),
         query_cache_dir=getattr(parsed, "query_cache_dir", None),
         staticpass=not getattr(parsed, "no_staticpass", False),
+        staticpass_interproc=not getattr(
+            parsed, "no_staticpass_interproc", False
+        ),
         pipeline=getattr(parsed, "pipeline", True),
         prefilter=getattr(parsed, "prefilter", True),
         devsolver=getattr(parsed, "devsolver", True),
@@ -863,6 +898,102 @@ def _export_observability(parsed) -> None:
         log.info("wrote static pre-analysis report to %s", staticpass_report)
 
 
+def _print_static_report(report: dict, top: int = 10) -> None:
+    """Human rendering of the ``myth static`` report dict."""
+    for entry in report.get("contracts", []):
+        print(f"contract {entry['name']}")
+        for code in entry.get("codes", []):
+            kind = "creation" if code.get("is_creation") else "runtime"
+            r = code.get("reachability", {})
+            d = code.get("dispatch", {})
+            print(
+                f"  [{kind}] {code['instructions']} instrs, "
+                f"{code['blocks']} blocks, edges "
+                f"{r.get('edges_reachable', 0)}/{r.get('edges_total', 0)} "
+                f"reachable ({r.get('reachable_edge_pct', 100.0):.1f}%), "
+                f"interproc={'on' if code.get('interproc') else 'off'}"
+            )
+            if d.get("recovered"):
+                print(
+                    f"    dispatch recovered, "
+                    f"fallback entry @ {d.get('fallback_addr')}"
+                )
+            for fn in code.get("functions", []):
+                flags = [
+                    label for key, label in (
+                        ("caller_guarded", "caller-guarded"),
+                        ("selfdestruct", "selfdestruct"),
+                        ("delegatecall", "delegatecall"),
+                        ("writes_after_call", "writes-after-call"),
+                    ) if fn.get(key)
+                ]
+                reads = ("?" if fn.get("reads_unknown")
+                         else str(len(fn.get("storage_reads", []))))
+                writes = ("?" if fn.get("writes_unknown")
+                          else str(len(fn.get("storage_writes", []))))
+                print(
+                    f"    fn {fn['name']:<12} entry={fn['entry_addr']:<6} "
+                    f"blocks={fn['n_blocks']:<4} sloads={reads:<3} "
+                    f"sstores={writes:<3} calls={len(fn.get('calls', []))}"
+                    + (f"  [{', '.join(flags)}]" if flags else "")
+                )
+    points = [
+        p
+        for entry in report.get("contracts", [])
+        for code in entry.get("codes", [])
+        for p in code.get("interesting_points", [])
+    ]
+    points.sort(key=lambda p: -p.get("score", 0))
+    if points:
+        print(
+            f"interesting points (top {min(top, len(points))} "
+            f"of {len(points)}):"
+        )
+        for p in points[:top]:
+            print(
+                f"  [{p.get('score', 0):>3}] {p.get('kind')} "
+                f"@ {p.get('addr')} in {p.get('function')}"
+            )
+    cg = report.get("callgraph", {})
+    print(
+        f"callgraph: {len(cg.get('nodes', []))} nodes, "
+        f"{len(cg.get('edges', []))} edges "
+        f"({cg.get('resolved_edges', 0)} resolved)"
+    )
+
+
+def _execute_static(parsed) -> None:
+    """``myth static``: the interprocedural pre-pass alone, no symbolic
+    execution — recovered functions, reachable-edge oracle, ranked
+    interesting points, cross-contract call graph."""
+    from mythril_tpu.facade.mythril_config import MythrilConfig
+    from mythril_tpu.facade.mythril_disassembler import MythrilDisassembler
+    from mythril_tpu.staticpass import report_dict, summarize_contract
+    from mythril_tpu.support.support_args import args as global_args
+
+    global_args.staticpass = True
+    global_args.staticpass_interproc = not getattr(
+        parsed, "no_staticpass_interproc", False
+    )
+    config = MythrilConfig()
+    if getattr(parsed, "rpc", None) and not getattr(
+            parsed, "no_onchain_data", False):
+        config.set_api_rpc(parsed.rpc, parsed.rpctls)
+    disassembler = MythrilDisassembler(
+        eth=config.eth,
+        solc_version=getattr(parsed, "solv", None),
+        solc_settings_json=getattr(parsed, "solc_json", None),
+    )
+    _load_code(parsed, disassembler)
+    for contract in disassembler.contracts or []:
+        summarize_contract(contract)
+    report = report_dict()
+    if getattr(parsed, "outform", "text") == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        _print_static_report(report, top=getattr(parsed, "top", 10))
+
+
 def execute_command(parsed) -> None:
     command = COMMAND_ALIASES.get(parsed.command, parsed.command)
 
@@ -953,6 +1084,10 @@ def execute_command(parsed) -> None:
                 print(contract.disassembly.get_easm())
             elif contract.creation_disassembly is not None:
                 print(contract.creation_disassembly.get_easm())
+        return
+
+    if command == "static":
+        _execute_static(parsed)
         return
 
     if command == "safe-functions":

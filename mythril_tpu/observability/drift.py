@@ -113,6 +113,13 @@ _SPECS: List[Tuple[str, Callable[[Dict[str, Any]], Optional[float]],
      lambda r: _get(r, ("devsolver", "decided")), True, 1.0),
     ("exploration.coverage_pct",
      lambda r: _get(r, ("exploration", "coverage_pct")), True, 1.5),
+    ("exploration.coverage_pct_reachable",
+     lambda r: _get(r, ("exploration", "coverage_pct_reachable")),
+     True, 1.5),
+    # the reachable-edge denominator itself: movement means the corpus
+    # or the static oracle changed, not that the run got better/worse
+    ("staticpass.reachable_edge_pct",
+     lambda r: _get(r, ("staticpass", "reachable_edge_pct")), None, 1.0),
     ("device_residency_pct", lambda r: _get(r, ("device_residency_pct",)),
      True, 1.0),
     ("spread.production.width_pct", _spread_width, False, 1.0),

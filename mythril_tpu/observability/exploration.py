@@ -97,7 +97,8 @@ _MAX_HOTSPOT_LABELS = 256
 
 
 class _CodeCoverage:
-    __slots__ = ("total", "jumpis", "instr", "edge_taken", "edge_fall")
+    __slots__ = ("total", "jumpis", "instr", "edge_taken", "edge_fall",
+                 "reach_instr", "reach_taken", "reach_fall")
 
     def __init__(self, total: int, jumpis: int):
         self.total = max(int(total), 0)
@@ -106,24 +107,73 @@ class _CodeCoverage:
         self.instr = np.zeros(n, bool)
         self.edge_taken = np.zeros(n, bool)
         self.edge_fall = np.zeros(n, bool)
+        # static reachability masks (the staticpass reachable-edge
+        # oracle); None until a summary is registered — then the
+        # *_reachable variants fall back to the raw denominators
+        self.reach_instr: Optional[np.ndarray] = None
+        self.reach_taken: Optional[np.ndarray] = None
+        self.reach_fall: Optional[np.ndarray] = None
+
+    def set_static(self, instr_mask, taken_mask, fall_mask) -> None:
+        """Install the static reachability masks, aligned to this
+        entry's instruction space (truncate/pad as needed)."""
+        def fit(mask):
+            m = np.zeros(self.instr.shape[0], bool)
+            src = np.asarray(mask, bool)
+            n = min(m.shape[0], src.shape[0])
+            m[:n] = src[:n]
+            return m
+
+        self.reach_instr = fit(instr_mask)
+        self.reach_taken = fit(taken_mask)
+        self.reach_fall = fit(fall_mask)
+
+    def _reach_counts(self):
+        """(reachable_instructions, reachable_edges) with the executed
+        bits unioned in, so executed ⊆ reachable holds by construction
+        and the reachable percentages can never dip below the raw ones
+        even if a registered mask is misaligned."""
+        if self.reach_instr is None:
+            return None, None
+        r_instr = int((self.reach_instr | self.instr).sum()) \
+            if self.total else 0
+        r_edges = int((self.reach_taken | self.edge_taken).sum()) \
+            + int((self.reach_fall | self.edge_fall).sum())
+        return min(r_instr, self.total), min(r_edges, 2 * self.jumpis)
 
     def as_dict(self) -> Dict[str, Any]:
         seen = int(self.instr.sum())
         taken = int(self.edge_taken.sum())
         fall = int(self.edge_fall.sum())
         edges_total = 2 * self.jumpis
+        instr_pct = round(100.0 * seen / self.total, 2) if self.total else 0.0
+        edge_pct = round(100.0 * (taken + fall) / edges_total, 2) \
+            if edges_total else None
+        r_instr, r_edges = self._reach_counts()
+        instr_pct_reach = (
+            round(100.0 * seen / r_instr, 2)
+            if r_instr else instr_pct
+        )
+        edge_pct_reach = (
+            round(100.0 * (taken + fall) / r_edges, 2)
+            if r_edges else edge_pct
+        )
         return {
             "instructions_total": self.total,
             "instructions_seen": seen,
-            "instruction_pct": round(100.0 * seen / self.total, 2)
-            if self.total else 0.0,
+            "instructions_reachable": r_instr,
+            "instruction_pct": instr_pct,
+            "instruction_pct_raw": instr_pct,
+            "instruction_pct_reachable": instr_pct_reach,
             "jumpis": self.jumpis,
             "edges_total": edges_total,
             "edges_seen": taken + fall,
+            "edges_reachable": r_edges,
             "edge_taken_seen": taken,
             "edge_fall_seen": fall,
-            "edge_pct": round(100.0 * (taken + fall) / edges_total, 2)
-            if edges_total else None,
+            "edge_pct": edge_pct,
+            "edge_pct_raw": edge_pct,
+            "edge_pct_reachable": edge_pct_reach,
         }
 
 
@@ -199,6 +249,17 @@ class ExplorationLedger:
             self.record_pc_overflow(overflow)
         self._publish_gauge()
 
+    def register_static(self, code_hash: str, instr_mask,
+                        taken_mask, fall_mask) -> None:
+        """Install the static pass's reachability masks for one code
+        (the reachable-edge oracle): `coverage_pct_reachable` quotes
+        coverage against the statically reachable denominator instead
+        of all decoded instructions (padding, metadata, dead code)."""
+        with self._lock:
+            entry = self._entry(code_hash, len(np.asarray(instr_mask)))
+            entry.set_static(instr_mask, taken_mask, fall_mask)
+        self._publish_gauge()
+
     def record_pc_overflow(self, n: int = 1) -> None:
         """An out-of-range pc was observed (and dropped, not clamped)."""
         self._reg().counter("exploration.pc_overflow").inc(n)
@@ -213,8 +274,9 @@ class ExplorationLedger:
 
     def coverage_pct(self, code_hash: Optional[str] = None
                      ) -> Optional[float]:
-        """Instruction coverage percent: one contract, or the aggregate
-        weighted by instruction counts when ``code_hash`` is None."""
+        """Raw instruction coverage percent (denominator = every decoded
+        instruction): one contract, or the aggregate weighted by
+        instruction counts when ``code_hash`` is None."""
         with self._lock:
             if code_hash is not None:
                 entry = self._codes.get(code_hash)
@@ -227,17 +289,52 @@ class ExplorationLedger:
             seen = sum(int(c.instr.sum()) for c in self._codes.values())
             return round(100.0 * seen / total, 2)
 
-    def _publish_gauge(self) -> None:
-        """Per-codehash instruction coverage as one dict-valued gauge —
-        ``prometheus_text`` renders dict gauges as labeled samples, so the
-        percentages reach Prometheus / ``--metrics-out`` directly."""
+    def coverage_pct_reachable(self, code_hash: Optional[str] = None
+                               ) -> Optional[float]:
+        """Instruction coverage percent over the STATICALLY REACHABLE
+        denominator.  Codes with no registered static masks contribute
+        their raw denominator, so this is always ≥ `coverage_pct` and
+        degrades to it when the static pass is off."""
         with self._lock:
-            value = {
-                h[:10]: round(100.0 * int(c.instr.sum()) / c.total, 2)
-                for h, c in self._codes.items()
-                if c.total
-            }
-        self._reg().gauge("exploration.coverage_pct", default={}).set(value)
+            if code_hash is not None:
+                entry = self._codes.get(code_hash)
+                if entry is None or not entry.total:
+                    return None
+                r_instr, _ = entry._reach_counts()
+                denom = r_instr if r_instr else entry.total
+                return round(100.0 * int(entry.instr.sum()) / denom, 2)
+            total = seen = 0
+            for c in self._codes.values():
+                if not c.total:
+                    continue
+                r_instr, _ = c._reach_counts()
+                total += r_instr if r_instr else c.total
+                seen += int(c.instr.sum())
+            if not total:
+                return None
+            return round(100.0 * seen / total, 2)
+
+    def _publish_gauge(self) -> None:
+        """Per-codehash instruction coverage as dict-valued gauges —
+        ``prometheus_text`` renders dict gauges as labeled samples, so the
+        percentages reach Prometheus / ``--metrics-out`` directly.  Both
+        denominators are published: raw (all decoded instructions) and
+        statically reachable (the staticpass oracle)."""
+        with self._lock:
+            raw = {}
+            reach = {}
+            for h, c in self._codes.items():
+                if not c.total:
+                    continue
+                seen = int(c.instr.sum())
+                raw[h[:10]] = round(100.0 * seen / c.total, 2)
+                r_instr, _ = c._reach_counts()
+                denom = r_instr if r_instr else c.total
+                reach[h[:10]] = round(100.0 * seen / denom, 2)
+        self._reg().gauge("exploration.coverage_pct", default={}).set(raw)
+        self._reg().gauge(
+            "exploration.coverage_pct_reachable", default={}
+        ).set(reach)
 
     # -- termination attribution ---------------------------------------
 
@@ -296,6 +393,8 @@ class ExplorationLedger:
         total = self.terminated_total()
         return {
             "coverage_pct": self.coverage_pct(),
+            "coverage_pct_raw": self.coverage_pct(),
+            "coverage_pct_reachable": self.coverage_pct_reachable(),
             "coverage": self.coverage(),
             "terminated": terminated,
             "terminated_total": total,

@@ -234,8 +234,17 @@ class AnalysisService:
         self._coverage_by_hash: "collections.OrderedDict[str, float]" = (
             collections.OrderedDict()
         )
+        # same view over the statically reachable denominator (the
+        # staticpass reachable-edge oracle); falls back to the raw
+        # percentage for codes with no registered static masks
+        self._coverage_reach_by_hash: "collections.OrderedDict[str, float]" = (
+            collections.OrderedDict()
+        )
         self._g_coverage = reg.gauge(
             "service.coverage_avg_pct", persistent=True
+        )
+        self._g_coverage_reach = reg.gauge(
+            "service.coverage_reachable_avg_pct", persistent=True
         )
         self.telemetry = RequestTelemetry(
             request_log=self.config.request_log,
@@ -672,6 +681,24 @@ class AnalysisService:
             "coverage_pct": {
                 h[:10]: pct for h, pct in self._coverage_by_hash.items()
             },
+            "coverage_pct_reachable": {
+                h[:10]: pct
+                for h, pct in self._coverage_reach_by_hash.items()
+            },
+        }
+        # static-gate health: self-disable reasons + the reachable-edge
+        # oracle's aggregate (daemon-local registry view; `myth top`
+        # renders a WARN line when any self-disable occurred)
+        from mythril_tpu.observability import get_registry as _get_reg
+
+        _reg = _get_reg()
+        out["staticpass"] = {
+            "gate_disabled": dict(_reg.labeled_counter(
+                "staticpass.gate_disabled", label_name="reason"
+            ).snapshot()),
+            "reachable_edge_pct": _reg.gauge(
+                "staticpass.reachable_edge_pct"
+            ).snapshot(),
         }
         requests = out["service.requests"] or 0
         out["cache"] = {
@@ -898,11 +925,21 @@ class AnalysisService:
             self._coverage_by_hash.move_to_end(codehash)
         while len(self._coverage_by_hash) > _RID_REGISTRY_CAP:
             self._coverage_by_hash.popitem(last=False)
+        for codehash, pct in (
+            delta.get("coverage_pct_reachable") or {}
+        ).items():
+            self._coverage_reach_by_hash[codehash] = pct
+            self._coverage_reach_by_hash.move_to_end(codehash)
+        while len(self._coverage_reach_by_hash) > _RID_REGISTRY_CAP:
+            self._coverage_reach_by_hash.popitem(last=False)
         if self._coverage_by_hash:
             # registry mirror of the rolling average: the watchtower's
             # coverage-floor objective reads it from the history
             vals = self._coverage_by_hash.values()
             self._g_coverage.set(round(sum(vals) / len(vals), 3))
+        if self._coverage_reach_by_hash:
+            vals = self._coverage_reach_by_hash.values()
+            self._g_coverage_reach.set(round(sum(vals) / len(vals), 3))
 
     def _coverage_of(self, codehash: str) -> Optional[float]:
         return self._coverage_by_hash.get(codehash)
@@ -1054,6 +1091,9 @@ class AnalysisService:
                          compute_share: float = 0.0) -> None:
         primary = flight.requests[0]
         coverage_pct = self._coverage_of(flight.codehash)
+        coverage_pct_reachable = self._coverage_reach_by_hash.get(
+            flight.codehash
+        )
         for req in requests:
             self.telemetry.request_finished(
                 req, event,
@@ -1061,6 +1101,7 @@ class AnalysisService:
                 batch_width=batch_width, compute_share=compute_share,
                 deduped=req is not primary,
                 coverage_pct=coverage_pct,
+                coverage_pct_reachable=coverage_pct_reachable,
             )
 
     def _probe(

@@ -164,12 +164,15 @@ class RequestTelemetry:
         deduped: bool = False,
         replayed: bool = False,
         coverage_pct: Optional[float] = None,
+        coverage_pct_reachable: Optional[float] = None,
     ) -> None:
         """Finalize one request at its terminal event (idempotent).
 
         ``coverage_pct`` is the exploration ledger's instruction-coverage
         percentage for the request's contract (None when the engine never
-        produced one — rejected/replayed requests)."""
+        produced one — rejected/replayed requests);
+        ``coverage_pct_reachable`` is the same percentage quoted against
+        the statically reachable denominator (staticpass oracle)."""
         with self._lock:
             entry = self._active.pop(request.request_id, None)
         if entry is None:
@@ -198,7 +201,8 @@ class RequestTelemetry:
         self._log_line(request, entry, phases, event,
                        n_issues=n_issues, digests=digests,
                        batch_width=batch_width, deduped=deduped,
-                       replayed=replayed, coverage_pct=coverage_pct)
+                       replayed=replayed, coverage_pct=coverage_pct,
+                       coverage_pct_reachable=coverage_pct_reachable)
         # pool mode allocates flows per request (adopt_worker_flow), not
         # per batch, so retire the binding here to keep the table bounded
         with self._lock:
@@ -295,7 +299,7 @@ class RequestTelemetry:
 
     def _log_line(self, request, entry, phases, event, *, n_issues,
                   digests, batch_width, deduped, replayed,
-                  coverage_pct=None) -> None:
+                  coverage_pct=None, coverage_pct_reachable=None) -> None:
         if self._log_file is None:
             return
         rec = {
@@ -313,6 +317,7 @@ class RequestTelemetry:
             "digests": [list(d) for d in digests] if digests else [],
             "phases_s": {p: round(v, 6) for p, v in phases.items()},
             "coverage_pct": coverage_pct,
+            "coverage_pct_reachable": coverage_pct_reachable,
         }
         line = json.dumps(rec, default=repr) + "\n"
         with self._log_lock:

@@ -168,6 +168,7 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
     if exploration.get("terminated_total"):
         terminated = exploration.get("terminated") or {}
         cov = exploration.get("coverage_pct") or {}
+        cov_reach = exploration.get("coverage_pct_reachable") or {}
         # compact class breakdown: only nonzero classes, largest first
         classes = "  ".join(
             f"{cls}={n}" for cls, n in
@@ -176,9 +177,13 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         cov_txt = ""
         if cov:
             vals = list(cov.values())
-            cov_txt = "  cov(avg) {:.1f}% over {} contracts".format(
-                sum(vals) / len(vals), len(vals)
-            )
+            cov_txt = "  cov(avg) {:.1f}% raw".format(sum(vals) / len(vals))
+            if cov_reach:
+                rvals = list(cov_reach.values())
+                cov_txt += " / {:.1f}% reachable".format(
+                    sum(rvals) / len(rvals)
+                )
+            cov_txt += f" over {len(vals)} contracts"
         lines.append(
             "exploration: {t} paths terminated{c}".format(
                 t=exploration.get("terminated_total", 0), c=cov_txt
@@ -186,6 +191,18 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         )
         if classes:
             lines.append("  " + classes)
+
+    staticpass = stats.get("staticpass") or {}
+    disabled = staticpass.get("gate_disabled") or {}
+    if disabled:
+        reasons = "  ".join(
+            f"{r}={n}" for r, n in
+            sorted(disabled.items(), key=lambda kv: -kv[1]) if n
+        )
+        lines.append(
+            "WARN staticpass: gate self-disabled (nothing pruned)  "
+            + reasons
+        )
 
     phases = stats.get("phases") or {}
     if any((phases.get(p) or {}).get("count") for p in _PHASE_ORDER):

@@ -82,6 +82,11 @@ class Args:
     # packed device events.  Over-approximate — the issue set is identical
     # either way; --no-staticpass is the escape hatch
     staticpass: bool = True
+    # interprocedural layer on top of the base pass (value-set jump
+    # refinement, function recovery, reachable-edge oracle, call graph);
+    # --no-staticpass-interproc keeps the base passes only — the bench
+    # parity gate compares exactly this toggle
+    staticpass_interproc: bool = True
     # pipelined frontier (mythril_tpu/frontier/pipeline): overlap device
     # segments with host harvest/solve via chained dispatch + a background
     # feasibility pool.  Issue-set-identical to the synchronous loop;

@@ -175,7 +175,8 @@ def test_exploration_meta_shape():
     assert get_exploration_ledger() is get_exploration_ledger()
     meta = exploration_meta()
     assert set(meta) == {
-        "coverage_pct", "coverage", "terminated", "terminated_total",
+        "coverage_pct", "coverage_pct_raw", "coverage_pct_reachable",
+        "coverage", "terminated", "terminated_total",
         "partition_ok", "solver_hotspots", "pc_overflow",
     }
     assert set(meta["terminated"]) == set(TERM_CLASSES)

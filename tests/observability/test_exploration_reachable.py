@@ -1,0 +1,115 @@
+"""Reachable-edge oracle in the exploration ledger: the corrected
+coverage denominator (`coverage_pct_reachable`) and its defensive
+guarantee — reachable coverage can never dip below raw coverage, even
+with misaligned or missing static masks."""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.observability.exploration import ExplorationLedger
+from mythril_tpu.observability.metrics import MetricsRegistry
+
+
+def _ledger():
+    return ExplorationLedger(registry=MetricsRegistry())
+
+
+def _mask(total, live):
+    m = np.zeros(total, bool)
+    m[list(live)] = True
+    return m
+
+
+def test_reachable_denominator_lifts_coverage():
+    led = _ledger()
+    # 10 decoded instructions, only the first 5 statically reachable,
+    # all 5 of those executed: raw 50%, reachable 100%
+    led.record_instr("h", 10, range(5))
+    led.register_static("h", _mask(10, range(5)), _mask(10, []), _mask(10, []))
+    assert led.coverage_pct("h") == 50.0
+    assert led.coverage_pct_reachable("h") == 100.0
+    d = led.coverage()["h"]
+    assert d["instruction_pct_raw"] == 50.0
+    assert d["instruction_pct_reachable"] == 100.0
+    assert d["instructions_reachable"] == 5
+
+
+def test_without_masks_reachable_equals_raw():
+    led = _ledger()
+    led.record_instr("h", 10, range(3))
+    assert led.coverage_pct_reachable("h") == led.coverage_pct("h") == 30.0
+    d = led.coverage()["h"]
+    assert d["instruction_pct_reachable"] == d["instruction_pct_raw"]
+    assert d["instructions_reachable"] is None
+
+
+def test_executed_bits_union_into_reach_mask():
+    led = _ledger()
+    # an instruction OUTSIDE the static mask executed (mask is wrong or
+    # misaligned): it is unioned into the denominator, so reachable
+    # coverage still cannot exceed 100 or dip below raw
+    led.record_instr("h", 10, [7])
+    led.register_static("h", _mask(10, range(5)), _mask(10, []), _mask(10, []))
+    d = led.coverage()["h"]
+    assert d["instructions_reachable"] == 6  # 5 static + the stray bit
+    assert d["instruction_pct_reachable"] >= d["instruction_pct_raw"]
+    assert d["instruction_pct_reachable"] <= 100.0
+
+
+def test_mask_longer_than_code_is_truncated():
+    led = _ledger()
+    led.record_instr("h", 4, [0, 1])
+    led.register_static("h", _mask(8, range(8)), _mask(8, []), _mask(8, []))
+    d = led.coverage()["h"]
+    assert d["instructions_total"] == 4
+    assert d["instructions_reachable"] == 4
+    assert d["instruction_pct_reachable"] == 50.0
+
+
+def test_mask_shorter_than_code_is_padded():
+    led = _ledger()
+    led.record_instr("h", 8, [0, 1])
+    led.register_static("h", _mask(2, range(2)), _mask(2, []), _mask(2, []))
+    d = led.coverage()["h"]
+    assert d["instructions_total"] == 8
+    assert d["instructions_reachable"] == 2
+    assert d["instruction_pct_reachable"] == 100.0
+
+
+def test_aggregate_mixes_masked_and_unmasked_codes():
+    led = _ledger()
+    led.record_instr("a", 10, range(5))
+    led.register_static("a", _mask(10, range(5)), _mask(10, []), _mask(10, []))
+    led.record_instr("b", 10, range(5))  # no masks: raw denominator
+    assert led.coverage_pct() == 50.0
+    # aggregate: (5+5) executed over (5 reachable + 10 raw) = 66.67
+    assert led.coverage_pct_reachable() == pytest.approx(66.67, abs=0.01)
+    assert led.coverage_pct_reachable() >= led.coverage_pct()
+
+
+def test_edge_denominator_uses_reachable_masks():
+    led = _ledger()
+    planes = np.zeros((3, 8), bool)
+    planes[0, :4] = True  # instr
+    planes[1, 2] = True  # taken at the first JUMPI
+    led.record_device_planes("h", 8, 2, planes)
+    d = led.coverage()["h"]
+    assert d["edges_total"] == 4  # 2 JUMPIs, raw denominator
+    assert d["edge_pct_raw"] == 25.0
+    # statically only one JUMPI's two edges are reachable
+    led.register_static(
+        "h", _mask(8, range(8)), _mask(8, [2]), _mask(8, [2])
+    )
+    d = led.coverage()["h"]
+    assert d["edges_reachable"] == 2
+    assert d["edge_pct_reachable"] == 50.0
+    assert d["edge_pct_reachable"] >= d["edge_pct_raw"]
+
+
+def test_reset_scope_drops_masks_too():
+    led = _ledger()
+    led.record_instr("h", 4, [0])
+    led.register_static("h", _mask(4, range(4)), _mask(4, []), _mask(4, []))
+    led.reset_scope()
+    assert led.coverage() == {}
+    assert led.coverage_pct_reachable("h") is None

@@ -1,0 +1,72 @@
+"""Cross-contract static call graph: registration, lazy edge
+resolution by constant target address, and the export shape."""
+
+import pytest
+
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.staticpass.callgraph import StaticCallGraph, get_callgraph
+from mythril_tpu.staticpass.cfg import StaticCFG
+from mythril_tpu.staticpass.functions import recover_functions
+from mythril_tpu.staticpass.interproc import refine
+from mythril_tpu.staticpass.tables import InstrTables
+
+# PUSH1 0 x5; PUSH1 0xee; GAS; CALL; POP; STOP — one constant-target call
+CALLER_CODE = "6000600060006000600060ee5af15000"
+
+
+def _fmap(hexcode: str):
+    cfg = StaticCFG(InstrTables(Disassembly(bytes.fromhex(hexcode)).instruction_list))
+    return recover_functions(refine(cfg) or cfg)
+
+
+def test_unresolved_edge_has_no_callee():
+    g = StaticCallGraph()
+    g.register("hash_a", name="Caller", function_map=_fmap(CALLER_CODE))
+    (edge,) = g.edges()
+    assert edge["caller"] == "hash_a"
+    assert edge["opcode"] == "CALL"
+    assert edge["target_address"] == f"0x{0xEE:040x}"
+    assert edge["callee"] is None
+    assert g.to_dict()["resolved_edges"] == 0
+
+
+def test_edge_resolves_once_callee_registers():
+    g = StaticCallGraph()
+    g.register("hash_a", name="Caller", function_map=_fmap(CALLER_CODE))
+    g.register("hash_b", name="Callee", address=0xEE)
+    (edge,) = g.edges()
+    assert edge["callee"] == "hash_b"
+    d = g.to_dict()
+    assert d["resolved_edges"] == 1
+    names = {n["name"]: n for n in d["nodes"]}
+    assert names["Callee"]["address"] == f"0x{0xEE:040x}"
+    assert names["Caller"]["n_call_sites"] == 1
+
+
+def test_registration_order_does_not_matter():
+    g = StaticCallGraph()
+    g.register("hash_b", name="Callee", address=0xEE)
+    g.register("hash_a", name="Caller", function_map=_fmap(CALLER_CODE))
+    assert g.to_dict()["resolved_edges"] == 1
+
+
+def test_unknown_target_yields_single_unresolved_edge():
+    # call target comes from storage: SLOAD folds to ⊤
+    # PUSH1 0 x5; PUSH1 0; SLOAD; GAS; CALL; POP; STOP
+    g = StaticCallGraph()
+    g.register("hash_a", function_map=_fmap("60006000600060006000" + "6000545af15000"))
+    (edge,) = g.edges()
+    assert edge["target_address"] is None
+    assert edge["callee"] is None
+
+
+def test_reset_clears_graph():
+    g = StaticCallGraph()
+    g.register("hash_a", name="Caller", function_map=_fmap(CALLER_CODE))
+    g.reset()
+    assert g.to_dict() == {"nodes": [], "edges": [], "resolved_edges": 0}
+
+
+def test_module_singleton():
+    g = get_callgraph()
+    assert g is get_callgraph()

@@ -1,0 +1,135 @@
+"""Function recovery: the solc selector-dispatch idiom, per-function
+storage/call summaries, graceful degradation, and the ranked
+interesting-point export."""
+
+import pytest
+
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.staticpass.cfg import StaticCFG
+from mythril_tpu.staticpass.functions import (
+    FunctionMap,
+    interesting_points,
+    recover_functions,
+)
+from mythril_tpu.staticpass.interproc import refine
+from mythril_tpu.staticpass.tables import InstrTables
+
+
+def _flow(hexcode: str):
+    cfg = StaticCFG(InstrTables(Disassembly(bytes.fromhex(hexcode)).instruction_list))
+    return refine(cfg) or cfg
+
+
+# hand-written two-selector dispatcher:
+#   0x00  PUSH1 0; CALLDATALOAD; PUSH1 0xe0; SHR; DUP1
+#   0x07  PUSH4 0x0a11ce00; EQ; PUSH1 0x1e; JUMPI     -> activate()
+#   0x10  PUSH4 0x41c0e1b5; EQ; PUSH1 0x25; JUMPI     -> kill()
+#   0x19  PUSH1 0; PUSH1 0; REVERT                     (fallback tail)
+#   0x1e  JUMPDEST; PUSH1 1; PUSH1 0; SSTORE; STOP     activate: writes slot 0
+#   0x25  JUMPDEST; PUSH1 0; SLOAD; PUSH1 1; EQ; PUSH1 0x34; JUMPI;
+#         PUSH1 0; PUSH1 0; REVERT
+#   0x34  JUMPDEST; CALLER; SELFDESTRUCT               kill: unguarded
+DISPATCH = (
+    "60003560e01c80630a11ce0014601e576341c0e1b514602557"
+    "60006000fd5b6001600055005b60005460011460345760006000fd5b33ff"
+)
+
+
+def _by_name(fmap: FunctionMap):
+    return {fn.name: fn for fn in fmap.functions}
+
+
+def test_dispatch_ladder_recovered():
+    fmap = recover_functions(_flow(DISPATCH))
+    assert fmap.dispatch_recovered
+    selectors = {fn.selector for fn in fmap.functions if fn.selector is not None}
+    assert selectors == {0x0A11CE00, 0x41C0E1B5}
+    assert fmap.fallback_addr == 0x19
+
+
+def test_per_function_storage_summaries():
+    fns = _by_name(recover_functions(_flow(DISPATCH)))
+    activate = fns["0x0a11ce00"]
+    kill = fns["0x41c0e1b5"]
+    assert activate.storage_writes == (0,)
+    assert not activate.has_selfdestruct
+    assert kill.storage_reads == (0,)
+    assert kill.has_selfdestruct
+    assert not kill.caller_guarded
+
+
+def test_unguarded_selfdestruct_is_top_point():
+    fmap = recover_functions(_flow(DISPATCH))
+    points = interesting_points(fmap)
+    assert points
+    top = points[0]
+    assert top["kind"] == "unauthenticated_selfdestruct"
+    assert top["score"] == 100
+    assert top["selector"] == "0x41c0e1b5"
+    assert top["addr"] == 0x36
+
+
+# ---------------------------------------------------------------------------
+# degradation: anything non-idiomatic collapses to one "contract" region
+# ---------------------------------------------------------------------------
+
+
+def test_revert_only_code_degrades():
+    fmap = recover_functions(_flow("60006000fd"))
+    assert not fmap.dispatch_recovered
+    assert fmap.fallback_addr is None
+    assert [fn.name for fn in fmap.functions] == ["contract"]
+
+
+def test_linear_code_degrades():
+    # PUSH1 1; PUSH1 0; SSTORE; STOP — no dispatch, still summarized
+    fmap = recover_functions(_flow("6001600055 00".replace(" ", "")))
+    assert not fmap.dispatch_recovered
+    (fn,) = fmap.functions
+    assert fn.name == "contract"
+    assert fn.storage_writes == (0,)
+
+
+def test_caller_guarded_selfdestruct_not_flagged():
+    # CALLER; PUSH20 owner; EQ; PUSH1 0x1b; JUMPI; STOP;
+    # JUMPDEST; CALLER; SELFDESTRUCT — the owner check gates the kill.
+    # (A PUSH20 compare is NOT a selector ladder, so this degrades to
+    # one "contract" region with caller_guarded set.)
+    fmap = recover_functions(_flow("3373" + "11" * 20 + "14601b57005b33ff"))
+    (fn,) = fmap.functions
+    assert fn.caller_guarded
+    assert fn.has_selfdestruct
+    assert interesting_points(fmap) == []
+
+
+# ---------------------------------------------------------------------------
+# call-site folding
+# ---------------------------------------------------------------------------
+
+# PUSH1 0 x5; PUSH1 0xee; GAS; CALL; POP; STOP
+UNCHECKED_CALL = "6000600060006000600060ee5af15000"
+
+
+def test_call_site_constant_folding():
+    fmap = recover_functions(_flow(UNCHECKED_CALL))
+    (fn,) = fmap.functions
+    (call,) = fn.calls
+    assert call.opcode == "CALL"
+    assert call.to == (0xEE,)
+    assert call.value == (0,)
+    assert call.unchecked
+    kinds = {p["kind"]: p for p in interesting_points(fmap)}
+    assert kinds["unchecked_call_return"]["score"] == 40
+
+
+def test_write_after_call_outranks_unchecked():
+    # same call, then SSTORE(0, 1) before STOP
+    fmap = recover_functions(_flow("6000600060006000600060ee5af150600160005500"))
+    (fn,) = fmap.functions
+    assert fn.writes_after_call
+    points = interesting_points(fmap)
+    kinds = [p["kind"] for p in points]
+    assert "write_after_external_call" in kinds
+    assert kinds.index("write_after_external_call") < kinds.index(
+        "unchecked_call_return"
+    )

@@ -1,0 +1,150 @@
+"""Interprocedural value-set refinement: cross-block jump resolution,
+constant-folded JUMPI pruning, the subset invariant, and the budget /
+widening fallbacks that keep it over-approximate."""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.frontend.disassembler import Disassembly
+from mythril_tpu.staticpass import interproc
+from mythril_tpu.staticpass.cfg import E_JUMP, StaticCFG
+from mythril_tpu.staticpass.interproc import (
+    RefinedFlow,
+    _fall_dead,
+    _join_val,
+    _taken_dead,
+    refine,
+)
+from mythril_tpu.staticpass.tables import InstrTables
+
+
+def _cfg(hexcode: str) -> StaticCFG:
+    return StaticCFG(InstrTables(Disassembly(bytes.fromhex(hexcode)).instruction_list))
+
+
+def _assert_subset(refined: RefinedFlow, cfg: StaticCFG) -> None:
+    """Refinement may only REMOVE reachability, never add it."""
+    base = np.asarray(cfg.reachable_blocks(), bool)
+    ref = np.asarray(refined.reachable_blocks(), bool)
+    assert not np.any(ref & ~base)
+
+
+# ---------------------------------------------------------------------------
+# abstract-value lattice
+# ---------------------------------------------------------------------------
+
+
+def test_join_val_unions_small_sets():
+    assert _join_val(frozenset({1}), frozenset({2})) == frozenset({1, 2})
+
+
+def test_join_val_top_absorbs():
+    assert _join_val(None, frozenset({1})) is None
+    assert _join_val(frozenset({1}), None) is None
+
+
+def test_join_val_widens_past_cap():
+    a = frozenset(range(interproc.VSET_CAP))
+    assert _join_val(a, frozenset({10 ** 6})) is None
+
+
+def test_jumpi_deadness_predicates():
+    assert _taken_dead(frozenset({0}))
+    assert not _taken_dead(frozenset({0, 1}))
+    assert not _taken_dead(None)
+    assert _fall_dead(frozenset({1}))
+    assert not _fall_dead(frozenset({0, 1}))
+    assert not _fall_dead(None)
+
+
+# ---------------------------------------------------------------------------
+# cross-block jump resolution
+# ---------------------------------------------------------------------------
+
+# PUSH1 8; PUSH1 5; JUMP; JUMPDEST; JUMP; INVALID; JUMPDEST; STOP
+# The second JUMP's target (8) was pushed by the CALLER block, so the
+# per-block constant fold cannot see it — only the interproc fixpoint can.
+CROSS_BLOCK = "60086005565b56fe5b00"
+
+
+def test_cross_block_constant_jump_resolves():
+    cfg = _cfg(CROSS_BLOCK)
+    refined = refine(cfg)
+    assert refined is not None
+    # the base CFG leaves the second JUMP as a dynamic fan
+    base_dyn = [(f, t, k) for f, t, k in cfg.edge_list() if k != E_JUMP]
+    assert base_dyn
+    # refined: block 1 ([JUMPDEST@5, JUMP@6]) jumps only to block 3 (@8)
+    succs = [(f, t, k) for f, t, k in refined.edge_list() if f == 1]
+    assert succs == [(1, 3, E_JUMP)]
+    assert refined.n_resolved >= 1
+    _assert_subset(refined, cfg)
+
+
+def test_cross_block_prunes_invalid_pad():
+    cfg = _cfg(CROSS_BLOCK)
+    refined = refine(cfg)
+    reach = list(np.asarray(refined.reachable_blocks(), bool))
+    # block 2 is the INVALID pad at addr 7 — nothing targets it
+    assert reach[2] is np.False_ or not reach[2]
+    assert reach[0] and reach[1] and reach[3]
+
+
+def test_entry_stack_empty_for_unvisited_block():
+    refined = refine(_cfg(CROSS_BLOCK))
+    # the INVALID pad was never visited: its entry stack defaults to []
+    assert refined.entry_stack(2) == []
+
+
+# ---------------------------------------------------------------------------
+# constant-folded JUMPI pruning
+# ---------------------------------------------------------------------------
+
+
+def test_constant_false_jumpi_kills_taken_edge():
+    # PUSH1 0; PUSH1 6; JUMPI; STOP; JUMPDEST; STOP — cond is {0}
+    cfg = _cfg("6000600657005b00")
+    refined = refine(cfg)
+    assert refined is not None
+    reach = np.asarray(refined.reachable_blocks(), bool)
+    # the JUMPDEST@6 block (last) is only reachable via the dead taken edge
+    assert not reach[-1]
+    _assert_subset(refined, cfg)
+
+
+def test_constant_true_jumpi_kills_fall_edge():
+    # PUSH1 1; PUSH1 6; JUMPI; STOP; JUMPDEST; STOP — cond is {1}
+    cfg = _cfg("6001600657005b00")
+    refined = refine(cfg)
+    assert refined is not None
+    reach = np.asarray(refined.reachable_blocks(), bool)
+    # the fall-through STOP block (between JUMPI and JUMPDEST) is dead
+    assert not reach[1]
+    assert reach[-1]
+    _assert_subset(refined, cfg)
+
+
+def test_unknown_cond_keeps_both_edges():
+    # CALLDATASIZE; PUSH1 5; JUMPI; STOP; JUMPDEST; STOP — cond is ⊤
+    cfg = _cfg("36600557005b00")
+    refined = refine(cfg)
+    assert refined is not None
+    reach = np.asarray(refined.reachable_blocks(), bool)
+    assert reach.all()
+
+
+# ---------------------------------------------------------------------------
+# convergence and fallbacks
+# ---------------------------------------------------------------------------
+
+
+def test_loop_converges_via_widening():
+    # PUSH1 0; JUMPDEST; PUSH1 1; ADD; PUSH1 2; JUMP — counter widens to ⊤
+    refined = refine(_cfg("60005b600101600256"))
+    assert refined is not None
+
+
+def test_budget_exhaustion_falls_back(monkeypatch):
+    monkeypatch.setattr(interproc, "_VISIT_BUDGET_MIN", 0)
+    monkeypatch.setattr(interproc, "_VISIT_BUDGET_PER_BLOCK", 0)
+    assert refine(_cfg(CROSS_BLOCK)) is None

@@ -1,0 +1,45 @@
+"""Interprocedural refinement over-approximation contract: issue sets
+are bit-identical with the interproc layer on and off (the base static
+pass stays enabled in both runs), and the reachable coverage
+denominator never reports below the raw one."""
+
+import bench
+from mythril_tpu.frontend.evmcontract import EVMContract
+from mythril_tpu.observability import get_registry
+from mythril_tpu.observability.exploration import get_exploration_ledger
+from mythril_tpu.staticpass import clear_cache, reset_views
+from mythril_tpu.support.support_args import args
+
+
+def _run(interproc_on: bool):
+    prev = (args.staticpass, args.staticpass_interproc)
+    args.staticpass = True
+    args.staticpass_interproc = interproc_on
+    try:
+        bench._clear_caches()
+        clear_cache()
+        reset_views()
+        get_registry().reset(prefix="staticpass.")
+        contract = EVMContract(
+            code=bench.KILLBILLY,
+            creation_code=bench.KILLBILLY_CREATION,
+            name="KillBilly",
+        )
+        _, issues = bench._analyze(
+            contract, 0x0901D12E, 2, modules=None, timeout=300
+        )
+        return sorted((i.swc_id, i.address, i.title) for i in issues)
+    finally:
+        args.staticpass, args.staticpass_interproc = prev
+
+
+def test_issue_sets_identical_and_coverage_monotone():
+    on_issues = _run(True)
+    # with interproc on, every ledger entry must satisfy the defensive
+    # guarantee: reachable coverage >= raw coverage
+    for code_hash, d in get_exploration_ledger().coverage().items():
+        assert d["instruction_pct_reachable"] >= d["instruction_pct_raw"], code_hash
+    off_issues = _run(False)
+    assert on_issues == off_issues
+    # the recall issue itself must be present in both
+    assert any(swc == "106" for swc, _, _ in on_issues)
