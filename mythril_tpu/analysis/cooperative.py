@@ -125,6 +125,13 @@ def run_cooperative_batch(
         errors_by_name.setdefault(name, f"{stage}: {exc!r}")
 
     addresses = [base_address + 0x10000 * i for i in range(len(jobs))]
+    # --coverage-target needs a live coverage feed to measure the bar
+    # against: the device frontier merges its visited planes into the
+    # ledger, but the host path only feeds it through the instruction
+    # coverage plugin — enable it when a bar is set and the frontier is
+    # off, or the stop verdict could never latch
+    host_coverage = bool(getattr(args, "coverage_target", None)) \
+        and not bool(args.frontier)
     wrappers: List[Tuple[str, int, object]] = []  # (name, addr, wrapper)
     for (name, code), addr in zip(jobs, addresses):
         try:
@@ -136,6 +143,7 @@ def run_cooperative_batch(
                 execution_timeout=execution_timeout,
                 modules=modules,
                 defer_exec=True,
+                enable_coverage_strategy=host_coverage,
             )
         except Exception as e:
             _fail(name, "construction", e)

@@ -8,7 +8,7 @@ from __future__ import annotations
 import binascii
 import json
 from datetime import datetime, timedelta
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from mythril_tpu.concolic.concrete_data import ConcreteData
 from mythril_tpu.concolic.find_trace import concrete_execution, setup_concrete_initial_state
@@ -19,9 +19,14 @@ from mythril_tpu.core.transaction.transaction_models import tx_id_manager
 
 
 def flip_branches(
-    init_state, concrete_data: ConcreteData, jump_addresses: List[int], trace: List
+    init_state, concrete_data: ConcreteData, jump_addresses: List[int],
+    trace: List, hits_out: Optional[Dict] = None,
 ) -> List[Dict]:
-    """Re-execute symbolically along the trace, flipping requested JUMPIs."""
+    """Re-execute symbolically along the trace, flipping requested JUMPIs.
+
+    ``hits_out`` (when given) is filled with addr → bool(result): which
+    requested flips actually produced a new concrete input — the adaptive
+    flip counters read it; output parity is untouched."""
     tx_id_manager.restart_counter()
     output_list = []
     laser_evm = LaserEVM(
@@ -48,19 +53,35 @@ def flip_branches(
 
     if isinstance(laser_evm.strategy, ConcolicStrategy):
         for addr, result in laser_evm.strategy.results.items():
+            if hits_out is not None:
+                hits_out[addr] = bool(result)
             if result:
                 output_list.append(result)
     return output_list
 
 
 def concolic_execution(
-    concrete_data: ConcreteData, jump_addresses: List[int], solver_timeout: int = 100000
+    concrete_data: ConcreteData,
+    jump_addresses: List[int],
+    solver_timeout: int = 100000,
+    flip_targets: Optional[List[int]] = None,
 ) -> List[Dict]:
     """Main entry (reference :67-85): returns new concrete inputs, one per
-    flipped branch."""
+    flipped branch.
+
+    ``flip_targets`` are PLANNED flips from the adaptive controller —
+    uncovered-JUMPI addrs the steering plan ranked by static
+    interesting-point priority.  They merge into ``jump_addresses``
+    (dedup, caller order first so explicitly requested flips keep their
+    precedence) and their outcomes feed the ``adaptive.flips_planned`` /
+    ``adaptive.flips_hit`` counters: a planned addr whose flip produced a
+    new concrete input is a hit."""
     from mythril_tpu.support.support_args import args
     from mythril_tpu.support.time_handler import time_handler
 
+    planned = [a for a in (flip_targets or []) if a not in set(jump_addresses)]
+    if planned:
+        jump_addresses = list(jump_addresses) + planned
     old_timeout = args.solver_timeout
     old_remaining = time_handler.time_remaining()
     args.solver_timeout = solver_timeout
@@ -68,10 +89,28 @@ def concolic_execution(
     # time budget themselves; this frame only restores the caller's)
     try:
         init_state, trace = concrete_execution(concrete_data)
-        return flip_branches(init_state, concrete_data, jump_addresses, trace)
+        hits: Dict = {}
+        out = flip_branches(init_state, concrete_data, jump_addresses,
+                            trace, hits_out=hits)
+        if planned:
+            _count_planned_flips(planned, hits)
+        return out
     finally:
         # leaked process-global budgets silently reshape every later
         # analysis (solver_timeout feeds the engine's prune/confirm
         # deadlines; the time handler feeds every exec loop)
         args.solver_timeout = old_timeout
         time_handler.start_execution(max(0, old_remaining))
+
+
+def _count_planned_flips(planned: List[int], hits: Dict) -> None:
+    """Feed the adaptive flip counters; telemetry only, never raises."""
+    try:
+        from mythril_tpu.adaptive import get_adaptive_controller
+
+        get_adaptive_controller().count_flips(
+            planned=len(planned),
+            hit=sum(1 for a in planned if hits.get(a)),
+        )
+    except Exception:
+        pass

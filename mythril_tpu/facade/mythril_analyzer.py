@@ -57,6 +57,8 @@ class AnalyzerArgs:
     devsolver_bit_budget: int = 64
     devsolver_iters: int = 2048
     frontier_mesh: bool = True
+    adaptive: bool = True
+    coverage_target: Optional[float] = None
     solver_workers: int = 2
     harvest_workers: int = 4
     compile_cache_dir: Optional[str] = None

@@ -110,6 +110,8 @@ def apply_analyzer_args(cmd_args) -> None:
     _devsolver.configure(bit_budget=args.devsolver_bit_budget,
                          iters=args.devsolver_iters)
     args.frontier_mesh = getattr(cmd_args, "frontier_mesh", True)
+    args.adaptive = getattr(cmd_args, "adaptive", True)
+    args.coverage_target = getattr(cmd_args, "coverage_target", None)
     args.solver_workers = getattr(cmd_args, "solver_workers", 2)
     args.harvest_workers = getattr(cmd_args, "harvest_workers", 4)
     args.heartbeat_out = getattr(cmd_args, "heartbeat_out", None)
@@ -264,6 +266,33 @@ class WorkerContext:
             out["coverage_pct_reachable"] = {
                 h: c["instruction_pct_reachable"] for h, c in cov.items()
             }
+
+    @contextlib.contextmanager
+    def adaptive_delta(self, out: Dict[str, Any]):
+        """Measure this scope's adaptive-controller activity into ``out``
+        (keys ``plans``/``resteered_slots``/``requeued_paths``/
+        ``flips_planned``/``flips_hit``/``plateau_stops``, plus the
+        scope-end ``coverage_stop`` verdict when --coverage-target
+        latched one) — scoped counters reset per batch, same contract as
+        ``prefilter_delta``."""
+        from mythril_tpu.observability.metrics import get_registry
+
+        reg = get_registry()
+        names = ("plans", "resteered_slots", "requeued_paths",
+                 "flips_planned", "flips_hit", "plateau_stops")
+        base = {n: reg.counter("adaptive." + n).value for n in names}
+        try:
+            yield out
+        finally:
+            for n in names:
+                out[n] = out.get(n, 0) + max(
+                    reg.counter("adaptive." + n).value - base[n], 0
+                )
+            from mythril_tpu.adaptive import get_adaptive_controller
+
+            stop = get_adaptive_controller().stop_state()
+            if stop:
+                out["coverage_stop"] = stop
 
     def stats(self) -> Dict[str, Any]:
         """Worker-local engine-global sizes (heartbeat payload)."""

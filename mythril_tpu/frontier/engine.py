@@ -185,6 +185,66 @@ def _code_tag(code) -> str:
     return tag
 
 
+_code_hash_cache: Dict[object, str] = {}
+
+
+def _code_hash_full(code) -> str:
+    """Full codehash — the exploration ledger's coverage key (and the
+    adaptive planner's), so steering weights join coverage bitmaps
+    without prefix games."""
+    key = _code_key(code)
+    h = _code_hash_cache.get(key)
+    if h is None:
+        bytecode = getattr(code, "bytecode", b"") or b""
+        if bytecode:
+            from mythril_tpu.support.support_utils import get_code_hash
+
+            h = get_code_hash(bytecode.hex())
+        else:
+            h = "?"
+        if len(_code_hash_cache) >= 4096:
+            _code_hash_cache.clear()
+        _code_hash_cache[key] = h
+    return h
+
+
+def _adaptive_pick(seed_queue: List[int], seed_code_idx: List[int],
+                   table_hash: List[str]) -> int:
+    """Queue position of the next seed to inject (0 = the FIFO order every
+    pre-adaptive build used).  With >1 code queued and the controller
+    enabled, the steering plan's deficit scheduler picks the code whose
+    uncovered reachable edges earn the next slot."""
+    if len(seed_queue) <= 1:
+        return 0
+    try:
+        from mythril_tpu.adaptive import get_adaptive_controller
+
+        ctrl = get_adaptive_controller()
+        if not ctrl.enabled:
+            return 0
+        ctrl.plan()  # throttled refresh; cheap when recently built
+        return ctrl.pick_seed(
+            [table_hash[seed_code_idx[si]] for si in seed_queue]
+        )
+    except Exception:  # steering must never break a dispatch
+        log.debug("adaptive seed pick failed", exc_info=True)
+        return 0
+
+
+def _adaptive_coverage_stop() -> bool:
+    """True when the --coverage-target contract says stop exploring
+    (bar reached or all-codes plateau)."""
+    if not getattr(args, "coverage_target", None):
+        return False
+    try:
+        from mythril_tpu.adaptive import get_adaptive_controller
+
+        return get_adaptive_controller().coverage_stop() is not None
+    except Exception:  # pragma: no cover - defensive
+        log.debug("adaptive coverage check failed", exc_info=True)
+        return False
+
+
 def _strategy_chain(laser):
     """The active strategy and every strategy it wraps (extensions nest via
     ``super_strategy``), outermost first."""
@@ -741,6 +801,7 @@ class FrontierEngine:
         tables: List[CodeTables] = []
         table_laser: List = []
         table_code: List = []
+        table_hash: List[str] = []
         table_idx: Dict[tuple, int] = {}
         seed_code_idx: List[int] = []
         for laser, gs in pairs:
@@ -762,8 +823,24 @@ class FrontierEngine:
                 summary = summary_for_code(code)
                 # register the reachable-edge oracle with the exploration
                 # ledger so coverage is also quoted against the statically
-                # reachable denominator (coverage_pct_reachable)
+                # reachable denominator (coverage_pct_reachable), and hand
+                # the static interesting points to the adaptive controller
+                # (flip-target ranking shares the oracle's codehash key)
                 publish_reachability(code, summary)
+                if summary is not None and getattr(
+                        summary, "interesting_points", None):
+                    try:
+                        from mythril_tpu.adaptive import (
+                            get_adaptive_controller,
+                        )
+
+                        get_adaptive_controller().register_points(
+                            _code_hash_full(code),
+                            summary.interesting_points,
+                        )
+                    except Exception:  # steering never breaks packing
+                        log.debug("adaptive point registration failed",
+                                  exc_info=True)
                 hooked, conc_nop, val_gate = self._hook_info(laser, summary)
                 tables.append(
                     CodeTables(
@@ -779,6 +856,7 @@ class FrontierEngine:
                 )
                 table_laser.append(laser)
                 table_code.append(code)
+                table_hash.append(_code_hash_full(code))
             seed_code_idx.append(ci)
 
         natural_bucket = multi_size_bucket(tables)
@@ -857,11 +935,15 @@ class FrontierEngine:
             for gs in seeds
         ]
 
-        # initial fill
+        # initial fill (adaptive: the steering plan's deficit scheduler
+        # orders multi-code injection; FIFO — the parity baseline — with
+        # one code, no plan, or --no-adaptive)
         for slot in range(caps.B):
             if not seed_queue:
                 break
-            si = seed_queue.pop(0)
+            si = seed_queue.pop(
+                _adaptive_pick(seed_queue, seed_code_idx, table_hash)
+            )
             self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
                          _beam_importance(seeds[si]) if beam else 0,
                          static=statics[si])
@@ -1113,6 +1195,7 @@ class FrontierEngine:
                 lasers=lasers, ctxs=ctxs, seed_code_idx=seed_code_idx,
                 mid_enc=mid_enc, seed_queue=seed_queue, statics=statics,
                 beam=beam, tables=tables, table_code=table_code,
+                table_hash=table_hash,
                 table_idx=table_idx, segment=segment, code_dev=code_dev,
                 cfg=cfg, dev_arena=dev_arena, arena_len=arena_len,
                 visited=visited, deadline=deadline,
@@ -1295,7 +1378,9 @@ class FrontierEngine:
             for slot in range(caps.B):
                 rec = records[slot]
                 if rec is None and seed_queue:
-                    si = seed_queue.pop(0)
+                    si = seed_queue.pop(
+                        _adaptive_pick(seed_queue, seed_code_idx, table_hash)
+                    )
                     self._inject(st, slot, si, ctxs[si], seed_code_idx[si],
                                  _beam_importance(seeds[si]) if beam else 0,
                                  static=statics[si])
@@ -1312,6 +1397,16 @@ class FrontierEngine:
             live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
             max_live = max(max_live, live)
             if live == 0 and not seed_queue:
+                break
+            # --coverage-target: the request contract says stop at the
+            # bar (or the all-codes plateau) — spending further segments
+            # on saturated code is the waste this controller exists to cut
+            if _adaptive_coverage_stop():
+                log.info(
+                    "frontier: coverage target reached; parking live paths"
+                )
+                self._park_all(st, records, walker, reason="coverage-target")
+                width_verdict_valid = False
                 break
             if arena_len + max(live, 1) * caps.R * 2 >= caps.ARENA:
                 log.warning("frontier: arena nearly full; parking live paths")
@@ -1664,11 +1759,12 @@ class FrontierEngine:
                   reason: str = "bulk") -> None:
         """Timeout/overflow: hand every live path back to the host engine."""
         stats = FrontierStatistics()
-        if reason == "timeout":
-            # the execution budget is gone: the host work list these paths
-            # land on will never be drained, so they stop exploring HERE —
-            # other park reasons (slow/narrow-bail, drain) genuinely
-            # continue host-side and are stamped at their real end
+        if reason in ("timeout", "coverage-target"):
+            # the execution budget is gone (or the coverage contract ended
+            # the request): the host work list these paths land on will
+            # never be drained, so they stop exploring HERE — other park
+            # reasons (slow/narrow-bail, drain) genuinely continue
+            # host-side and are stamped at their real end
             from mythril_tpu.observability.exploration import (
                 get_exploration_ledger,
             )

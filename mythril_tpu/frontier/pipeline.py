@@ -334,7 +334,7 @@ class PipelinedRunner:
                  seed_queue, statics, beam, tables, table_code, table_idx,
                  segment, code_dev, cfg, dev_arena, arena_len, visited,
                  deadline, program_key, program_warm, mesh=None,
-                 push_fn=None):
+                 push_fn=None, table_hash=None):
         self.engine = engine
         self.caps = engine.caps
         self.st = st
@@ -385,9 +385,19 @@ class PipelinedRunner:
             _get_metrics().gauge("pipeline.mesh_shards").set(self.n_shards)
         self._rebalance_backoff = 0
 
+        self.table_hash = table_hash or [
+            "?" for _ in range(len(table_code))
+        ]
+
         self.ledger = CorrectionLedger(self.caps.B)
         self.pool = FeasibilityPool(args.solver_workers)
         self.reinject_q: List[tuple] = []
+        # adaptive park pool: re-runnable spills the reinject queue could
+        # not hold.  The controller's plan names which to resurrect when
+        # arena slots free; anything still pooled at run end flushes back
+        # to its host work list (exactly-once: a pooled carrier is never
+        # simultaneously on a work list or in a slot)
+        self.adaptive_parked: List[tuple] = []
 
         self.executed = 0
         self.max_live = 0
@@ -456,6 +466,15 @@ class PipelinedRunner:
         from mythril_tpu.frontier.engine import _mid_eligible
 
         if len(self.reinject_q) >= 2 * self.caps.B:
+            # queue full: these spills are exactly the "budget" parks the
+            # adaptive plan resurrects when slots free — pool them
+            # (bounded) instead of bouncing to the host work list
+            if self._adaptive_enabled() and \
+                    len(self.adaptive_parked) < 4 * self.caps.B \
+                    and _mid_eligible(carrier):
+                self.adaptive_parked.append((laser, carrier))
+                _pc("adaptive_parked").inc()
+                return True
             return False
         if not _mid_eligible(carrier):
             return False
@@ -539,15 +558,23 @@ class PipelinedRunner:
     def refill(self) -> None:
         """Queued seeds into host-reclaimable free slots.  Unlike the
         synchronous loop, beam scores of LIVE slots are not refreshed:
-        uploading onto a device-advanced slot would clobber it."""
-        from mythril_tpu.frontier.engine import _beam_importance
+        uploading onto a device-advanced slot would clobber it.  Seed
+        order follows the adaptive plan's deficit scheduler (FIFO — the
+        parity baseline — with one code or --no-adaptive)."""
+        from mythril_tpu.frontier.engine import (
+            _adaptive_pick,
+            _beam_importance,
+        )
 
         eng = self.engine
         while self.seed_queue:
             slot = self._free_slot()
             if slot is None:
                 break
-            si = self.seed_queue.pop(0)
+            si = self.seed_queue.pop(
+                _adaptive_pick(self.seed_queue, self.seed_code_idx,
+                               self.table_hash)
+            )
             eng._inject(self.st, slot, si, self.ctxs[si],
                         self.seed_code_idx[si],
                         _beam_importance(self.seeds[si]) if self.beam else 0,
@@ -560,6 +587,53 @@ class PipelinedRunner:
             self.records[slot] = PathRecord(seed_idx=si)
             self.ev_seen[slot] = 0
             self.ledger.touch(slot)
+
+    # -- adaptive steering ---------------------------------------------
+
+    @staticmethod
+    def _adaptive_enabled() -> bool:
+        return bool(getattr(args, "adaptive", True))
+
+    def _adaptive_requeue(self) -> None:
+        """Resurrect pooled spills when arena slots free (sync point
+        only: the moved carriers ride the ordinary ``_reinject`` path, so
+        arena appends and ledger touches stay inside the existing
+        exactly-once protocol).  The plan picks which parked paths earn
+        their slot back; the rest stay pooled."""
+        if not self.adaptive_parked or not self._adaptive_enabled():
+            return
+        live, free = self._slot_masks()
+        room = int(free.sum()) - len(self.reinject_q) - len(self.seed_queue)
+        if room <= 0:
+            return
+        try:
+            from mythril_tpu.adaptive import get_adaptive_controller
+
+            parked = [
+                (id(carrier), "budget_exhausted")
+                for _, carrier in self.adaptive_parked
+            ]
+            picked = set(get_adaptive_controller().select_requeue(
+                parked, live=(), limit=room
+            ))
+        except Exception:  # steering must never break a dispatch
+            log.debug("adaptive requeue failed", exc_info=True)
+            return
+        if not picked:
+            return
+        keep: List[tuple] = []
+        cap = 2 * self.caps.B
+        for laser, carrier in self.adaptive_parked:
+            if id(carrier) in picked and len(self.reinject_q) < cap:
+                self.reinject_q.append((laser, carrier))
+            else:
+                keep.append((laser, carrier))
+        self.adaptive_parked = keep
+
+    def _adaptive_coverage_stop(self) -> bool:
+        from mythril_tpu.frontier.engine import _adaptive_coverage_stop
+
+        return _adaptive_coverage_stop()
 
     # -- sync-point spill re-injection ---------------------------------
 
@@ -660,6 +734,12 @@ class PipelinedRunner:
         for laser, carrier in self.reinject_q:
             laser.work_list.append(carrier)
         self.reinject_q = []
+        self._flush_adaptive_pool()
+
+    def _flush_adaptive_pool(self) -> None:
+        for laser, carrier in self.adaptive_parked:
+            laser.work_list.append(carrier)
+        self.adaptive_parked = []
 
     # -- the loop -------------------------------------------------------
 
@@ -773,6 +853,7 @@ class PipelinedRunner:
                 want_sync = bool(
                     micro_pending or self.reinject_q
                     or (self.seed_queue and free_owned)
+                    or (self.adaptive_parked and free_owned)
                 )
                 if (not want_sync and self.n_shards > 1
                         and stop is None and not deadline_hit):
@@ -941,8 +1022,15 @@ class PipelinedRunner:
                         stop = "timeout"
                     elif bail_now:
                         stop = "slow-bail"
+                    elif self._adaptive_coverage_stop():
+                        log.info(
+                            "frontier: coverage target reached; "
+                            "parking live paths"
+                        )
+                        stop = "coverage-target"
                     elif (live == 0 and not self.seed_queue
-                          and not self.reinject_q):
+                          and not self.reinject_q
+                          and not self.adaptive_parked):
                         stop = "done"
                     elif (self.arena_len + max(live, 1) * caps.R * 4
                           >= caps.ARENA):
@@ -977,6 +1065,15 @@ class PipelinedRunner:
                 if self.n_shards > 1:
                     moved = self._rebalance()
                     self._rebalance_backoff = 0 if moved else 2
+                self._adaptive_requeue()
+                if (self.adaptive_parked and not self.reinject_q
+                        and not self.seed_queue):
+                    live_now, _ = self._slot_masks()
+                    if not live_now.any():
+                        # nothing else runs and the plan declined the
+                        # pooled spills: hand them to the host engine
+                        # rather than spin on empty segments
+                        self._flush_adaptive_pool()
                 if self.reinject_q:
                     self._reinject()
                 self.refill()
@@ -1026,7 +1123,7 @@ class PipelinedRunner:
 
         if stop == "slow-bail":
             self.slow_bailed = True
-        if stop in ("timeout", "slow-bail", "arena-full"):
+        if stop in ("timeout", "slow-bail", "arena-full", "coverage-target"):
             self.width_verdict_valid = False
         live = int(((self.st.halt == O.H_RUNNING)
                     & (self.st.seed >= 0)).sum())

@@ -214,6 +214,25 @@ def _add_analysis_options(parser) -> None:
         "--no-pipeline (all four combinations yield the same issue set)",
     )
     group.add_argument(
+        "--no-adaptive",
+        action="store_false",
+        dest="adaptive",
+        default=True,
+        help="disable coverage-guided adaptive exploration (feedback "
+        "controller steering dispatch slots, requeues and concolic "
+        "flips at uncovered reachable edges); the issue set is "
+        "identical either way",
+    )
+    group.add_argument(
+        "--coverage-target",
+        type=float,
+        default=None,
+        metavar="PCT",
+        help="stop exploring once reachable-edge coverage reaches PCT "
+        "percent (or every explored code plateaus), instead of running "
+        "the full time/tx budget; requires the adaptive controller",
+    )
+    group.add_argument(
         "--solver-workers",
         type=int,
         default=2,
@@ -505,6 +524,13 @@ def create_parser() -> argparse.ArgumentParser:
         help="default per-request execution timeout (seconds)",
     )
     serve.add_argument(
+        "--coverage-target", type=float, default=None, metavar="PCT",
+        help="default coverage-target contract for submissions: stop "
+        "exploring a request once reachable-edge coverage reaches PCT "
+        "percent (or every explored code plateaus); the done event "
+        "carries coverage_target_met",
+    )
+    serve.add_argument(
         "--heartbeat-out", metavar="FILE",
         help="sample service queue depths into FILE as JSON lines",
     )
@@ -590,6 +616,12 @@ def create_parser() -> argparse.ArgumentParser:
     submit.add_argument(
         "--execution-timeout", type=int, default=None,
         help="override the service's default execution timeout (seconds)",
+    )
+    submit.add_argument(
+        "--coverage-target", type=float, default=None, metavar="PCT",
+        help="per-request coverage-target contract: terminate once "
+        "reachable-edge coverage reaches PCT percent (or exploration "
+        "plateaus); the done event carries coverage_target_met",
     )
     submit.add_argument(
         "-o", "--outform", choices=["text", "json"], default="text",
@@ -785,6 +817,8 @@ def _build_analyzer(parsed, query_signature: bool = False):
         devsolver_bit_budget=getattr(parsed, "devsolver_bit_budget", 64),
         devsolver_iters=getattr(parsed, "devsolver_iters", 2048),
         frontier_mesh=getattr(parsed, "frontier_mesh", True),
+        adaptive=getattr(parsed, "adaptive", True),
+        coverage_target=getattr(parsed, "coverage_target", None),
         solver_workers=getattr(parsed, "solver_workers", 2),
         harvest_workers=getattr(parsed, "harvest_workers", 4),
         compile_cache_dir=getattr(parsed, "compile_cache_dir", None),
@@ -1131,6 +1165,7 @@ def execute_command(parsed) -> None:
                 modules=modules,
                 strategy=parsed.strategy,
                 execution_timeout=parsed.execution_timeout,
+                coverage_target=getattr(parsed, "coverage_target", None),
             ),
             max_batch_width=parsed.batch_width,
             batch_window_s=parsed.batch_window,
@@ -1202,6 +1237,7 @@ def execute_command(parsed) -> None:
                 modules=modules,
                 execution_timeout=parsed.execution_timeout,
                 tenant=getattr(parsed, "tenant", None),
+                coverage_target=getattr(parsed, "coverage_target", None),
             ):
                 if as_json:
                     print(json.dumps(event), flush=True)
@@ -1221,9 +1257,16 @@ def execute_command(parsed) -> None:
                 elif kind == "error":
                     raise CriticalError(f"analysis failed: {event.get('error')}")
                 else:
+                    target_note = ""
+                    if "coverage_target_met" in event:
+                        target_note = (
+                            " [coverage target met]"
+                            if event["coverage_target_met"]
+                            else " [coverage target not met]"
+                        )
                     print(
                         f"done: {len(event.get('issues', []))} issues in "
-                        f"{event.get('elapsed_s')}s",
+                        f"{event.get('elapsed_s')}s{target_note}",
                         flush=True,
                     )
         except (ConnectionError, OSError) as e:

@@ -123,3 +123,8 @@ def reset_analysis_metrics() -> None:
     """
     get_registry().reset()
     get_exploration_ledger().reset_scope()
+    # the adaptive controller's plan cache / coverage history / latched
+    # coverage-stop verdict all describe the scope being swept
+    from mythril_tpu.adaptive import get_adaptive_controller
+
+    get_adaptive_controller().reset_scope()

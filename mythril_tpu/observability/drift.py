@@ -122,6 +122,17 @@ _SPECS: List[Tuple[str, Callable[[Dict[str, Any]], Optional[float]],
      lambda r: _get(r, ("staticpass", "reachable_edge_pct")), None, 1.0),
     ("device_residency_pct", lambda r: _get(r, ("device_residency_pct",)),
      True, 1.0),
+    # adaptive steering: fewer dispatched segments at equal issue sets is
+    # the controller doing its job; resteer/requeue volume is reported
+    # neutrally (more steering is not inherently better or worse)
+    ("segments_dispatched", lambda r: _get(r, ("segments_dispatched",)),
+     False, 1.5),
+    ("adaptive.resteered_slots",
+     lambda r: _get(r, ("adaptive", "resteered_slots")), None, 1.0),
+    ("adaptive.requeued_paths",
+     lambda r: _get(r, ("adaptive", "requeued_paths")), None, 1.0),
+    ("adaptive.flip_hit_rate",
+     lambda r: _get(r, ("adaptive", "flip_hit_rate")), True, 1.0),
     ("spread.production.width_pct", _spread_width, False, 1.0),
 ]
 

@@ -420,6 +420,30 @@ class ExplorationLedger:
             }
         return out
 
+    def bitmaps(self) -> Dict[str, Dict[str, Any]]:
+        """Per-codehash coverage/reachability arrays, COPIED out under the
+        lock — the adaptive planner's raw input.  Each entry carries the
+        executed planes, the static reachability masks (or None when no
+        summary was registered), and the denominators; callers own the
+        copies and may mutate them freely."""
+        with self._lock:
+            return {
+                h: {
+                    "total": c.total,
+                    "jumpis": c.jumpis,
+                    "instr": c.instr.copy(),
+                    "edge_taken": c.edge_taken.copy(),
+                    "edge_fall": c.edge_fall.copy(),
+                    "reach_instr": None if c.reach_instr is None
+                    else c.reach_instr.copy(),
+                    "reach_taken": None if c.reach_taken is None
+                    else c.reach_taken.copy(),
+                    "reach_fall": None if c.reach_fall is None
+                    else c.reach_fall.copy(),
+                }
+                for h, c in self._codes.items()
+            }
+
     def reset_scope(self) -> None:
         """Per-analysis sweep (the registry counters reset separately via
         ``reset_analysis_metrics``; this clears the bitmap side)."""

@@ -94,6 +94,7 @@ class ServiceClient:
         strategy: Optional[str] = None,
         execution_timeout: Optional[int] = None,
         tenant: Optional[str] = None,
+        coverage_target: Optional[float] = None,
     ) -> Iterator[Dict[str, Any]]:
         """Yield event dicts: ``accepted``, ``issue``*, ``done``/``error``."""
         msg: Dict[str, Any] = {"op": "submit", "code": code, "tier": tier}
@@ -109,6 +110,8 @@ class ServiceClient:
             msg["strategy"] = strategy
         if execution_timeout is not None:
             msg["execution_timeout"] = execution_timeout
+        if coverage_target is not None:
+            msg["coverage_target"] = coverage_target
         terminal = False
         for event in self._roundtrip(msg):
             yield event
@@ -140,7 +143,7 @@ class ServiceClient:
         if tenant:
             msg["tenant"] = tenant
         for key in ("transaction_count", "modules", "strategy",
-                    "execution_timeout"):
+                    "execution_timeout", "coverage_target"):
             if options.get(key) is not None:
                 msg[key] = options[key]
         for event in self._roundtrip(msg):

@@ -72,16 +72,20 @@ def options_key(
     modules: Optional[Sequence[str]] = None,
     strategy: str = "bfs",
     execution_timeout: int = 60,
+    coverage_target: Optional[float] = None,
 ) -> Tuple:
     """Hashable key over the options that can change an issue set.
 
     Module order is presentation (the loader filters a fixed registry),
     so the key sorts it.  Requests with equal keys are batch-compatible:
     the cooperative sweep runs one shared configuration per batch.
+    A coverage target changes WHEN exploration stops, so it is part of
+    the key (target-bounded and budget-bounded runs must not dedup).
     """
     mods = tuple(sorted(modules)) if modules else None
     return (int(transaction_count), mods, str(strategy),
-            int(execution_timeout))
+            int(execution_timeout),
+            float(coverage_target) if coverage_target is not None else None)
 
 
 def issue_digest(issue) -> Tuple:

@@ -80,7 +80,11 @@ from mythril_tpu.service.request import (
     TIER_INTERACTIVE,
     issue_to_wire,
 )
-from mythril_tpu.service.scheduling import AdmissionRejected, SchedulerPolicy
+from mythril_tpu.service.scheduling import (
+    AdmissionRejected,
+    SchedulerPolicy,
+    validate_coverage_target,
+)
 from mythril_tpu.service.telemetry import RequestTelemetry
 
 log = logging.getLogger(__name__)
@@ -217,6 +221,15 @@ class AnalysisService:
             for name in ("admitted", "decided_sat", "decided_unsat",
                          "unknown", "model_validation_failures")
         }
+        # adaptive-controller mirrors, same scope-reset/persistent-delta
+        # contract; coverage_stop keeps the most recent batch's latched
+        # verdict for stats()/top
+        self._c_adaptive = {
+            name: reg.counter("service.adaptive_" + name, persistent=True)
+            for name in ("plans", "resteered_slots", "requeued_paths",
+                         "flips_planned", "flips_hit", "plateau_stops")
+        }
+        self._last_coverage_stop: Optional[Dict[str, Any]] = None
         # exploration-ledger mirrors: termination classes and pc-overflow
         # deltas accumulate here across batches (the scoped exploration.*
         # counters reset per analysis); per-contract coverage keeps the
@@ -513,6 +526,10 @@ class AnalysisService:
             raise RuntimeError("service is not accepting submissions")
         if tier not in (TIER_BATCH, TIER_INTERACTIVE):
             raise ValueError(f"unknown tier {tier!r}")
+        # refuse a nonsense coverage bar at submit, before any budget burns
+        validate_coverage_target(
+            (options or self.config.default_options).coverage_target
+        )
         raw = normalize_code(code)
         codehash = canonical_codehash(raw)
         request = AnalysisRequest(
@@ -667,6 +684,29 @@ class AnalysisService:
             "model_validation_failures": out[
                 "service.devsolver_model_validation_failures"] or 0,
             "decide_rate": round(ds_dec / ds_adm, 4) if ds_adm else 0.0,
+        }
+        # adaptive steering rollup: persistent mirrors of the scoped
+        # adaptive.* counters, plus the most recent coverage-stop verdict
+        flips_planned = int(
+            self._c_adaptive["flips_planned"].snapshot() or 0
+        )
+        flips_hit = int(self._c_adaptive["flips_hit"].snapshot() or 0)
+        out["adaptive"] = {
+            "plans": int(self._c_adaptive["plans"].snapshot() or 0),
+            "resteered_slots": int(
+                self._c_adaptive["resteered_slots"].snapshot() or 0
+            ),
+            "requeued_paths": int(
+                self._c_adaptive["requeued_paths"].snapshot() or 0
+            ),
+            "flips_planned": flips_planned,
+            "flips_hit": flips_hit,
+            "flip_hit_rate": round(flips_hit / flips_planned, 4)
+            if flips_planned else 0.0,
+            "plateau_stops": int(
+                self._c_adaptive["plateau_stops"].snapshot() or 0
+            ),
+            "coverage_stop": self._last_coverage_stop,
         }
         from mythril_tpu.observability.exploration import TERM_CLASSES
 
@@ -909,6 +949,28 @@ class AnalysisService:
         finally:
             self._fold_exploration(delta)
 
+    @contextlib.contextmanager
+    def _account_adaptive(self, out: Dict[str, Any]):
+        """Fold this scope's adaptive-controller activity into the
+        persistent service mirrors — same pattern as
+        ``_account_prefilter``.  ``out`` also carries the scope-end
+        ``coverage_stop`` verdict to the caller (``_run_batch`` stamps
+        it into the done payload)."""
+        try:
+            with self._ctx.adaptive_delta(out):
+                yield
+        finally:
+            self._fold_adaptive(out)
+
+    def _fold_adaptive(self, delta: Dict[str, Any]) -> None:
+        if not delta:
+            return
+        for name, counter in self._c_adaptive.items():
+            if delta.get(name):
+                counter.inc(delta[name])
+        if delta.get("coverage_stop"):
+            self._last_coverage_stop = dict(delta["coverage_stop"])
+
     def _fold_exploration(self, delta: Dict[str, Any]) -> None:
         """Merge one batch's exploration delta (inline scope or a pool
         worker's done payload) into the persistent mirrors."""
@@ -946,6 +1008,7 @@ class AnalysisService:
 
     def _run_batch(self, batch: List[Flight]) -> None:
         from mythril_tpu.analysis.cooperative import run_cooperative_batch
+        from mythril_tpu.support.support_args import args as engine_args
 
         t0 = time.perf_counter()
         self._c_batches.inc()
@@ -977,21 +1040,31 @@ class AnalysisService:
                 self._scope_reset()
 
             self._stamp_batch(batch, "execute0", "execute")
+            adaptive_out: Dict[str, Any] = {}
             with self._account_prefilter(), self._account_devsolver(), \
                     self._account_exploration(), \
+                    self._account_adaptive(adaptive_out), \
                     self._ctx.sink_scope(
                 self._make_sink(by_hash, streamed, "device", sink_lock)
             ):
-                issues_by_name, errors_by_name, _states = run_cooperative_batch(
-                    [(f.codehash, f.requests[0].code) for f in batch],
-                    transaction_count=opts.transaction_count,
-                    modules=list(opts.modules) if opts.modules else None,
-                    strategy=opts.strategy,
-                    execution_timeout=opts.execution_timeout,
-                    isolate_errors=True,
-                    request_tags=request_ids,
-                    request_flow_cb=flow_cb,
-                )
+                # the coverage-target contract rides the engine-global
+                # args (the frontier/svm loops poll it mid-run); scoped
+                # to this batch, restored before the next one
+                prev_target = engine_args.coverage_target
+                engine_args.coverage_target = opts.coverage_target
+                try:
+                    issues_by_name, errors_by_name, _states = run_cooperative_batch(
+                        [(f.codehash, f.requests[0].code) for f in batch],
+                        transaction_count=opts.transaction_count,
+                        modules=list(opts.modules) if opts.modules else None,
+                        strategy=opts.strategy,
+                        execution_timeout=opts.execution_timeout,
+                        isolate_errors=True,
+                        request_tags=request_ids,
+                        request_flow_cb=flow_cb,
+                    )
+                finally:
+                    engine_args.coverage_target = prev_target
             self._stamp_batch(batch, "execute1", "stream")
 
         elapsed = time.perf_counter() - t0
@@ -1004,9 +1077,17 @@ class AnalysisService:
             ]
             for f in batch
         }
+        coverage_target_met = None
+        if opts.coverage_target is not None:
+            stop = adaptive_out.get("coverage_stop")
+            coverage_target_met = bool(
+                stop and stop.get("coverage_target_met")
+            )
         self._finalize_batch(
             batch, streamed, wires_by_hash, dict(errors_by_name),
             elapsed=elapsed, device_wall=device_wall, sink_lock=sink_lock,
+            coverage_target=opts.coverage_target,
+            coverage_target_met=coverage_target_met,
         )
         log.info(
             "service batch of %d done in %.2fs (%d errored)",
@@ -1023,6 +1104,8 @@ class AnalysisService:
         elapsed: float,
         device_wall: float,
         sink_lock: Optional[threading.Lock] = None,
+        coverage_target: Optional[float] = None,
+        coverage_target_met: Optional[bool] = None,
     ) -> None:
         """Shared terminal path for inline batches and pool jobs:
         stream any late findings, emit terminal events, retire flights,
@@ -1058,18 +1141,25 @@ class AnalysisService:
             if flight.interactive and flight.first_issue_source is not None:
                 (self._c_probe_wins if flight.first_issue_source == "probe"
                  else self._c_device_wins).inc()
-            flight.emit("done", {
+            done_payload: Dict[str, Any] = {
                 "codehash": flight.codehash,
                 "issues": wires,
                 "elapsed_s": round(elapsed, 3),
                 "batch_width": len(batch),
-            })
+            }
+            if coverage_target is not None:
+                done_payload["coverage_target"] = coverage_target
+                done_payload["coverage_target_met"] = bool(
+                    coverage_target_met
+                )
+            flight.emit("done", done_payload)
             self.admission.finish(flight)
             self._finish_requests(
                 flight, flight_requests, "done",
                 n_issues=len(wires),
                 digests=[issue_digest(w) for w in wires],
                 batch_width=len(batch), compute_share=share,
+                coverage_target_met=coverage_target_met,
             )
 
     def _stamp_batch(self, batch: List[Flight], stamp: Optional[str],
@@ -1088,7 +1178,8 @@ class AnalysisService:
                          requests: List[AnalysisRequest], event: str,
                          *, n_issues: int = 0, digests=None,
                          batch_width: Optional[int] = None,
-                         compute_share: float = 0.0) -> None:
+                         compute_share: float = 0.0,
+                         coverage_target_met: Optional[bool] = None) -> None:
         primary = flight.requests[0]
         coverage_pct = self._coverage_of(flight.codehash)
         coverage_pct_reachable = self._coverage_reach_by_hash.get(
@@ -1102,6 +1193,7 @@ class AnalysisService:
                 deduped=req is not primary,
                 coverage_pct=coverage_pct,
                 coverage_pct_reachable=coverage_pct_reachable,
+                coverage_target_met=coverage_target_met,
             )
 
     def _probe(
@@ -1268,15 +1360,24 @@ class AnalysisService:
             self._c_pf_kill.inc(pf["killed"])
         self._fold_devsolver(payload.get("devsolver") or {})
         self._fold_exploration(payload.get("exploration") or {})
+        adaptive = payload.get("adaptive") or {}
+        self._fold_adaptive(adaptive)
         for wall in payload.get("probe_s") or []:
             self._c_probe_runs.inc()
             self._h_probe.observe(wall)
+        target = batch[0].options.coverage_target
+        met = None
+        if target is not None:
+            stop = adaptive.get("coverage_stop")
+            met = bool(stop and stop.get("coverage_target_met"))
         self._finalize_batch(
             batch, job["streamed"],
             payload.get("issues") or {},
             payload.get("errors") or {},
             elapsed=elapsed,
             device_wall=float(payload.get("elapsed_s") or 0.0),
+            coverage_target=target,
+            coverage_target_met=met,
         )
         log.info(
             "pool job on worker %d: batch of %d done in %.2fs (%d errored)",

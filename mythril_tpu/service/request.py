@@ -54,6 +54,11 @@ class AnalysisOptions:
     modules: Optional[Tuple[str, ...]] = None
     strategy: str = "bfs"
     execution_timeout: int = 60
+    # explore-to-a-coverage-bar contract (--coverage-target): terminate
+    # once reachable coverage reaches this percent or all explored codes
+    # plateau.  Part of the dedup key: a target-bounded run may terminate
+    # earlier than a budget-bounded one, so their results must not alias
+    coverage_target: Optional[float] = None
 
     def key(self) -> Tuple:
         from mythril_tpu.service.codehash import options_key
@@ -63,6 +68,7 @@ class AnalysisOptions:
             self.modules,
             self.strategy,
             self.execution_timeout,
+            self.coverage_target,
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -72,15 +78,18 @@ class AnalysisOptions:
             "modules": list(self.modules) if self.modules else None,
             "strategy": self.strategy,
             "execution_timeout": self.execution_timeout,
+            "coverage_target": self.coverage_target,
         }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "AnalysisOptions":
+        target = d.get("coverage_target")
         return cls(
             transaction_count=int(d.get("transaction_count", 2)),
             modules=tuple(d["modules"]) if d.get("modules") else None,
             strategy=d.get("strategy", "bfs"),
             execution_timeout=int(d.get("execution_timeout", 60)),
+            coverage_target=float(target) if target is not None else None,
         )
 
 

@@ -25,6 +25,16 @@ workers never see it — and has three independent levers:
 
 ``AdmissionRejected`` is a ``RuntimeError`` so every existing transport
 path (server error event, client exception) reports it unchanged.
+
+This module also owns the **coverage-target contract** validation
+(:func:`validate_coverage_target`): ``--coverage-target PCT`` turns a
+request's termination condition from "flat tx/time budget" into
+"reachable coverage reached the bar, or all explored codes plateaued".
+The adaptive controller renders the verdict mid-run; the daemon stamps
+``coverage_target_met`` into the request's done meta and request-log
+line.  Validation lives here — with the other admission-time request
+checks — so a nonsense bar is refused at submit, not discovered after a
+full exploration budget burned.
 """
 
 from __future__ import annotations
@@ -33,7 +43,34 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-__all__ = ["AdmissionRejected", "SchedulerPolicy"]
+__all__ = [
+    "AdmissionRejected",
+    "SchedulerPolicy",
+    "validate_coverage_target",
+]
+
+
+def validate_coverage_target(pct) -> Optional[float]:
+    """Normalize a ``--coverage-target`` value (percent in (0, 100]).
+
+    None/empty passes through (no contract); anything unparseable or out
+    of range raises :class:`AdmissionRejected` so the submitter sees a
+    one-line refusal immediately."""
+    if pct is None or pct == "":
+        return None
+    try:
+        val = float(pct)
+    except (TypeError, ValueError):
+        raise AdmissionRejected(
+            f"invalid coverage target {pct!r} (expected a percent)",
+            kind="coverage_target",
+        )
+    if not 0.0 < val <= 100.0:
+        raise AdmissionRejected(
+            f"coverage target {val} out of range (0, 100]",
+            kind="coverage_target",
+        )
+    return val
 
 
 class AdmissionRejected(RuntimeError):

@@ -125,9 +125,10 @@ class _Handler(socketserver.StreamRequestHandler):
             options = None
             if any(k in msg for k in (
                 "transaction_count", "modules", "strategy",
-                "execution_timeout",
+                "execution_timeout", "coverage_target",
             )):
                 base = service.config.default_options
+                raw_target = msg.get("coverage_target", base.coverage_target)
                 options = AnalysisOptions(
                     transaction_count=int(
                         msg.get("transaction_count", base.transaction_count)
@@ -138,6 +139,8 @@ class _Handler(socketserver.StreamRequestHandler):
                     execution_timeout=int(
                         msg.get("execution_timeout", base.execution_timeout)
                     ),
+                    coverage_target=float(raw_target)
+                    if raw_target is not None else None,
                 )
             request, stream, deduped = service.submit(
                 msg.get("code", ""),

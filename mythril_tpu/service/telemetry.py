@@ -165,6 +165,7 @@ class RequestTelemetry:
         replayed: bool = False,
         coverage_pct: Optional[float] = None,
         coverage_pct_reachable: Optional[float] = None,
+        coverage_target_met: Optional[bool] = None,
     ) -> None:
         """Finalize one request at its terminal event (idempotent).
 
@@ -172,7 +173,11 @@ class RequestTelemetry:
         percentage for the request's contract (None when the engine never
         produced one — rejected/replayed requests);
         ``coverage_pct_reachable`` is the same percentage quoted against
-        the statically reachable denominator (staticpass oracle)."""
+        the statically reachable denominator (staticpass oracle).
+        ``coverage_target_met`` is the --coverage-target verdict: True
+        when the adaptive controller ended exploration at the bar (or on
+        plateau), False when the budget ran out first, None when the
+        request carried no target."""
         with self._lock:
             entry = self._active.pop(request.request_id, None)
         if entry is None:
@@ -202,7 +207,8 @@ class RequestTelemetry:
                        n_issues=n_issues, digests=digests,
                        batch_width=batch_width, deduped=deduped,
                        replayed=replayed, coverage_pct=coverage_pct,
-                       coverage_pct_reachable=coverage_pct_reachable)
+                       coverage_pct_reachable=coverage_pct_reachable,
+                       coverage_target_met=coverage_target_met)
         # pool mode allocates flows per request (adopt_worker_flow), not
         # per batch, so retire the binding here to keep the table bounded
         with self._lock:
@@ -299,7 +305,8 @@ class RequestTelemetry:
 
     def _log_line(self, request, entry, phases, event, *, n_issues,
                   digests, batch_width, deduped, replayed,
-                  coverage_pct=None, coverage_pct_reachable=None) -> None:
+                  coverage_pct=None, coverage_pct_reachable=None,
+                  coverage_target_met=None) -> None:
         if self._log_file is None:
             return
         rec = {
@@ -319,6 +326,8 @@ class RequestTelemetry:
             "coverage_pct": coverage_pct,
             "coverage_pct_reachable": coverage_pct_reachable,
         }
+        if coverage_target_met is not None:
+            rec["coverage_target_met"] = coverage_target_met
         line = json.dumps(rec, default=repr) + "\n"
         with self._log_lock:
             if self._log_file is not None:

@@ -192,6 +192,28 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
         if classes:
             lines.append("  " + classes)
 
+    adaptive = stats.get("adaptive") or {}
+    if adaptive.get("plans"):
+        line = (
+            "adaptive: {p} plans  {s} resteered  {q} requeued  "
+            "flips {h}/{f}".format(
+                p=adaptive.get("plans", 0),
+                s=adaptive.get("resteered_slots", 0),
+                q=adaptive.get("requeued_paths", 0),
+                h=adaptive.get("flips_hit", 0),
+                f=adaptive.get("flips_planned", 0),
+            )
+        )
+        if adaptive.get("plateau_stops"):
+            line += f"  plateau-stops {adaptive['plateau_stops']}"
+        stop = adaptive.get("coverage_stop") or {}
+        if stop:
+            line += "  last-stop {r}@{c:.1f}%".format(
+                r=stop.get("reason", "?"),
+                c=float(stop.get("coverage_pct_reachable") or 0.0),
+            )
+        lines.append(line)
+
     staticpass = stats.get("staticpass") or {}
     disabled = staticpass.get("gate_disabled") or {}
     if disabled:

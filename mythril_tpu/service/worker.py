@@ -169,6 +169,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
     prefilter: Dict[str, int] = {}
     devsolver: Dict[str, int] = {}
     exploration: Dict[str, Any] = {}
+    adaptive: Dict[str, Any] = {}
 
     def _note_first(source):
         base = _make_sink(event_q, worker_id, job_id, streamed, source)
@@ -184,6 +185,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
     with ctx.prefilter_delta(prefilter), \
             ctx.devsolver_delta(devsolver), \
             ctx.exploration_delta(exploration), \
+            ctx.adaptive_delta(adaptive), \
             tracer.span("service.worker_batch", cat="service",
                         job=job_id, width=len(flights)):
         # flow.request arrows across the process seam: emit the "f"
@@ -227,15 +229,24 @@ def _run_job(ctx, worker_id: int, job_id: int,
                 ctx.reset_scope()
 
         with ctx.sink_scope(_note_first("device")):
-            issues_by_name, errors_by_name, _states = run_cooperative_batch(
-                [(f["codehash"], f["code"]) for f in flights],
-                transaction_count=opts.transaction_count,
-                modules=list(opts.modules) if opts.modules else None,
-                strategy=opts.strategy,
-                execution_timeout=opts.execution_timeout,
-                isolate_errors=True,
-                request_tags=[f["request_id"] for f in flights],
-            )
+            # coverage-target contract rides the engine-global args for
+            # the authoritative pass only (the probe stays budget-bound)
+            from mythril_tpu.support.support_args import args as engine_args
+
+            prev_target = engine_args.coverage_target
+            engine_args.coverage_target = opts.coverage_target
+            try:
+                issues_by_name, errors_by_name, _states = run_cooperative_batch(
+                    [(f["codehash"], f["code"]) for f in flights],
+                    transaction_count=opts.transaction_count,
+                    modules=list(opts.modules) if opts.modules else None,
+                    strategy=opts.strategy,
+                    execution_timeout=opts.execution_timeout,
+                    isolate_errors=True,
+                    request_tags=[f["request_id"] for f in flights],
+                )
+            finally:
+                engine_args.coverage_target = prev_target
 
     elapsed = time.perf_counter() - t0
     # persistent: survives the per-batch analysis-scope sweep, so the
@@ -266,6 +277,7 @@ def _run_job(ctx, worker_id: int, job_id: int,
         "prefilter": dict(prefilter),
         "devsolver": dict(devsolver),
         "exploration": dict(exploration),
+        "adaptive": dict(adaptive),
         "probe_s": probe_walls,
         "first_source": first_source,
     }))

@@ -126,6 +126,19 @@ class Args:
     # cache under <root>/xla (facade/warm.resolve_cache_root); explicit
     # per-cache dirs win over the derivation
     cache_root: Optional[str] = None
+    # coverage-guided adaptive exploration (mythril_tpu/adaptive): the
+    # feedback controller that re-steers frontier dispatch slots at
+    # uncovered reachable edges, resurrects budget-parked paths when
+    # slots free, and targets concolic flips.  A scheduling-only
+    # optimization — the issue set is bit-identical either way;
+    # --no-adaptive is the escape hatch (and the parity baseline for
+    # bench.py --adaptive-compare)
+    adaptive: bool = True
+    # terminate exploration once reachable-edge/instruction coverage
+    # reaches this percent (or all explored codes plateau below it):
+    # the "explore to a coverage bar" request contract.  None = explore
+    # to the transaction/time budget as before
+    coverage_target: Optional[float] = None
     # flight deck (mythril_tpu/observability): heartbeat JSONL of sampled
     # queue depths, sampler period, flight-recorder bundle directory, and
     # the watchdog deadline (seconds without a completed segment before a

@@ -1,0 +1,253 @@
+"""Property tests for the pure steering planner.
+
+``hypothesis`` is not part of the toolchain, so each property runs over a
+seeded ``numpy.random.default_rng`` sweep — deterministic, wide enough to
+exercise the edge cases the ISSUE contract names:
+
+* ``steer_weights`` always emits a valid probability distribution,
+* ``requeue_candidates`` never names a live (or duplicate) token,
+* ``plateau_verdict`` is monotone under coverage growth.
+"""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.adaptive.plan import (
+    EPS_WEIGHT,
+    PLATEAU_EPSILON,
+    PLATEAU_WINDOW,
+    SteeringPlan,
+    build_plan,
+    plateau_verdict,
+    rank_flip_targets,
+    requeue_candidates,
+    steer_weights,
+    uncovered_reachable,
+)
+
+RNG = np.random.default_rng(0xC0FFEE)
+
+
+def _random_uncovered(rng, n_codes):
+    return {
+        "%040x" % rng.integers(0, 1 << 62): int(rng.integers(-2, 500))
+        for _ in range(n_codes)
+    }
+
+
+class TestSteerWeights:
+    def test_empty(self):
+        assert steer_weights({}) == {}
+
+    def test_valid_distribution_randomized(self):
+        """Weights are a valid distribution for ANY input mix: strictly
+        positive (epsilon floor, no starvation) and summing to 1."""
+        for trial in range(200):
+            n = int(RNG.integers(1, 12))
+            uncovered = _random_uncovered(RNG, n)
+            plateaued = {k: bool(RNG.integers(0, 2)) for k in uncovered}
+            hotspots = {
+                k: float(RNG.uniform(0, 30))
+                for k in uncovered if RNG.integers(0, 2)
+            }
+            w = steer_weights(uncovered, plateaued, hotspots)
+            assert set(w) == set(uncovered)
+            vals = np.asarray(list(w.values()))
+            assert (vals > 0).all(), f"starved a code at trial {trial}: {w}"
+            assert vals.sum() == pytest.approx(1.0, abs=1e-9)
+
+    def test_deterministic_and_order_invariant(self):
+        uncovered = _random_uncovered(RNG, 6)
+        plateaued = {k: i % 2 == 0 for i, k in enumerate(uncovered)}
+        w1 = steer_weights(uncovered, plateaued)
+        w2 = steer_weights(
+            dict(reversed(list(uncovered.items()))), plateaued
+        )
+        assert w1 == w2
+
+    def test_uncovered_mass_attracts_weight(self):
+        w = steer_weights({"a" * 40: 100, "b" * 40: 1})
+        assert w["a" * 40] > w["b" * 40]
+
+    def test_plateaued_code_decays_to_floor(self):
+        """A plateaued code never out-weighs any non-plateaued code,
+        whatever its uncovered mass — but keeps a positive share."""
+        for _ in range(50):
+            uncovered = _random_uncovered(RNG, 5)
+            keys = sorted(uncovered)
+            flat = keys[0]
+            uncovered[flat] = 10_000  # huge mass, then flat-lined
+            w = steer_weights(uncovered, {flat: True})
+            assert w[flat] > 0
+            assert all(w[flat] <= w[k] + 1e-12 for k in keys[1:])
+
+    def test_saturated_code_decays_to_floor(self):
+        w = steer_weights({"a" * 40: 0, "b" * 40: 50})
+        assert 0 < w["a" * 40] < w["b" * 40]
+
+    def test_hotspot_damping(self):
+        """Equal uncovered mass: the code eating the solver wall yields."""
+        hot, cold = "a" * 40, "b" * 40
+        w = steer_weights({hot: 50, cold: 50}, hotspot_s={hot: 10.0})
+        assert w[hot] < w[cold]
+
+    def test_floor_scales_with_eps(self):
+        uncovered = {"a" * 40: 0, "b" * 40: 1000}
+        lo = steer_weights(uncovered, eps=0.01)["a" * 40]
+        hi = steer_weights(uncovered, eps=0.25)["a" * 40]
+        assert lo < hi
+        assert EPS_WEIGHT == pytest.approx(0.05)
+
+
+class TestRequeueCandidates:
+    def test_never_names_live_tokens_randomized(self):
+        """Exactly-once: whatever the park log looks like, a token that
+        is currently live in an arena slot is never resurrected."""
+        reasons = ("budget_exhausted", "verdict", "loop_bound", "pruned")
+        for _ in range(200):
+            n = int(RNG.integers(0, 40))
+            parked = [
+                (int(RNG.integers(0, 20)),
+                 reasons[int(RNG.integers(0, len(reasons)))])
+                for _ in range(n)
+            ]
+            live = {int(t) for t in RNG.integers(0, 20, size=6)}
+            out = requeue_candidates(parked, live,
+                                     limit=int(RNG.integers(0, 10)))
+            assert not (set(out) & live)
+            assert len(out) == len(set(out))  # no duplicates
+            assert all(
+                any(t == tok and r == "budget_exhausted"
+                    for t, r in parked)
+                for tok in out
+            )
+
+    def test_fifo_order_and_limit(self):
+        parked = [(i, "budget_exhausted") for i in range(10)]
+        assert requeue_candidates(parked, (), limit=4) == [0, 1, 2, 3]
+        assert requeue_candidates(parked, {0, 2}, limit=4) == [1, 3, 4, 5]
+
+    def test_only_budget_exhausted_qualifies(self):
+        parked = [(1, "verdict"), (2, "budget_exhausted"), (3, "pruned")]
+        assert requeue_candidates(parked, ()) == [2]
+
+
+class TestPlateauVerdict:
+    def test_short_history_never_plateaus(self):
+        for n in range(PLATEAU_WINDOW + 1):
+            assert plateau_verdict([50.0] * n) is False
+
+    def test_flat_history_plateaus(self):
+        assert plateau_verdict([50.0] * (PLATEAU_WINDOW + 2)) is True
+
+    def test_monotone_under_coverage_growth_randomized(self):
+        """The ISSUE contract: the verdict is monotone in the window's
+        total gain — appending a sample that lifts the gain to epsilon
+        or more ALWAYS clears a standing plateau."""
+        for _ in range(200):
+            n = int(RNG.integers(PLATEAU_WINDOW + 1, PLATEAU_WINDOW + 12))
+            # non-decreasing coverage history (coverage never regresses)
+            hist = list(np.cumsum(RNG.uniform(0, 0.2, size=n)))
+            verdict = plateau_verdict(hist)
+            gain = hist[-1] - hist[-1 - PLATEAU_WINDOW]
+            assert verdict == (gain < PLATEAU_EPSILON)
+            if verdict:
+                # growth >= epsilon within the window clears it (the
+                # 1e-9 absorbs float cancellation in x + eps - x)
+                lifted = hist + [hist[-1 - PLATEAU_WINDOW + 1]
+                                 + PLATEAU_EPSILON + 1e-9]
+                assert plateau_verdict(lifted) is False
+
+    def test_growth_keeps_exploring(self):
+        hist = [10.0, 20.0, 30.0, 40.0, 50.0, 60.0]
+        assert plateau_verdict(hist) is False
+
+    def test_window_zero_disables(self):
+        assert plateau_verdict([1.0] * 50, window=0) is False
+
+
+class TestUncoveredReachable:
+    def test_no_oracle_uses_seen_branch_sites(self):
+        taken = np.zeros(8, bool)
+        fall = np.zeros(8, bool)
+        taken[3] = True  # JUMPI at 3: taken seen, fall not
+        fall[5] = taken[5] = True  # JUMPI at 5: exhausted
+        un_taken, un_fall, n_instr = uncovered_reachable({
+            "instr": np.ones(8, bool), "edge_taken": taken,
+            "edge_fall": fall,
+        })
+        assert list(un_taken) == []
+        assert list(un_fall) == [3]
+        assert n_instr == 0
+
+    def test_oracle_masks_bound_the_frontier(self):
+        instr = np.zeros(8, bool)
+        instr[:4] = True
+        reach = np.ones(8, bool)
+        un_taken, un_fall, n_instr = uncovered_reachable({
+            "instr": instr,
+            "edge_taken": np.zeros(8, bool),
+            "edge_fall": np.zeros(8, bool),
+            "reach_taken": np.array([0, 0, 1, 0, 0, 0, 0, 0], bool),
+            "reach_fall": np.array([0, 0, 1, 0, 0, 0, 0, 0], bool),
+            "reach_instr": reach,
+        })
+        assert list(un_taken) == [2]
+        assert list(un_fall) == [2]
+        assert n_instr == 4  # 8 reachable, 4 executed
+
+
+class TestRankFlipTargets:
+    def test_empty(self):
+        assert rank_flip_targets(np.array([]), np.array([])) == ()
+
+    def test_score_then_addr_deterministic(self):
+        pts = [{"addr": 30, "score": 5.0}, {"addr": 100, "score": 1.0}]
+        un = np.array([10, 40, 90])
+        # 10 and 40... 10 sees max(5,1)=5, 40 sees 1? no: points at/after
+        # 10 -> {30:5, 100:1} max 5; after 40 -> {100:1}; after 90 -> 1
+        out = rank_flip_targets(un, np.array([]), pts)
+        assert out == (10, 40, 90)
+        # determinism across repeated calls
+        assert out == rank_flip_targets(un, np.array([]), pts)
+
+    def test_limit(self):
+        un = np.arange(100)
+        out = rank_flip_targets(un, np.array([]), limit=7)
+        assert len(out) == 7
+
+
+class TestBuildPlan:
+    def _bitmap(self, n=8, jumpis=(3,)):
+        taken = np.zeros(n, bool)
+        fall = np.zeros(n, bool)
+        for j in jumpis:
+            taken[j] = True  # taken seen, fall uncovered
+        return {
+            "instr": np.ones(n, bool), "edge_taken": taken,
+            "edge_fall": fall, "jumpis": list(jumpis), "total": n,
+        }
+
+    def test_composes_all_products(self):
+        h1, h2 = "a" * 40, "b" * 40
+        plan = build_plan(
+            {h1: self._bitmap(), h2: self._bitmap(jumpis=(2, 5))},
+            history={h1: [50.0] * (PLATEAU_WINDOW + 2)},
+            parked=[("tok1", "budget_exhausted"), ("tok2", "verdict")],
+            live=(),
+            points={h1: ({"addr": 6, "score": 3.0},)},
+        )
+        assert isinstance(plan, SteeringPlan)
+        assert set(plan.weights) == {h1, h2}
+        assert plan.plateaued[h1] is True and plan.plateaued[h2] is False
+        assert plan.weights[h2] > plan.weights[h1]
+        assert plan.requeue == ("tok1",)
+        assert plan.flip_targets[h1] == (3,)
+        assert plan.uncovered_edges == {h1: 1, h2: 2}
+
+    def test_weight_accessor_defaults(self):
+        plan = SteeringPlan()
+        assert plan.weight("anything") == 1.0
+        plan = build_plan({"a" * 40: self._bitmap(),
+                           "b" * 40: self._bitmap()})
+        assert plan.weight("unknown") == pytest.approx(0.5)
