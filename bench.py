@@ -936,6 +936,131 @@ def adaptive_compare() -> dict:
     return {"metric": "adaptive_compare", "workloads": results}
 
 
+def paging_compare() -> dict:
+    """Large-code frontier on-vs-off parity on mixed-size batches.
+
+    Runs each workload twice with the device frontier forced on — once
+    with per-code bucket isolation + packed-code paging (the defaults),
+    once under ``--no-code-paging`` semantics (one corpus-wide bucket,
+    everything fully resident) — and asserts the optimization contract:
+    the issue sets are BIT-IDENTICAL (paging only changes which window
+    of a code is device-resident; a cold jump degrades to an ordinary
+    host park, and the host engine is always correct), the isolated run
+    actually split the corpus into >1 bucket class with strictly lower
+    pad waste than the single-bucket counterfactual, and the paged
+    workload actually faulted and repacked at least once.  Mirrors
+    ``adaptive_compare``; one JSON-able dict per run."""
+    from mythril_tpu.analysis.cooperative import analyze_cooperative
+    from mythril_tpu.observability import get_registry
+    from mythril_tpu.support.support_args import args as global_args
+
+    def issue_set(per_name):
+        return sorted(
+            (name, i.swc_id, i.address, i.bytecode_hash)
+            for name, issues in per_name.items()
+            for i in issues
+        )
+
+    suicide = bytes.fromhex("60003560e01c6341c0e1b51460145760006000fd5b33ff")
+    gated = bytes.fromhex(
+        "60003580600a9010600c57005b80600514601c5780601414601c57005b33ff"
+    )
+    # mixed-size batches: the parity only bites when small codes share a
+    # batch with an outlier big enough to page (deep cold-jump target)
+    workloads = [
+        ("largecode_mixed",
+         [("bigkill", _largecode_contract()), ("suicide", suicide),
+          ("gated", gated)],
+         2, {"106"}),
+        ("two_outliers",
+         [("big_a", _largecode_contract(1200)),
+          ("big_b", _largecode_contract(2400)), ("suicide", suicide)],
+         1, {"106"}),
+    ]
+
+    def one_run(jobs, txs, paged: bool):
+        global_args.code_paging = paged
+        _clear_caches()
+        reg = get_registry()
+        before = (
+            reg.counter("frontier.page_faults").value,
+            reg.counter("frontier.page_repacks").value,
+        )
+        t0 = time.time()
+        per_name, _states = analyze_cooperative(
+            jobs, transaction_count=txs, execution_timeout=180
+        )
+        wall = time.time() - t0
+        snap = {
+            "bucket_classes": int(
+                reg.gauge("frontier.bucket_classes").value or 0),
+            "pad_waste_pct": float(
+                reg.gauge("frontier.pad_waste_pct").value or 0.0),
+            "pad_waste_single_bucket_pct": float(reg.gauge(
+                "frontier.pad_waste_single_bucket_pct").value or 0.0),
+            "page_faults": int(
+                reg.counter("frontier.page_faults").value - before[0]),
+            "page_repacks": int(
+                reg.counter("frontier.page_repacks").value - before[1]),
+        }
+        return issue_set(per_name), wall, snap
+
+    prev = (global_args.code_paging, global_args.frontier,
+            global_args.frontier_force, global_args.frontier_width,
+            global_args.pipeline)
+    results = {}
+    total_faults = 0
+    try:
+        global_args.probe_backend = "auto"
+        global_args.frontier = True
+        global_args.frontier_force = True  # tiny members: bypass gates
+        global_args.frontier_width = 64
+        global_args.pipeline = True
+        # warm the XLA programs outside the timers
+        one_run([("suicide", suicide)], 1, True)
+        for name, jobs, txs, swcs in workloads:
+            off_issues, off_wall, off_snap = one_run(jobs, txs, False)
+            on_issues, on_wall, on_snap = one_run(jobs, txs, True)
+            found = {s for _, s, _, _ in on_issues}
+            assert swcs <= found, (
+                f"{name}: paged run lost recall: wanted {swcs}, got {found}"
+            )
+            assert on_issues == off_issues, (
+                f"{name}: bucket isolation / paging changed the issue set "
+                f"(parity broken): {on_issues} != {off_issues}"
+            )
+            assert not off_snap["bucket_classes"], (
+                f"{name}: --no-code-paging run still clustered bucket "
+                f"classes: {off_snap}"
+            )
+            assert on_snap["bucket_classes"] > 1, (
+                f"{name}: mixed-size batch did not split into >1 bucket "
+                f"class: {on_snap}"
+            )
+            assert (on_snap["pad_waste_pct"]
+                    < on_snap["pad_waste_single_bucket_pct"]), (
+                f"{name}: per-class pad waste not below the single-bucket "
+                f"counterfactual: {on_snap}"
+            )
+            total_faults += on_snap["page_faults"]
+            results[name] = {
+                "paged_wall_s": round(on_wall, 3),
+                "unpaged_wall_s": round(off_wall, 3),
+                "issues": len(on_issues),
+                "identical_issue_sets": True,
+                **on_snap,
+            }
+    finally:
+        (global_args.code_paging, global_args.frontier,
+         global_args.frontier_force, global_args.frontier_width,
+         global_args.pipeline) = prev
+    assert total_faults > 0, (
+        "no workload ever page-faulted (the paged window never engaged "
+        f"on the deep cold-jump outliers): {results}"
+    )
+    return {"metric": "paging_compare", "workloads": results}
+
+
 def mesh_compare() -> dict:
     """Sharded-pipelined vs single-device parity across every mesh ×
     pipeline combination.
@@ -1420,6 +1545,90 @@ def wl_bectoken(production: bool):
     )
     assert any(i.swc_id == "101" for i in issues), "batchTransfer recall lost"
     return sym.laser.total_states, time.time() - t0, _ttfe(issues, t0, "101")
+
+
+def _largecode_contract(n_pad: int = 1500) -> bytes:
+    """A creation-heavy-shaped outlier: selector dispatch to a reachable
+    CALLER;SELFDESTRUCT whose JUMPDEST sits BEYOND a long straight-line
+    pad tail (``n_pad`` PUSH1/POP pairs, ~``2*n_pad`` instructions).  The
+    instruction count blows past the smallest size bucket (and, at the
+    default residency budget, past the paged window), so the vulnerable
+    jump is a cold-page jump: exactly the shape that inflated
+    bectoken_batch's shared bucket in BENCH_r19."""
+    sel = 0x41C0E1B5  # kill()
+    tail = bytes([0x60, 0x00, 0x50]) * n_pad + bytes([0x00])  # pads + STOP
+    dest = 16 + len(tail)
+    assert dest < 0x10000
+    head = bytes([
+        0x60, 0x00, 0x35,                     # PUSH1 0; CALLDATALOAD
+        0x60, 0xE0, 0x1C,                     # PUSH1 0xE0; SHR
+        0x63, (sel >> 24) & 0xFF, (sel >> 16) & 0xFF,
+        (sel >> 8) & 0xFF, sel & 0xFF,        # PUSH4 kill()
+        0x14,                                 # EQ
+        0x61, (dest >> 8) & 0xFF, dest & 0xFF,  # PUSH2 dest
+        0x57,                                 # JUMPI
+    ])
+    assert len(head) == 16
+    return head + tail + bytes([0x5B, 0x33, 0xFF])  # JUMPDEST;CALLER;SELFDESTRUCT
+
+
+def wl_largecode(production: bool):
+    """Large-code mixed batch: one pad-tail outlier (~3000 instructions)
+    next to three small real-shape codes in ONE cooperative batch — the
+    corpus shape whose shared size bucket collapsed bectoken_batch in
+    BENCH_r19.  Production runs with bucket isolation + packed-code
+    paging on (the defaults); baseline is the sequential host schedule.
+    Recall asserted on the outlier's deep SELFDESTRUCT (the cold-page
+    jump) and on the small members."""
+    from bench_contracts import rubixi_like
+
+    suicide = bytes.fromhex("60003560e01c6341c0e1b51460145760006000fd5b33ff")
+    gated = bytes.fromhex(
+        "60003580600a9010600c57005b80600514601c5780601414601c57005b33ff"
+    )
+    jobs = [
+        ("bigkill", _largecode_contract()),
+        ("suicide", suicide),
+        ("gated", gated),
+        ("rubixi", rubixi_like()),
+    ]
+    expected = {"bigkill": "106", "suicide": "106", "rubixi": "105"}
+
+    _configure(production)
+    if production:
+        from mythril_tpu.support.support_args import args
+
+        args.frontier_force = True  # tiny members: bypass the narrow gate
+        try:
+            (per_name, states, wall, t0, dev_delta, har_delta,
+             mid_delta) = _cooperative_timed_run(jobs, "largecode_mixed")
+        finally:
+            args.frontier_force = False
+    else:
+        per_name = {}
+        states = 0
+        t0 = time.time()
+        for name, code in jobs:
+            _clear_caches()
+            sym, issues = _analyze(code, 0x0901D12E, 2, timeout=120)
+            states += sym.laser.total_states
+            per_name[name] = issues
+        wall = time.time() - t0
+        dev_delta = har_delta = mid_delta = None
+
+    for name, swc in expected.items():
+        got = {i.swc_id for i in per_name.get(name, [])}
+        assert swc in got, (
+            f"largecode_mixed recall lost: {name} missing SWC-{swc}"
+        )
+    all_issues = [i for iss in per_name.values() for i in iss]
+    ttfe = _ttfe(
+        [i for i in all_issues if i.swc_id in set(expected.values())], t0
+    )
+    return (
+        states, wall, ttfe, dev_delta, har_delta,
+        _ttfr(per_name, t0, expected), mid_delta,
+    )
 
 
 # The real-bytecode device flagship (VERDICT r4 #4): the call-free solc
@@ -2203,6 +2412,7 @@ WORKLOADS = [
     ("wide_frontier", wl_wide_frontier, "states/sec", 3),
     ("wide_solc", wl_wide_solc, "states/sec", 3),
     ("bectoken_batch", wl_bectoken, "states/sec", 3),
+    ("largecode_mixed", wl_largecode, "states/sec", 3),
     ("concolic_flip", wl_concolic, "flips/sec", 3),
     ("corpus_sweep", wl_corpus, "states/sec", 3),
 ]
@@ -2253,6 +2463,9 @@ def _new_row_data():
         "devsolver": [],  # per-production-rep devsolver.* counter deltas
         "adaptive": [],  # per-production-rep adaptive.* counter deltas
         "segments": [],  # per-production-rep frontier.segments deltas
+        # per-production-rep large-code frontier reads: bucket classes,
+        # pad-waste (isolated vs single-bucket counterfactual), paging
+        "frontier": [],
         "exploration": [],  # per-production-rep termination/coverage deltas
         # per-production-rep staticpass.reachable_edge_pct gauge reads
         # (static property of the workload's code; drift across bench
@@ -2318,6 +2531,24 @@ def _adaptive_summary(samples) -> dict:
         round(out["flips_hit"] / out["flips_planned"], 4)
         if out["flips_planned"] else 0.0
     )
+    return out
+
+
+def _frontier_summary(samples) -> dict:
+    """Median large-code frontier reads — pad-waste after bucket isolation
+    next to the single-bucket counterfactual (the row the ISSUE's
+    acceptance bar compares), plus paging fault/repack volume."""
+    out = {
+        "bucket_classes": _median([s["bucket_classes"] for s in samples]),
+        "pad_waste_pct": round(
+            _median([s["pad_waste_pct"] for s in samples]), 2),
+        "pad_waste_single_bucket_pct": round(
+            _median([s["pad_waste_single_bucket_pct"] for s in samples]), 2),
+        "page_faults": _median([s["page_faults"] for s in samples]),
+        "page_repacks": _median([s["page_repacks"] for s in samples]),
+        "page_resident_pct": round(
+            _median([s["page_resident_pct"] for s in samples]), 1),
+    }
     return out
 
 
@@ -2517,6 +2748,17 @@ def _row_summary(unit: str, d: dict, configured_reps: int = None) -> dict:
         **(
             {"segments_dispatched": _median(d["segments"])}
             if d.get("segments") and any(d["segments"])
+            else {}
+        ),
+        # large-code frontier (production runs): bucket classes, pad waste
+        # with isolation vs the single-bucket counterfactual, and paging
+        # fault/repack pressure — quoted whenever the run clustered codes
+        # into classes or paid any paging traffic
+        **(
+            {"frontier": _frontier_summary(d["frontier"])}
+            if d.get("frontier")
+            and any(s["bucket_classes"] or s["page_faults"]
+                    or s["pad_waste_pct"] for s in d["frontier"])
             else {}
         ),
         # exploration quality (production runs): how many paths stopped,
@@ -3092,6 +3334,11 @@ def main() -> None:
         print(json.dumps(adaptive_compare()), flush=True)
         return
 
+    if "--paging-compare" in sys.argv:
+        # standalone large-code bucket-isolation/paging parity mode
+        print(json.dumps(paging_compare()), flush=True)
+        return
+
     if "--harvest-compare" in sys.argv:
         # standalone sharded-vs-serial harvest parity mode: one line
         print(json.dumps(harvest_compare()), flush=True)
@@ -3265,6 +3512,7 @@ def main() -> None:
                     for k in _ADAPTIVE_KEYS
                 }
                 seg_before = fstats.segments
+                page_before = (fstats.page_faults, fstats.page_repacks)
                 from mythril_tpu.observability.exploration import (
                     get_exploration_ledger,
                 )
@@ -3370,6 +3618,25 @@ def main() -> None:
                         for k in _ADAPTIVE_KEYS
                     })
                     d["segments"].append(fstats.segments - seg_before)
+                    # large-code frontier: per-rep pad economics (gauges
+                    # reflect the most recent multi-code run) + paging
+                    # pressure deltas attributed to this rep
+                    d["frontier"].append({
+                        "bucket_classes": int(get_registry().gauge(
+                            "frontier.bucket_classes").value or 0),
+                        "pad_waste_pct": float(get_registry().gauge(
+                            "frontier.pad_waste_pct").value or 0.0),
+                        "pad_waste_single_bucket_pct": float(
+                            get_registry().gauge(
+                                "frontier.pad_waste_single_bucket_pct"
+                            ).value or 0.0),
+                        "page_faults": int(
+                            fstats.page_faults - page_before[0]),
+                        "page_repacks": int(
+                            fstats.page_repacks - page_before[1]),
+                        "page_resident_pct": float(get_registry().gauge(
+                            "frontier.page_resident_pct").value or 100.0),
+                    })
                     led = get_exploration_ledger()
                     t_after = led.terminated()
                     # partition invariant: every stamped path carries

@@ -112,9 +112,10 @@ def run_cooperative_batch(
     from mythril_tpu.analysis.security import retrieve_callback_issues
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.core.transaction import symbolic as sym_tx
-    from mythril_tpu.frontier.engine import drain_lasers
+    from mythril_tpu.frontier.engine import drain_lasers, reset_isolation_gauges
     from mythril_tpu.smt.solver import check_satisfiable_batch
 
+    reset_isolation_gauges()
     errors_by_name: Dict[str, str] = {}
 
     def _fail(name: str, stage: str, exc: BaseException) -> None:
@@ -166,12 +167,19 @@ def run_cooperative_batch(
     # compile mid-run (measured at ~17s on the tunneled chip)
     bucket_floor = None
     if use_frontier and wrappers:
-        from mythril_tpu.frontier.code import bucket_hint
+        from mythril_tpu.frontier.code import bucket_hint, bucket_hint_classes
 
-        bucket_floor = bucket_hint([
+        lists = [
             w.deferred_world_state[addr].code.instruction_list
             for _name, addr, w in wrappers
-        ])
+        ]
+        if args.code_paging:
+            # per-class floors: each size class keeps its own pinned
+            # program, so a creation-heavy outlier no longer inflates the
+            # floor every small code compiles (and pays pad for)
+            bucket_floor = bucket_hint_classes(lists)
+        else:
+            bucket_floor = bucket_hint(lists)
     failed: set = set()
     for round_idx in range(transaction_count):
         live = []

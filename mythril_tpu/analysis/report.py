@@ -252,6 +252,7 @@ class Report:
         meta["staticpass"] = _staticpass_meta()
         meta["health"] = health_meta()
         meta["device"] = device_meta()
+        meta["frontier"] = _frontier_meta()
         result = [
             {
                 "issues": sorted(_issues, key=lambda k: k["swcID"]),
@@ -311,6 +312,26 @@ def _devsolver_meta() -> dict:
         "kernel_wall_s": round(
             float(reg.counter("devsolver.kernel_wall_s").value or 0.0), 4),
         "decide_rate": round((sat + unsat) / admitted, 4) if admitted else 0.0,
+    }
+
+
+def _frontier_meta() -> dict:
+    """Large-code frontier rollup for report ``meta`` — pad economics and
+    paging pressure at a glance (bucket classes, pad-waste after
+    isolation vs the single-bucket counterfactual, fault/repack counts
+    and the resident fraction of paged codes)."""
+    from mythril_tpu.observability import get_registry
+
+    reg = get_registry()
+    return {
+        "bucket_classes": reg.gauge("frontier.bucket_classes").value or 0,
+        "pad_waste_pct": reg.gauge("frontier.pad_waste_pct").value or 0.0,
+        "pad_waste_single_bucket_pct": reg.gauge(
+            "frontier.pad_waste_single_bucket_pct").value or 0.0,
+        "page_faults": reg.counter("frontier.page_faults").value or 0,
+        "page_repacks": reg.counter("frontier.page_repacks").value or 0,
+        "page_resident_pct": reg.gauge(
+            "frontier.page_resident_pct").value or 100.0,
     }
 
 

@@ -51,6 +51,8 @@ class AnalyzerArgs:
     query_cache_dir: Optional[str] = None
     staticpass: bool = True
     staticpass_interproc: bool = True
+    code_paging: bool = True
+    code_page_budget: int = 2048
     pipeline: bool = True
     prefilter: bool = True
     devsolver: bool = True
@@ -143,6 +145,9 @@ class MythrilAnalyzer:
         return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
 
     def fire_lasers(self, modules: Optional[List[str]] = None) -> Report:
+        from mythril_tpu.frontier.engine import reset_isolation_gauges
+
+        reset_isolation_gauges()
         SolverStatistics().enabled = True
         benchmark_base = args.benchmark_path
         try:

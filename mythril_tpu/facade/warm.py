@@ -100,6 +100,8 @@ def apply_analyzer_args(cmd_args) -> None:
     args.staticpass_interproc = getattr(
         cmd_args, "staticpass_interproc", True
     )
+    args.code_paging = getattr(cmd_args, "code_paging", True)
+    args.code_page_budget = getattr(cmd_args, "code_page_budget", 2048)
     args.pipeline = getattr(cmd_args, "pipeline", True)
     args.prefilter = getattr(cmd_args, "prefilter", True)
     args.devsolver = getattr(cmd_args, "devsolver", True)

@@ -21,7 +21,7 @@ convention, reference mythril/laser/ethereum/svm.py:351):
 
 from __future__ import annotations
 
-from typing import Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set
 
 import numpy as np
 
@@ -262,37 +262,67 @@ class CodeTables:
         """(instr_cap, addr_cap, loops_cap) — padded sizes so one compiled
         segment program serves every contract in the same bucket.  Base caps
         fit EIP-170 runtime code (24576 bytes); larger inputs (initcode,
-        arbitrary files) grow the bucket instead of crashing."""
+        arbitrary files) grow the bucket instead of crashing.
+
+        Under packed-code paging the instruction axis is capped at the
+        residency budget: a paged code's device tables hold only the
+        resident window, so an oversized code stops growing the bucket
+        (pc stays the TRUE instruction index; the window check in step.py
+        faults non-resident pcs to the host for a repack)."""
         instr_cap = _grow(_INSTR_BASE, _INSTR_GROWTH, self.fam.shape[0])
+        budget = page_budget()
+        if budget is not None and instr_cap > budget:
+            instr_cap = budget
         addr_cap = _grow(_ADDR_BASE, _ADDR_GROWTH, self.jumpmap.shape[0])
         return instr_cap, addr_cap, _LOOPS_CAP
 
-    def padded_device_tables(self, bucket: Optional[tuple] = None):
+    def full_instr_cap(self) -> int:
+        """Instruction-axis cap covering the WHOLE code (paging ignored) —
+        the coverage-plane axis, which is indexed by true pc."""
+        return _grow(_INSTR_BASE, _INSTR_GROWTH, self.fam.shape[0])
+
+    def is_paged(self) -> bool:
+        """True when the code's instruction axis exceeds the residency
+        budget, i.e. its device tables hold a window, not the whole code."""
+        budget = page_budget()
+        return budget is not None and self.fam.shape[0] > budget
+
+    def padded_device_tables(self, bucket: Optional[tuple] = None,
+                             window_base: int = 0):
         """CodeDev-shaped numpy arrays padded to the size bucket; the pad
         region dispatches F_STOP (unreachable: pc never exceeds n).
+
+        ``window_base`` selects the resident window of a paged code: the
+        instruction-axis tables hold rows [window_base, window_base +
+        instr_cap) and the device subtracts the base before every gather.
+        jumpmap is NOT windowed (it is byte-address-indexed and maps to
+        TRUE instruction indices, so jumps into cold spans resolve and
+        then fault at the next dispatch).
 
         JUMPDESTs beyond the loops cap get loop_id -1 (no loop bound for
         them, rather than aliasing counters and killing loop-free paths);
         max_depth and the segment step cap still bound those paths."""
         instr_cap, addr_cap, loops_cap = bucket or self.size_bucket()
 
-        def pad1(a, cap, fill):
+        def pad1(a, cap, fill, base=0):
+            seg = a[base:base + cap]
             out = np.full(cap, fill, a.dtype)
-            out[: a.shape[0]] = a
+            out[: seg.shape[0]] = seg
             return out
 
+        b = int(window_base)
         loop_id = np.where(self.loop_id >= loops_cap, -1, self.loop_id)
         return (
-            pad1(self.fam, instr_cap, O.F_STOP),
-            pad1(self.aux, instr_cap, 0),
-            pad1(self.arity, instr_cap, 0),
-            pad1(self.gmin, instr_cap, 0),
-            pad1(self.gmax, instr_cap, 0),
-            pad1(self.event, instr_cap, True),
+            pad1(self.fam, instr_cap, O.F_STOP, b),
+            pad1(self.aux, instr_cap, 0, b),
+            pad1(self.arity, instr_cap, 0, b),
+            pad1(self.gmin, instr_cap, 0, b),
+            pad1(self.gmax, instr_cap, 0, b),
+            pad1(self.event, instr_cap, True, b),
             pad1(self.jumpmap, addr_cap, -1),
-            pad1(loop_id, instr_cap, -1),
-            pad1(self.concskip, instr_cap, False),
-            pad1(self.valgate, instr_cap, False),
+            pad1(loop_id, instr_cap, -1, b),
+            pad1(self.concskip, instr_cap, False, b),
+            pad1(self.valgate, instr_cap, False, b),
         )
 
 
@@ -313,6 +343,36 @@ def _grow(base: int, factor: int, need: int) -> int:
     return cap
 
 
+def page_budget() -> Optional[int]:
+    """Instruction-axis residency budget (a grown bucket size), or None
+    when packed-code paging is off (--no-code-paging).  Codes whose
+    instruction axis exceeds this keep only a window of that many rows
+    resident on device; cold spans page in via host repacks."""
+    from mythril_tpu.support.support_args import args
+
+    if not getattr(args, "code_paging", True):
+        return None
+    budget = int(getattr(args, "code_page_budget", 0) or 0)
+    if budget <= 0:
+        return None
+    return _grow(_INSTR_BASE, _INSTR_GROWTH, budget)
+
+
+def _hint_size_bucket(instruction_list: List) -> tuple:
+    """CodeTables.size_bucket computed from the raw instruction list (no
+    table build) — MUST mirror size_bucket exactly or the cooperative
+    floor desynchronizes from the real bucket (mid-sweep recompiles)."""
+    instr_cap = _grow(
+        _INSTR_BASE, _INSTR_GROWTH, len(instruction_list) + 1
+    )  # +1: implicit trailing STOP
+    budget = page_budget()
+    if budget is not None and instr_cap > budget:
+        instr_cap = budget
+    max_addr = max((ins.address for ins in instruction_list), default=0)
+    addr_cap = _grow(_ADDR_BASE, _ADDR_GROWTH, max_addr + 2)
+    return instr_cap, addr_cap, _LOOPS_CAP
+
+
 def bucket_hint(instruction_lists: List[List]) -> tuple:
     """(code_cap, instr_cap, addr_cap, loops_cap) covering these codes
     WITHOUT building tables — the cooperative driver pins this as the
@@ -321,12 +381,62 @@ def bucket_hint(instruction_lists: List[List]) -> tuple:
     code_cap = _grow(1, _CODE_GROWTH, len(instruction_lists))
     instr_cap, addr_cap = _INSTR_BASE, _ADDR_BASE
     for instruction_list in instruction_lists:
-        instr_cap = _grow(
-            instr_cap, _INSTR_GROWTH, len(instruction_list) + 1
-        )  # +1: implicit trailing STOP
-        max_addr = max((ins.address for ins in instruction_list), default=0)
-        addr_cap = _grow(addr_cap, _ADDR_GROWTH, max_addr + 2)
+        ic, ac, _lc = _hint_size_bucket(instruction_list)
+        instr_cap, addr_cap = max(instr_cap, ic), max(addr_cap, ac)
     return code_cap, instr_cap, addr_cap, _LOOPS_CAP
+
+
+def bucket_hint_classes(instruction_lists: List[List]) -> List[tuple]:
+    """Per-class bucket floors for a cooperative sweep: the codes cluster
+    by their own size bucket (same rule as ``bucket_classes``), and each
+    class gets a (code_cap, instr_cap, addr_cap, loops_cap) floor sized
+    for ITS members only — tiny contracts stop compiling giant programs
+    because one creation-heavy outlier rides the same sweep."""
+    groups: Dict[tuple, int] = {}
+    for instruction_list in instruction_lists:
+        key = _hint_size_bucket(instruction_list)
+        groups[key] = groups.get(key, 0) + 1
+    return [
+        (_grow(1, _CODE_GROWTH, n),) + key
+        for key, n in sorted(groups.items())
+    ]
+
+
+def bucket_classes(tables: List["CodeTables"]) -> List[tuple]:
+    """Cluster codes into bucket classes: members sharing the same
+    per-code ``size_bucket`` form one class with its own
+    (code_cap, instr_cap, addr_cap, loops_cap).  The growth factors are
+    geometric, so a mixed corpus lands in a handful of classes — and a
+    creation-heavy outlier pays for its own axes instead of taxing every
+    small code in the batch.  Returns [(bucket, member_indices)] sorted
+    small-to-large (deterministic across rounds of a sweep)."""
+    groups: Dict[tuple, List[int]] = {}
+    for i, t in enumerate(tables):
+        groups.setdefault(t.size_bucket(), []).append(i)
+    return [
+        ((_grow(1, _CODE_GROWTH, len(idxs)),) + key, idxs)
+        for key, idxs in sorted(groups.items())
+    ]
+
+
+def visited_instr_cap(tables: List["CodeTables"]) -> int:
+    """Coverage-plane instruction axis: the FULL (unpaged) cap over the
+    members.  Coverage is indexed by true pc, so the planes must cover
+    whole codes even when the dispatch tables hold only a window."""
+    return max((t.full_instr_cap() for t in tables), default=_INSTR_BASE)
+
+
+def pad_waste_pct(tables: List["CodeTables"], bucket: tuple) -> float:
+    """Percent of the bucket's [C, instr_cap] instruction plane that is
+    padding (code slots beyond the corpus count entirely; per-member rows
+    beyond the code's resident span).  The number the large-code tail is
+    about: one outlier inflating a shared bucket shows up here directly."""
+    code_cap, instr_cap, _ac, _lc = bucket
+    if not tables or code_cap <= 0 or instr_cap <= 0:
+        return 0.0
+    used = sum(min(t.fam.shape[0], instr_cap) for t in tables)
+    total = code_cap * instr_cap
+    return 100.0 * (1.0 - used / total)
 
 
 def multi_size_bucket(tables: List["CodeTables"]) -> tuple:
@@ -345,16 +455,25 @@ def multi_size_bucket(tables: List["CodeTables"]) -> tuple:
     return code_cap, instr_cap, addr_cap, loops_cap
 
 
-def stacked_device_tables(tables: List["CodeTables"], bucket: tuple):
+def stacked_device_tables(tables: List["CodeTables"], bucket: tuple,
+                          page_bases: Optional[List[int]] = None):
     """Stack per-code padded tables into the [C, ...] CodeDev arrays the
     segment consumes — the dispatch tables become per-path inputs via one
     [B] gather per table (multi-code frontier batching: paths from different
     contracts share a single wide device segment).  Pad codes beyond
     ``len(tables)`` dispatch F_STOP everywhere (unreachable: code_id is
-    always a real index)."""
+    always a real index).
+
+    ``page_bases`` (one window start per member, default all 0) windows
+    paged codes; the per-code starts ride along as the trailing ``pbase``
+    [C] column so the device can subtract them before every table gather."""
     code_cap, instr_cap, addr_cap, loops_cap = bucket
-    per_code = [t.padded_device_tables((instr_cap, addr_cap, loops_cap))
-                for t in tables]
+    bases = list(page_bases) if page_bases is not None else [0] * len(tables)
+    per_code = [
+        t.padded_device_tables((instr_cap, addr_cap, loops_cap),
+                               window_base=bases[i])
+        for i, t in enumerate(tables)
+    ]
     fills = (O.F_STOP, 0, 0, 0, 0, True, -1, -1, False, False)
     out = []
     for col, fill in enumerate(fills):
@@ -363,4 +482,7 @@ def stacked_device_tables(tables: List["CodeTables"], bucket: tuple):
         for ci, cols in enumerate(per_code):
             stack[ci] = cols[col]
         out.append(stack)
+    pbase = np.zeros(code_cap, np.int32)
+    pbase[: len(bases)] = np.asarray(bases, np.int32)
+    out.append(pbase)
     return out

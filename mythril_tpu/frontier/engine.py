@@ -55,8 +55,11 @@ from mythril_tpu.frontier.code import (
     CTX_STORAGE,
     CTX_TIMESTAMP,
     CodeTables,
+    bucket_classes,
     multi_size_bucket,
+    pad_waste_pct,
     stacked_device_tables,
+    visited_instr_cap,
 )
 from mythril_tpu.frontier.harvest import HarvestExecutor
 from mythril_tpu.frontier.records import PathRecord, snapshot_slot
@@ -372,6 +375,34 @@ def _eligible(gs) -> bool:
         return False
 
 
+def reset_isolation_gauges() -> None:
+    """Clear the per-analysis bucket-isolation latch.
+
+    ``frontier.bucket_classes`` and the pad-waste gauges are sticky
+    within one analysis (a multi-class dispatch must survive later
+    single-class tail rounds), so each fresh analysis zeroes them here
+    before its first dispatch — otherwise a long-lived process (daemon,
+    bench harness) would report the previous corpus's split."""
+    reg = _get_metrics()
+    for name in (
+        "frontier.bucket_classes",
+        "frontier.pad_waste_pct",
+        "frontier.pad_waste_single_bucket_pct",
+        "frontier.page_resident_pct",
+    ):
+        reg.gauge(name).set(0)
+
+
+def _latch_resident_pct(pct: float) -> None:
+    """Record the LOWEST residency observed this analysis: a fully
+    resident class dispatched after a paged one must not mask the paged
+    class's figure (gauges are last-write-wins)."""
+    gauge = _get_metrics().gauge("frontier.page_resident_pct")
+    current = float(gauge.value or 0.0)
+    if current <= 0.0 or pct < current:
+        gauge.set(pct)
+
+
 def drain_lasers(
     lasers: List,
     caps: Optional[Caps] = None,
@@ -404,11 +435,13 @@ def drain_lasers(
             continue
         key = (laser.max_depth, _sel_mode(laser))
         groups.setdefault(key, []).extend((laser, s) for s in seeds)
-    # the floor covers the WHOLE corpus: applying it to a small heterogeneous
-    # group would pad that group's device tables to the full code axis
-    # (wasted HBM); with one group — the practical cooperative case — the
-    # floor is exact
-    if len(groups) > 1:
+    # a single corpus-wide floor covers the WHOLE corpus: applying it to a
+    # small heterogeneous group would pad that group's device tables to the
+    # full code axis (wasted HBM); with one group — the practical
+    # cooperative case — the floor is exact.  PER-CLASS floors (a list,
+    # from bucket_hint_classes) survive any grouping: each class picks the
+    # smallest floor that covers it, so nothing over-pads.
+    if len(groups) > 1 and not isinstance(bucket_floor, list):
         bucket_floor = None
     executed = 0
     for pairs in groups.values():
@@ -760,6 +793,86 @@ class FrontierEngine:
         st.depth[slot] = enc["depth"]
 
     # ------------------------------------------------------------------
+    # large-code frontier: per-class floors + packed-code paging
+    # ------------------------------------------------------------------
+
+    # faults per code before the engine stops repacking for it and pins
+    # further faulting paths host-side (semantic park) — a window that
+    # keeps missing is a code the host engine runs better
+    _PAGE_FAULT_LIMIT = 8
+
+    @staticmethod
+    def _pick_floor(floors: List[tuple],
+                    natural: tuple) -> Optional[tuple]:
+        """Smallest per-class floor that covers ``natural`` in every
+        dimension, or None.  A floor that only partially covers would
+        produce a third bucket shape (elementwise max) that neither the
+        sweep's floored program nor the natural program matches — a
+        guaranteed mid-sweep recompile — so partial covers are skipped."""
+        best = None
+        for f in floors:
+            if len(f) != len(natural):
+                continue
+            if all(fv >= nv for fv, nv in zip(f, natural)):
+                if best is None or f[0] * f[1] < best[0] * best[1]:
+                    best = f
+        return best
+
+    def _note_page_fault(self, code_idx: int, pc: int) -> bool:
+        """Record a device page fault (harvest calls this).  Returns True
+        when the window will be repacked to cover ``pc`` at the next sync
+        point — the faulting path should then re-inject as an ordinary
+        park carrier.  Returns False once the code exceeded the fault
+        budget: the caller pins the path host-side instead."""
+        _get_metrics().counter("frontier.page_faults").inc()
+        counts = getattr(self, "_page_fault_counts", None)
+        if counts is None or not getattr(self, "_page_tables", None):
+            return False
+        tables = self._page_tables
+        if not (0 <= code_idx < len(tables)):
+            return False
+        counts[code_idx] = counts.get(code_idx, 0) + 1
+        if counts[code_idx] > self._PAGE_FAULT_LIMIT:
+            return False
+        axis = self._page_bucket[1]
+        full = tables[code_idx].fam.shape[0]
+        if full <= axis:
+            # not actually paged (stale pc past the code end): no repack
+            return False
+        # window start: a quarter-axis of context before the fault pc so
+        # backward jumps inside the new span stay resident, clamped to
+        # keep the window inside the code
+        base = min(max(0, int(pc) - axis // 4), full - axis)
+        self._page_pending[code_idx] = base
+        return True
+
+    def _maybe_repack(self):
+        """Rebuild the device tables with pending window moves folded in
+        (sync points only).  Same bucket, same shapes — the compiled
+        segment program is untouched; only table CONTENT re-uploads.
+        Returns the fresh CodeDev, or None when nothing is pending."""
+        pending = getattr(self, "_page_pending", None)
+        if not pending:
+            return None
+        for ci, base in pending.items():
+            self._page_bases[ci] = base
+        pending.clear()
+        reg = _get_metrics()
+        reg.counter("frontier.page_repacks").inc()
+        tables, bucket = self._page_tables, self._page_bucket
+        axis = bucket[1]
+        full_rows = sum(t.fam.shape[0] for t in tables)
+        _latch_resident_pct(round(
+            100.0 * sum(min(t.fam.shape[0], axis) for t in tables)
+            / max(full_rows, 1), 1,
+        ))
+        return CodeDev(*[
+            self._page_placer(a)
+            for a in stacked_device_tables(
+                tables, bucket, page_bases=self._page_bases)
+        ])
+
+    # ------------------------------------------------------------------
 
     def _run(self, pairs: List[Tuple],
              bucket_floor: Optional[tuple] = None) -> int:
@@ -859,12 +972,112 @@ class FrontierEngine:
                 table_hash.append(_code_hash_full(code))
             seed_code_idx.append(ci)
 
+        # ------------------------------------------------------------------
+        # per-code bucket isolation (large-code frontier): cluster the
+        # codes by their own size bucket and dispatch one batch per class,
+        # so a creation-heavy outlier pays for its own padded axes instead
+        # of inflating every small code's tables.  ``bucket_floor`` may be
+        # a list of per-class floors (cooperative driver) — each class
+        # picks the smallest floor that covers it.  --no-code-paging keeps
+        # the legacy single corpus-wide bucket (the parity baseline).
+        # ------------------------------------------------------------------
+        floors: List[tuple] = []
+        if isinstance(bucket_floor, list):
+            floors = [tuple(f) for f in bucket_floor]
+            bucket_floor = None
+        elif bucket_floor is not None:
+            floors = [tuple(bucket_floor)]
+            bucket_floor = None
+        if getattr(args, "code_paging", True):
+            classes = bucket_classes(tables)
+        else:
+            classes = [(multi_size_bucket(tables), list(range(len(tables))))]
+        if len(classes) > 1:
+            reg = _get_metrics()
+            # the counterfactual is the LEGACY single corpus-wide bucket —
+            # no paging, so its instruction axis covers the largest code in
+            # full (the r19 tail: one outlier inflating everyone's axes).
+            # multi_size_bucket() under paging clamps at the residency
+            # budget, which would understate the waste being recovered.
+            single = multi_size_bucket(tables)
+            single = (
+                single[0],
+                max(single[1], max(t.full_instr_cap() for t in tables)),
+            ) + single[2:]
+            single_waste = round(pad_waste_pct(tables, single), 2)
+            waste_num = waste_den = 0.0
+            for cls_bucket, members in classes:
+                cells = cls_bucket[0] * cls_bucket[1]
+                waste_num += pad_waste_pct(
+                    [tables[i] for i in members], cls_bucket
+                ) * cells
+                waste_den += cells
+                reg.gauge(
+                    "frontier.bucket_class_occupancy.%d" % cls_bucket[1]
+                ).set(round(100.0 * len(members) / cls_bucket[0], 1))
+            total = 0
+            for _cls_bucket, members in classes:
+                member_set = set(members)
+                sub_pairs = [
+                    p for p, ci in zip(pairs, seed_code_idx)
+                    if ci in member_set
+                ]
+                total += self._run(sub_pairs, bucket_floor=floors or None)
+            # aggregate LAST: each single-class sub-run above writes its
+            # own class's figure into the gauges, so the corpus-weighted
+            # aggregate must land after the recursion to survive
+            reg.gauge("frontier.pad_waste_pct").set(
+                round(waste_num / max(waste_den, 1.0), 2)
+            )
+            reg.gauge("frontier.pad_waste_single_bucket_pct").set(
+                single_waste
+            )
+            reg.gauge("frontier.bucket_classes").set(len(classes))
+            return total
+
         natural_bucket = multi_size_bucket(tables)
         bucket = natural_bucket
-        if bucket_floor is not None:
-            bucket = tuple(max(b, f) for b, f in zip(bucket, bucket_floor))
+        floor = self._pick_floor(floors, natural_bucket)
+        if floor is not None:
+            bucket = tuple(max(b, f) for b, f in zip(bucket, floor))
         code_cap, instr_cap, addr_cap, loops_cap = bucket
-        program_key = (caps, bucket)
+        # coverage planes are indexed by TRUE pc, so their axis covers the
+        # whole code even when paged dispatch tables hold only a window
+        visit_cap = max(instr_cap, visited_instr_cap(tables))
+        _waste = round(pad_waste_pct(tables, bucket), 2)
+        _reg = _get_metrics()
+        # single class: the class bucket IS the corpus bucket, so the
+        # counterfactual equals the actual.  A multi-class dispatch
+        # earlier in this analysis latches bucket_classes >= 2 — its
+        # corpus-weighted figures are the ones worth keeping, so a later
+        # single-class round (e.g. a tail transaction touching one code)
+        # must not clobber them.  reset_isolation_gauges() clears the
+        # latch at analysis entry.  bucket_classes reads 0 when isolation
+        # is off (--no-code-paging), 1 when on but the corpus is uniform.
+        if int(_reg.gauge("frontier.bucket_classes").value or 0) <= 1:
+            _reg.gauge("frontier.pad_waste_pct").set(_waste)
+            _reg.gauge("frontier.pad_waste_single_bucket_pct").set(_waste)
+            _reg.gauge("frontier.bucket_classes").set(
+                1 if getattr(args, "code_paging", True) else 0
+            )
+        _reg.gauge(
+            "frontier.bucket_class_occupancy.%d" % instr_cap
+        ).set(round(100.0 * len(tables) / code_cap, 1))
+        # packed-code paging state: per-table resident-window starts plus
+        # pending faults the next sync-point repack folds in
+        self._page_tables = tables
+        self._page_bucket = bucket
+        self._page_bases = [0] * len(tables)
+        self._page_pending = {}
+        self._page_fault_counts = {}
+        paged_rows = sum(t.fam.shape[0] for t in tables)
+        self._page_resident = sum(
+            min(t.fam.shape[0], instr_cap) for t in tables
+        )
+        _latch_resident_pct(
+            round(100.0 * self._page_resident / max(paged_rows, 1), 1)
+        )
+        program_key = (caps, bucket, visit_cap)
         program_warm = program_key in _WARM_PROGRAMS
         _devplane.install()
         with _otrace.span("frontier.compile", cat="frontier",
@@ -880,9 +1093,12 @@ class FrontierEngine:
         # segment would count toward the slow-bail verdict
         import jax
 
-        # tables never change during the run: upload once, reuse per segment
+        # tables are uploaded once and reused per segment; a page-fault
+        # repack (window move) rebuilds them at the next sync point with
+        # IDENTICAL shapes, so no recompile ever rides a repack
         code_dev = CodeDev(
-            *[jax.device_put(a) for a in stacked_device_tables(tables, bucket)]
+            *[jax.device_put(a) for a in stacked_device_tables(
+                tables, bucket, page_bases=self._page_bases)]
         )
         laser0 = lasers[0]
         cfg = CfgScalars(
@@ -960,8 +1176,10 @@ class FrontierEngine:
         )
         arena_len = arena.length
         # [3, C, I] coverage planes: instruction / taken-edge / fall-edge
-        # (see observability/exploration.py for the plane contract)
-        visited = jax.device_put(np.zeros((3, code_cap, instr_cap), bool))
+        # (see observability/exploration.py for the plane contract).  The
+        # instruction axis is the FULL cap (true-pc indexed), independent
+        # of the possibly-windowed dispatch-table axis
+        visited = jax.device_put(np.zeros((3, code_cap, visit_cap), bool))
 
         # SPMD over the mesh path axis (SURVEY.md §5.8): with >1 attached
         # device the segment inputs are placed path-sharded (state) /
@@ -1037,6 +1255,12 @@ class FrontierEngine:
                 fields["events"] = mesh_empty_events
                 fields["ev_len"] = mesh_empty_ev_len
                 return FrontierState(**fields)
+
+        if mesh is not None:
+            _repl = NamedSharding(mesh, PartitionSpec())
+            self._page_placer = lambda a: jax.device_put(a, _repl)
+        else:
+            self._page_placer = jax.device_put
         executed = 0
         exec_timeout = min(
             laser.execution_timeout or args.execution_timeout
@@ -1059,7 +1283,7 @@ class FrontierEngine:
         # then enter the floored-bucket loop.  Time-to-first-event now rides
         # the small compile; the big compile amortizes over the rest.
         if mesh is None and bucket != natural_bucket and not program_warm:
-            nat_cc, nat_ic, _nat_ac, nat_lc = natural_bucket
+            nat_cc, _nat_ic, _nat_ac, nat_lc = natural_bucket
             stats = FrontierStatistics()
 
             # pre-compile the floored big-bucket program in the background
@@ -1097,9 +1321,14 @@ class FrontierEngine:
             nat_segment = cached_segment(caps, *natural_bucket)
             nat_code_dev = CodeDev(*[
                 jax.device_put(a)
-                for a in stacked_device_tables(tables, natural_bucket)
+                for a in stacked_device_tables(
+                    tables, natural_bucket, page_bases=self._page_bases)
             ])
-            nat_visited = jax.device_put(np.zeros((3, nat_cc, nat_ic), bool))
+            # full-cap coverage axis (true-pc indexed), same as the floored
+            # planes — the corner copy below is then a straight slice
+            nat_visited = jax.device_put(
+                np.zeros((3, nat_cc, visit_cap), bool)
+            )
             cfg0 = cfg._replace(
                 k_limit=np.int32(min(caps.K, 96 << min(stats.segments, 4)))
             )
@@ -1109,7 +1338,8 @@ class FrontierEngine:
                      if _otrace.get_tracer().enabled else None)
             with _otrace.span(
                 "frontier.segment", cat="device", segment=-1,
-                warm=(caps, natural_bucket) in _WARM_PROGRAMS, opening=True,
+                warm=(caps, natural_bucket, visit_cap) in _WARM_PROGRAMS,
+                opening=True,
                 **(
                     {"requests": ",".join(self.request_tags)}
                     if self.request_tags else {}
@@ -1140,7 +1370,7 @@ class FrontierEngine:
                 seg_only, _devplane.bucket_tag(natural_bucket)
             )
             _get_metrics().counter("frontier.opening_dispatches").inc()
-            _WARM_PROGRAMS.add((caps, natural_bucket))
+            _WARM_PROGRAMS.add((caps, natural_bucket, visit_cap))
             _devplane.harvest_analysis(
                 nat_segment,
                 lambda st_nat=st_nat, dev_arena=dev_arena,
@@ -1169,7 +1399,7 @@ class FrontierEngine:
             # the floored bitmap (same code order, smaller caps)
             import jax.numpy as jnp
 
-            visited = visited.at[:, :nat_cc, :nat_ic].set(
+            visited = visited.at[:, :nat_cc, :].set(
                 jnp.asarray(nat_visited)
             )
             live = int(((st.halt == O.H_RUNNING) & (st.seed >= 0)).sum())
@@ -1201,6 +1431,7 @@ class FrontierEngine:
                 visited=visited, deadline=deadline,
                 program_key=program_key, program_warm=program_warm,
                 mesh=mesh, push_fn=push_sharded,
+                repack_fn=self._maybe_repack,
             )
             runner.run()
             st = runner.st
@@ -1311,6 +1542,12 @@ class FrontierEngine:
             # them; events never cross the link upward) — restart the
             # per-slot seen counters to match
             ev_seen.fill(0)
+            # page-fault repack: the synchronous loop is all sync points —
+            # fold pending window moves into fresh tables (same shapes, no
+            # recompile) before the next dispatch
+            new_code_dev = self._maybe_repack()
+            if new_code_dev is not None:
+                code_dev = new_code_dev
             har_only = time.perf_counter() - t_har
             stats.harvest_s += har_only
             _get_metrics().observe("frontier.harvest_wall_s", har_only)
@@ -1782,7 +2019,7 @@ class FrontierEngine:
             if int(st.halt[slot]) == O.H_RUNNING:
                 st.halt[slot] = O.H_PARK
             rec.final = snapshot_slot(st, slot)
-            if rec.final["halt"] == O.H_PENDING_FORK:
+            if rec.final["halt"] in (O.H_PENDING_FORK, O.H_PAGE_FAULT):
                 rec.final["halt"] = O.H_PARK
             stats.device_paths += 1
             stats.record_bulk_park(reason)

@@ -306,6 +306,23 @@ class HarvestExecutor:
             if halt == O.H_PENDING_FORK:
                 rec.final["halt"] = O.H_PARK
                 stats.record_bulk_park("batch-full")
+            elif halt == O.H_PAGE_FAULT:
+                # packed-code paging: the pc left the code's resident
+                # window.  Degrade to an ordinary park carrier (the host
+                # engine is always correct) and tell the engine which
+                # window to fold in at the next sync-point repack.  If the
+                # code is fault-storming past its limit, pin the carrier
+                # host-side instead of re-injecting into another fault.
+                rec.final["halt"] = O.H_PARK
+                rec.final["page_fault"] = True
+                ok = eng._note_page_fault(
+                    int(np.asarray(st.code_id)[slot]),
+                    int(rec.final["pc"]),
+                )
+                if not ok:
+                    rec.final["semantic_park"] = True
+                    stats.semantic_parks += 1
+                stats.record_bulk_park("page-fault")
             elif halt == O.H_PARK:
                 pc = int(rec.final["pc"])
                 names = walker.tables_for(rec).opcode_names

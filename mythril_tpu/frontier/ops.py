@@ -104,8 +104,13 @@ F_SIGNEXTEND = 28
 F_BYTEOP = 29
 F_ADDMODOP = 30  # aux = A_ADDMOD / A_MULMOD
 F_MSTORE8 = 31
+# packed-code paging: synthesized when a path's pc leaves the resident
+# window of a paged code (step.py window check) — never appears in a
+# CodeTables.fam row.  The handler halts with H_PAGE_FAULT so the harvest
+# can repack the window host-side and re-inject the path.
+F_PAGEFAULT = 32
 
-N_FAMILIES = 32
+N_FAMILIES = 33
 
 # ---------------------------------------------------------------------------
 # Halt kinds (state.halt)
@@ -121,6 +126,9 @@ H_PARK = 6  # unsupported op or cap overflow: host engine continues the path
 H_PENDING_FORK = 7  # JUMPI wanted to fork but the batch was full: re-inject
 H_DEPTH = 8  # max_depth exceeded: silently dropped (host strategy parity)
 H_LOOP = 9  # loop bound exceeded (bounded-loops parity)
+H_PAGE_FAULT = 10  # pc left the resident window of a paged code: the host
+# repacks the window (engine._note_page_fault) and the path re-injects as
+# an ordinary park carrier — correctness never depends on the window guess
 
 # ---------------------------------------------------------------------------
 # Event kinds (events[b, i, 0])

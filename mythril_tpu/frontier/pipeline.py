@@ -334,7 +334,7 @@ class PipelinedRunner:
                  seed_queue, statics, beam, tables, table_code, table_idx,
                  segment, code_dev, cfg, dev_arena, arena_len, visited,
                  deadline, program_key, program_warm, mesh=None,
-                 push_fn=None, table_hash=None):
+                 push_fn=None, table_hash=None, repack_fn=None):
         self.engine = engine
         self.caps = engine.caps
         self.st = st
@@ -363,6 +363,11 @@ class PipelinedRunner:
         self.deadline = deadline
         self.program_key = program_key
         self.program_warm = program_warm
+        # packed-code paging: engine callback that folds pending window
+        # moves into fresh same-shape tables.  Called ONLY at sync points
+        # (no dispatch in flight), the one place swapping code_dev cannot
+        # race a chained dispatch that already captured the old tables.
+        self.repack_fn = repack_fn
 
         # pod composition: with a mesh the slot batch is path-sharded and
         # every chained dispatch is one SPMD program.  push_fn is the
@@ -1074,6 +1079,13 @@ class PipelinedRunner:
                         # pooled spills: hand them to the host engine
                         # rather than spin on empty segments
                         self._flush_adaptive_pool()
+                if self.repack_fn is not None:
+                    # fold pending page-window moves in BEFORE re-injection
+                    # so faulted carriers resume against tables whose
+                    # resident window now covers their pc
+                    new_cd = self.repack_fn()
+                    if new_cd is not None:
+                        self.code_dev = new_cd
                 if self.reinject_q:
                     self._reinject()
                 self.refill()

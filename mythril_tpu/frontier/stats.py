@@ -54,6 +54,10 @@ class FrontierStatistics(metaclass=Singleton):
         "mid_encode_failures", "mid-frame seeds bounced at encoding")
     semantic_parks = _counter_prop(
         "semantic_parks", "paths pinned host-side until stepped past")
+    page_faults = _counter_prop(
+        "page_faults", "paths that jumped off their code's resident window")
+    page_repacks = _counter_prop(
+        "page_repacks", "sync-point window moves folded into fresh tables")
 
     def __init__(self) -> None:
         self._materialize()
@@ -86,7 +90,7 @@ class FrontierStatistics(metaclass=Singleton):
         for attr in (
             "device_instructions", "device_paths", "segments",
             "mesh_devices", "mid_injections", "mid_encode_failures",
-            "semantic_parks",
+            "semantic_parks", "page_faults", "page_repacks",
         ):
             reg.counter(_PREFIX + attr)
         # float-typed wall-time accumulators (report emits 0.0, not 0)
@@ -130,6 +134,10 @@ class FrontierStatistics(metaclass=Singleton):
             "mid_injections": self.mid_injections,
             "mid_encode_failures": self.mid_encode_failures,
             "semantic_parks": self.semantic_parks,
+            # page_{faults,repacks} intentionally absent: as_dict is the
+            # seed-compatible facade shape (pinned byte-for-byte by
+            # tests/observability/test_facades.py); paging telemetry lives
+            # in the registry snapshot / meta.frontier instead
             "parks_by_opcode": dict(self.parks_by_opcode.most_common()),
             "parks_by_reason": dict(self.parks_by_reason.most_common()),
             **({"microbench": self.microbench} if self.microbench else {}),

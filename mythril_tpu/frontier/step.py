@@ -104,6 +104,10 @@ class CodeDev(NamedTuple):
     valgate: jnp.ndarray  # [C, N] bool — MSTORE panic gate (module
     # value_gated_hooks): event only when the stored value is concrete
     # with the solc Panic(uint256) selector in its top 32 bits
+    pbase: jnp.ndarray  # [C] i32 resident-window start per code (packed-
+    # code paging): every instruction-axis gather subtracts it from the
+    # TRUE pc; a pc outside [pbase, pbase + N) dispatches F_PAGEFAULT.
+    # All-zero (and N covering the whole code) when paging is off.
 
 
 class CfgScalars(NamedTuple):
@@ -153,10 +157,18 @@ def build_segment(caps: Caps):
         cid = jnp.clip(st.code_id, 0, code.fam.shape[0] - 1)
         max_depth, loop_bound = cfg.max_depth, cfg.loop_bound
         row_zero, row_one = cfg.row_zero, cfg.row_one
-        pc = jnp.clip(st.pc, 0, code.fam.shape[1] - 1)
-        fam = code.fam[cid, pc]
+        # packed-code paging: table rows hold the resident window
+        # [pbase, pbase + N); st.pc stays the TRUE instruction index and
+        # every instruction-axis gather uses the window-relative index.
+        # A pc outside the window dispatches F_PAGEFAULT (halt for a host
+        # repack) — the clamped gathers below then read garbage rows that
+        # the fam override keeps unreachable.
+        rel = st.pc - code.pbase[cid]
+        infault = (rel < 0) | (rel >= code.fam.shape[1])
+        pc = jnp.clip(rel, 0, code.fam.shape[1] - 1)
+        fam = jnp.where(infault, O.F_PAGEFAULT, code.fam[cid, pc])
         aux = code.aux[cid, pc]
-        arity = code.arity[cid, pc]
+        arity = jnp.where(infault, 0, code.arity[cid, pc])
         running = (st.halt == O.H_RUNNING) & (st.seed >= 0)
 
         gas_pre = (st.gas_min, st.gas_max)
@@ -277,6 +289,12 @@ def build_segment(caps: Caps):
 
         def h_park(_):
             return halted(O.H_PARK)
+
+        def h_page_fault(_):
+            # pc left the resident window: freeze the path exactly where
+            # it is (no pc advance, no gas) so the host can repack the
+            # window and re-inject at the SAME pc
+            return halted(O.H_PAGE_FAULT)
 
         def h_stop(_):
             return halted(O.H_STOP)
@@ -794,6 +812,7 @@ def build_segment(caps: Caps):
             h_byte,  # F_BYTEOP
             h_addmod,  # F_ADDMODOP
             h_park,  # F_MSTORE8 (parked in v1)
+            h_page_fault,  # F_PAGEFAULT (synthesized by the window check)
         ]
 
         out = jax.lax.switch(jnp.clip(fam, 0, len(handlers) - 1), handlers, None)
@@ -885,11 +904,13 @@ def build_segment(caps: Caps):
         )
         emit = (
             code.event[cid, pc]
-            & ~(code.concskip[cid, pc] & all_conc)
-            & ~(code.valgate[cid, pc] & nonpanic)
+            & ~infault  # faulted paths re-inject and run the op then
             & ~pending
             & ~underflow
+            & ~(code.concskip[cid, pc] & all_conc)
+            & ~(code.valgate[cid, pc] & nonpanic)
             & (st2.halt != O.H_PARK)
+            & (st2.halt != O.H_PAGE_FAULT)
             & (st2.halt != O.H_DEPTH)
             & (st2.halt != O.H_LOOP)
         )
@@ -901,7 +922,9 @@ def build_segment(caps: Caps):
         res_slot = jnp.where(is_jumpi, st2.pc, out.res_row)
         extra_slot = jnp.where(is_jumpi & died, -3, -1)
         payload = jnp.concatenate([
-            jnp.stack([kind, pc, gas_pre[0], gas_pre[1]]),
+            # event pc is the TRUE instruction index (walker contract),
+            # not the window-relative gather index
+            jnp.stack([kind, st.pc, gas_pre[0], gas_pre[1]]),
             ev_ops,
             jnp.stack([res_slot, extra_slot]),
         ]).astype(I32)
@@ -981,8 +1004,13 @@ def build_segment(caps: Caps):
         # Compare the successor pc against pc+1 to pick the plane; paths
         # that halted at the JUMPI (invalid dest) mark no edge, and
         # fork-wanting paths mark theirs at the grant below.
-        fam_here = code.fam[cid_live, jnp.clip(state.pc, 0,
-                                               code.fam.shape[1] - 1)]
+        fam_here = code.fam[
+            cid_live,
+            jnp.clip(state.pc - code.pbase[cid_live], 0,
+                     code.fam.shape[1] - 1),
+        ]
+        # a faulted path has new halt H_PAGE_FAULT, so the garbage row a
+        # clamped out-of-window gather reads never passes this guard
         inline_jumpi = (
             running & (fam_here == O.F_JUMPI) & ~fork.want
             & (new_state.halt == O.H_RUNNING)
@@ -1067,11 +1095,16 @@ def build_segment(caps: Caps):
         # pops, depth, the JUMPI's static gas, and the branch constraint
         # (parent = fall-through + Not(cond); child = taken + cond)
         touched = granted | forked_into
-        jumpi_pc = jnp.clip(jnp.where(forked_into, state.pc[src], state.pc),
-                            0, code.fam.shape[1] - 1)
+        # TRUE pc of the JUMPI (branch targets, visited planes) vs the
+        # window-relative row index (gas-table gathers): a forking JUMPI
+        # just executed, so it is resident by construction
+        jumpi_true = jnp.where(forked_into, state.pc[src], state.pc)
         # child slots copied code_id from their parent via copy_field
         cid2 = jnp.clip(state2.code_id, 0, code.fam.shape[0] - 1)
-        branch_pc = jnp.where(forked_into, taken_pc, jumpi_pc + 1)
+        jumpi_pc = jnp.clip(jumpi_true, 0, visited.shape[2] - 1)
+        jumpi_rel = jnp.clip(jumpi_true - code.pbase[cid2], 0,
+                             code.fam.shape[1] - 1)
+        branch_pc = jnp.where(forked_into, taken_pc, jumpi_true + 1)
         branch_row = jnp.where(forked_into, cond_of_child, ncond_of_parent)
         # edge coverage, granted forks: the child resolves the taken edge,
         # the granting parent the fall-through edge, both at the JUMPI's
@@ -1087,11 +1120,11 @@ def build_segment(caps: Caps):
             depth=jnp.where(touched, state2.depth + 1, state2.depth),
             stack_len=jnp.where(touched, state2.stack_len - 2, state2.stack_len),
             gas_min=jnp.where(
-                touched, state2.gas_min + code.gmin[cid2, jumpi_pc],
+                touched, state2.gas_min + code.gmin[cid2, jumpi_rel],
                 state2.gas_min,
             ),
             gas_max=jnp.where(
-                touched, state2.gas_max + code.gmax[cid2, jumpi_pc],
+                touched, state2.gas_max + code.gmax[cid2, jumpi_rel],
                 state2.gas_max,
             ),
             cons=jnp.where(

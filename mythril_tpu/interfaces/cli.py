@@ -162,6 +162,26 @@ def _add_analysis_options(parser) -> None:
         "included)",
     )
     group.add_argument(
+        "--no-code-paging",
+        action="store_false",
+        dest="code_paging",
+        default=True,
+        help="disable the large-code frontier (per-code bucket isolation "
+        "and packed-code paging) and pad every code to one corpus-wide "
+        "size bucket; the issue set is identical either way (bench.py "
+        "--paging-compare gates exactly this toggle)",
+    )
+    group.add_argument(
+        "--code-page-budget",
+        type=int,
+        default=2048,
+        metavar="N",
+        help="instruction-axis residency budget for packed-code paging: "
+        "codes beyond the grown bucket of N instructions keep only a "
+        "window of that size device-resident, cold jumps fault to the "
+        "host for a sync-point repack (0 keeps bucket isolation only)",
+    )
+    group.add_argument(
         "--no-pipeline",
         action="store_false",
         dest="pipeline",
@@ -811,6 +831,8 @@ def _build_analyzer(parsed, query_signature: bool = False):
         staticpass_interproc=not getattr(
             parsed, "no_staticpass_interproc", False
         ),
+        code_paging=getattr(parsed, "code_paging", True),
+        code_page_budget=getattr(parsed, "code_page_budget", 2048),
         pipeline=getattr(parsed, "pipeline", True),
         prefilter=getattr(parsed, "prefilter", True),
         devsolver=getattr(parsed, "devsolver", True),

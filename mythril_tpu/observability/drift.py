@@ -122,6 +122,20 @@ _SPECS: List[Tuple[str, Callable[[Dict[str, Any]], Optional[float]],
      lambda r: _get(r, ("staticpass", "reachable_edge_pct")), None, 1.0),
     ("device_residency_pct", lambda r: _get(r, ("device_residency_pct",)),
      True, 1.0),
+    # large-code frontier pad economics: pad waste is padded cells the
+    # device computes but the corpus never uses — lower is strictly
+    # better.  Paging pressure is reported neutrally (faults trade
+    # against pad waste; neither direction alone means regression)
+    ("frontier.pad_waste_pct",
+     lambda r: _get(r, ("frontier", "pad_waste_pct")), False, 2.0),
+    ("frontier.bucket_classes",
+     lambda r: _get(r, ("frontier", "bucket_classes")), None, 1.0),
+    ("frontier.page_faults",
+     lambda r: _get(r, ("frontier", "page_faults")), None, 1.0),
+    ("frontier.page_repacks",
+     lambda r: _get(r, ("frontier", "page_repacks")), None, 1.0),
+    ("frontier.page_resident_pct",
+     lambda r: _get(r, ("frontier", "page_resident_pct")), True, 1.0),
     # adaptive steering: fewer dispatched segments at equal issue sets is
     # the controller doing its job; resteer/requeue volume is reported
     # neutrally (more steering is not inherently better or worse)

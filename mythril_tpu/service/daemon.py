@@ -740,6 +740,23 @@ class AnalysisService:
                 "staticpass.reachable_edge_pct"
             ).snapshot(),
         }
+        # large-code frontier: pad economics + paging pressure (local
+        # registry view — per-run scoped, so this reflects the most
+        # recent analysis in inline mode)
+        out["frontier"] = {
+            "bucket_classes": _reg.gauge(
+                "frontier.bucket_classes").snapshot() or 0,
+            "pad_waste_pct": _reg.gauge(
+                "frontier.pad_waste_pct").snapshot() or 0.0,
+            "pad_waste_single_bucket_pct": _reg.gauge(
+                "frontier.pad_waste_single_bucket_pct").snapshot() or 0.0,
+            "page_faults": _reg.counter(
+                "frontier.page_faults").snapshot() or 0,
+            "page_repacks": _reg.counter(
+                "frontier.page_repacks").snapshot() or 0,
+            "page_resident_pct": _reg.gauge(
+                "frontier.page_resident_pct").snapshot() or 100.0,
+        }
         requests = out["service.requests"] or 0
         out["cache"] = {
             "dedup_hit_rate": round(out["service.dedup_hits"] / requests, 4)

@@ -137,6 +137,24 @@ def format_top(stats: Dict[str, Any], address: Optional[str] = None) -> str:
                     f"{compile_s:>9}{rcmp:>6}  {rids}"
                 )
 
+    frontier = stats.get("frontier") or {}
+    if frontier.get("bucket_classes") or frontier.get("page_faults"):
+        line = (
+            "frontier: {c} bucket classes  pad-waste {w:.1f}%"
+            " (single-bucket {s:.1f}%)".format(
+                c=frontier.get("bucket_classes", 0),
+                w=frontier.get("pad_waste_pct", 0.0),
+                s=frontier.get("pad_waste_single_bucket_pct", 0.0),
+            )
+        )
+        if frontier.get("page_faults") or frontier.get("page_repacks"):
+            line += "  |  paging: {f} faults  {r} repacks  {p:.0f}% resident".format(
+                f=frontier.get("page_faults", 0),
+                r=frontier.get("page_repacks", 0),
+                p=frontier.get("page_resident_pct", 100.0),
+            )
+        lines.append(line)
+
     prefilter = stats.get("prefilter") or {}
     if prefilter.get("evaluated"):
         lines.append(

@@ -87,6 +87,19 @@ class Args:
     # --no-staticpass-interproc keeps the base passes only — the bench
     # parity gate compares exactly this toggle
     staticpass_interproc: bool = True
+    # large-code frontier (mythril_tpu/frontier/code): per-code bucket
+    # isolation (codes cluster into size classes, each dispatched with its
+    # own compiled segment instead of one corpus-wide max bucket) plus
+    # packed-code paging (codes beyond the residency budget keep only a
+    # hot window resident; cold jumps fault to the host for a repack).
+    # Issue-set-identical either way; --no-code-paging is the escape
+    # hatch (and the parity baseline for bench.py --paging-compare)
+    code_paging: bool = True
+    # instruction-axis residency budget for packed-code paging: codes
+    # whose instruction count exceeds the grown bucket of this value page
+    # through a window of that size (0 disables paging, keeping bucket
+    # isolation only)
+    code_page_budget: int = 2048
     # pipelined frontier (mythril_tpu/frontier/pipeline): overlap device
     # segments with host harvest/solve via chained dispatch + a background
     # feasibility pool.  Issue-set-identical to the synchronous loop;
